@@ -1,0 +1,171 @@
+"""AMP — auto-mixed precision (ref: python/paddle/amp/ — auto_cast, decorate,
+GradScaler; C++ eager autocast paddle/fluid/eager/amp_utils.h).
+
+TPU-first: bf16 is the native MXU dtype and needs no loss scaling, so the
+production path is O2-style — params cast to bf16, fp32 masters in the
+optimizer (`multi_precision=True`), fp32 accumulation in matmuls/softmax
+(handled inside our ops via preferred_element_type / explicit fp32 math).
+
+* ``auto_cast(enable, dtype)``: context manager setting the compute-dtype
+  policy; `amp_cast` consults it (O1-style per-op casting).
+* ``decorate(models, optimizers, level='O2', dtype='bfloat16')``: casts model
+  params; optimizer keeps fp32 masters.
+* ``GradScaler``: dynamic loss scaling for fp16 parity; with bf16 it is an
+  identity (matching the reference, which skips scaling for bf16).
+"""
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtype import to_jax_dtype, is_floating
+
+_tls = threading.local()
+
+# ops whitelisted to run in low precision under O1 (mirrors the reference's
+# white/black lists: matmul/conv in low precision, softmax/norm/reduce in fp32)
+WHITE_LIST = {"matmul", "linear", "conv2d", "einsum", "bmm"}
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "rms_norm", "cross_entropy",
+              "mean", "sum", "exp", "log"}
+
+
+def _state():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    cfg = {
+        "enable": enable,
+        "level": level,
+        "dtype": to_jax_dtype(dtype),
+        "white": WHITE_LIST | set(custom_white_list or ()),
+        "black": BLACK_LIST | set(custom_black_list or ()),
+    }
+    _state().append(cfg)
+    try:
+        yield
+    finally:
+        _state().pop()
+
+
+amp_guard = auto_cast
+
+
+def get_amp_policy():
+    s = _state()
+    return s[-1] if s else None
+
+
+def amp_dtype():
+    """Compute dtype under the active autocast policy (None if disabled)."""
+    p = get_amp_policy()
+    if p and p["enable"]:
+        return p["dtype"]
+    return None
+
+
+def amp_cast(x, op_name="matmul"):
+    """Cast `x` per the active policy for op `op_name` (O1 per-op casting)."""
+    p = get_amp_policy()
+    if not p or not p["enable"]:
+        return x
+    if op_name in p["black"]:
+        target = jnp.float32
+    elif op_name in p["white"] or p["level"] == "O2":
+        target = p["dtype"]
+    else:
+        return x
+    return jax.tree_util.tree_map(
+        lambda t: t.astype(target) if hasattr(t, "dtype") and is_floating(t.dtype) else t, x)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to `dtype` (level O2); optimizers keep fp32 masters."""
+    dt = to_jax_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for _, p in m.named_parameters():
+                # keep norm-style small vectors in fp32 for numerics
+                if is_floating(p.value.dtype):
+                    p.value = p.value.astype(dt)
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        o.multi_precision = True
+    return (models if single else model_list,
+            optimizers if opt_single else opt_list)
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py).
+
+    Functional usage inside jit:
+        scaled = scaler.scale(loss)
+        ... grads of scaled loss ...
+        grads, found_inf = scaler.unscale(grads)
+        new_sstate = scaler.update_state(sstate, found_inf)
+    Eager usage mirrors the reference (`scale`, `step`-less minimize flow).
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self.enable = enable
+        self.init_loss_scaling = init_loss_scaling
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self._scale = jnp.asarray(init_loss_scaling, jnp.float32)
+        self._good_steps = 0
+
+    def init_state(self):
+        return {"scale": jnp.asarray(self.init_loss_scaling, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32)}
+
+    def scale(self, loss, state=None):
+        if not self.enable:
+            return loss
+        s = state["scale"] if state is not None else self._scale
+        return loss * s
+
+    def unscale(self, grads, state=None):
+        if not self.enable:
+            return grads, jnp.zeros((), jnp.bool_)
+        s = state["scale"] if state is not None else self._scale
+        inv = 1.0 / s
+        un = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        leaves = jax.tree_util.tree_leaves(un)
+        found_inf = jnp.any(jnp.stack([jnp.any(~jnp.isfinite(g)) for g in leaves]))
+        return un, found_inf
+
+    def update_state(self, state, found_inf):
+        if not self.dynamic:
+            return state
+        good = jnp.where(found_inf, 0, state["good_steps"] + 1)
+        grow = good >= self.incr_every_n_steps
+        scale = jnp.where(found_inf, state["scale"] * self.decr_ratio,
+                          jnp.where(grow, state["scale"] * self.incr_ratio,
+                                    state["scale"]))
+        scale = jnp.clip(scale, 1.0, 2.0 ** 31)
+        good = jnp.where(grow, 0, good)
+        return {"scale": scale, "good_steps": good}
+
+    # eager parity
+    def is_enable(self):
+        return self.enable
+
+    def get_loss_scaling(self):
+        return float(self._scale)

@@ -1,0 +1,1 @@
+from paddle_tpu.core import dtype, enforce, flags, rng, device  # noqa: F401

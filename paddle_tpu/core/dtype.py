@@ -1,0 +1,65 @@
+"""Dtype aliases and default-dtype control.
+
+Mirrors the reference's dtype surface (paddle.float32 etc., `paddle.set_default_dtype`;
+ref: python/paddle/framework/dtype.py). TPU-first: bfloat16 is a first-class citizen
+and the preferred compute dtype on the MXU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+float32 = jnp.float32
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+_STR2DTYPE = {
+    "float32": float32,
+    "fp32": float32,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float64": float64,
+    "fp64": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+}
+
+_default_dtype = [jnp.float32]
+
+
+def to_jax_dtype(dtype):
+    """Normalize a user dtype spec (string / np dtype / jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return get_default_dtype()
+    if isinstance(dtype, str):
+        try:
+            return _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    return jnp.dtype(dtype).type
+
+
+def set_default_dtype(dtype):
+    _default_dtype[0] = to_jax_dtype(dtype)
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating)

@@ -1,0 +1,58 @@
+"""Enforce-style error checking with rich context.
+
+The reference wraps every native call in PADDLE_ENFORCE* macros that attach an
+error class, a hint, and a call-stack summary (ref: paddle/fluid/platform/enforce.h,
+phi::enforce). Here errors surface from Python/XLA directly, so this module only
+provides the user-facing check helpers and an error-context manager that prefixes
+framework context onto exceptions (the moral equivalent of Paddle's error stacks).
+"""
+
+import contextlib
+
+
+class EnforceError(RuntimeError):
+    pass
+
+
+class NotFoundError(EnforceError):
+    pass
+
+
+class InvalidArgumentError(EnforceError, ValueError):
+    pass
+
+
+class UnimplementedError(EnforceError, NotImplementedError):
+    pass
+
+
+def enforce(cond, msg="enforce failed", exc=EnforceError):
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg=""):
+    if a != b:
+        raise InvalidArgumentError(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_shape(x, expected, msg=""):
+    got = tuple(x.shape)
+    expected = tuple(expected)
+    if len(got) != len(expected) or any(
+        e is not None and e != g for g, e in zip(got, expected)
+    ):
+        raise InvalidArgumentError(f"Expected shape {expected}, got {got}. {msg}")
+
+
+@contextlib.contextmanager
+def error_context(ctx: str):
+    """Prefix `ctx` onto any exception escaping the block (≈ Paddle error stacks)."""
+    try:
+        yield
+    except Exception as e:
+        note = f"[paddle_tpu] {ctx}"
+        if hasattr(e, "add_note"):
+            e.add_note(note)
+            raise
+        raise type(e)(f"{note}: {e}") from e

@@ -1,0 +1,175 @@
+"""RNG discipline: global seed, named streams, and the TP rng-state tracker.
+
+The reference keeps per-device stateful generators (`phi::Generator`, `paddle.seed`)
+and, for tensor parallelism, a named rng-state tracker so dropout masks are identical
+across TP ranks for replicated activations but distinct for model-parallel ones
+(ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py,
+`get_rng_state_tracker`).
+
+TPU-first design: JAX RNG is functional (explicit keys). Eager code gets a stateful
+veneer (`paddle_tpu.seed`, fresh key per draw); jitted code threads keys explicitly.
+`rng_guard` pushes a dict of named streams for a traced region — layers pull keys by
+stream name via `next_rng_key`, each pull folding in a counter so draws are unique
+and reproducible under trace.
+"""
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class _GlobalGenerator:
+    """Stateful eager generator: splits off a fresh key per draw."""
+
+    def __init__(self, seed_: int = 0):
+        self._seed = seed_
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def seed(self, s: int):
+        with self._lock:
+            self._seed = int(s)
+            self._count = 0
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            c = self._count
+            self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), c)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = int(state[0]), int(state[1])
+
+
+_GLOBAL = _GlobalGenerator(0)
+
+
+def seed(s: int):
+    """Set the global seed (`paddle.seed` parity)."""
+    _GLOBAL.seed(s)
+    return _GLOBAL
+
+
+def get_rng_state():
+    return _GLOBAL.get_state()
+
+
+def set_rng_state(state):
+    _GLOBAL.set_state(state)
+
+
+def global_key() -> jax.Array:
+    return _GLOBAL.next_key()
+
+
+# ---- Traced rng streams ----------------------------------------------------
+
+class _StreamFrame:
+    def __init__(self, keys: Dict[str, jax.Array]):
+        self.keys = dict(keys)
+        self.counters: Dict[str, int] = {}
+
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def rng_guard(rngs: Optional[Dict[str, jax.Array]] = None, **kw):
+    """Push named rng streams for the dynamic extent of a (possibly traced) call.
+
+    >>> with rng_guard(dropout=key):
+    ...     y = model(x)   # Dropout layers pull from the 'dropout' stream
+    """
+    keys = dict(rngs or {})
+    keys.update(kw)
+    frame = _StreamFrame(keys)
+    _stack().append(frame)
+    try:
+        yield frame
+    finally:
+        _stack().pop()
+
+
+def has_rng(name: str) -> bool:
+    for frame in reversed(_stack()):
+        if name in frame.keys:
+            return True
+    return False
+
+
+def next_rng_key(name: str = "default") -> jax.Array:
+    """Pull the next key from stream `name`; falls back to the eager global gen."""
+    for frame in reversed(_stack()):
+        if name in frame.keys:
+            c = frame.counters.get(name, 0)
+            frame.counters[name] = c + 1
+            return jax.random.fold_in(frame.keys[name], c)
+    # Eager fallback (outside jit): stateful global generator.
+    try:
+        from jax._src import core as _core
+        if not _core.trace_state_clean():
+            import warnings
+            warnings.warn(
+                f"next_rng_key({name!r}) called under jit tracing with no rng "
+                "stream bound: the key becomes a compile-time constant, so "
+                "every call of the compiled function reuses the same "
+                "randomness. Pass rngs={...} to functional_call / rng_guard.",
+                stacklevel=2)
+    except ImportError:
+        pass
+    return _GLOBAL.next_key()
+
+
+class RNGStatesTracker:
+    """Named seeds for TP-aware dropout (`get_rng_state_tracker` parity).
+
+    Register e.g. 'global_seed' (same on all mp ranks) and 'local_seed'
+    (offset by mp rank); `rng_state(name)` scopes subsequent draws to it.
+    """
+
+    def __init__(self):
+        self._seeds: Dict[str, int] = {}
+
+    def add(self, name: str, seed_: int):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name!r} already added")
+        self._seeds[name] = int(seed_)
+
+    def reset(self):
+        self._seeds.clear()
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._seeds:
+            raise KeyError(f"rng state {name!r} not registered (have {sorted(self._seeds)})")
+        key = jax.random.PRNGKey(self._seeds[name])
+        with rng_guard(default=key, dropout=key):
+            yield
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
+    """Set up 'global_seed'/'local_seed' streams the way Fleet TP does."""
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", seed_ + 100003)
+    _TRACKER.add("local_seed", seed_ + 100003 + 1024 * (1 + mp_rank))
+    np.random.seed(seed_)
+    seed(seed_)

@@ -1,0 +1,64 @@
+"""Autograd + jit veneer.
+
+The reference's eager autograd engine (egr::Backward, GradNode graph —
+paddle/fluid/eager/backward.cc) is subsumed by jax.grad: the backward graph is
+built by tracing, not taped at runtime. This module provides the user-facing
+helpers that make the functional style feel like the reference:
+
+* ``paddle_tpu.grad(fn)`` / ``value_and_grad`` — jax passthroughs.
+* ``paddle_tpu.jit(fn)`` — jax.jit with donate/static conveniences (the
+  analog of @to_static: trace once, run compiled; dy2static's AST rewriting is
+  unnecessary because jax traces Python directly, with lax.cond/scan for
+  data-dependent control flow).
+* ``value_and_grad_layer(layer, loss_fn)`` — grads of a Layer's trainable
+  state via the functional bridge.
+* ``no_grad`` — stop-gradient context parity (functional code simply doesn't
+  differentiate; this exists for API compatibility and wraps jax.lax.stop_gradient
+  on request).
+"""
+
+import contextlib
+import functools
+
+import jax
+
+grad = jax.grad
+value_and_grad = jax.value_and_grad
+
+
+def jit(fn=None, *, static_argnums=None, static_argnames=None, donate_argnums=None):
+    if fn is None:
+        return functools.partial(jit, static_argnums=static_argnums,
+                                 static_argnames=static_argnames,
+                                 donate_argnums=donate_argnums)
+    return jax.jit(fn, static_argnums=static_argnums,
+                   static_argnames=static_argnames,
+                   donate_argnums=donate_argnums or ())
+
+
+to_static = jit  # @paddle.jit.to_static parity: trace-and-compile
+
+
+@contextlib.contextmanager
+def no_grad():
+    yield
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+def value_and_grad_layer(layer, loss_fn, has_aux=False):
+    """Return f(state, *args) -> ((loss, aux?), grads) over `layer`'s state.
+
+    `loss_fn(outputs, *args) -> loss` is applied to layer(*inputs).
+    """
+    from paddle_tpu.nn.layer import functional_call
+
+    def wrapped(state, inputs, *loss_args, rngs=None):
+        def inner(s):
+            out = functional_call(layer, s, *inputs, rngs=rngs)
+            return loss_fn(out, *loss_args)
+        return jax.value_and_grad(inner, has_aux=has_aux)(state)
+
+    return wrapped

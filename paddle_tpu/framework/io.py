@@ -1,0 +1,49 @@
+"""Checkpoint I/O: paddle.save / paddle.load parity.
+
+Ref: python/paddle/framework/io.py — pickled state_dict trees. Here arrays are
+stored as numpy inside a pickle (protocol 4, >4 GB safe); sharding-aware
+distributed checkpointing (the Orbax path, with resharding-on-load) lives in
+paddle_tpu/parallel/checkpoint.py.
+"""
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def _to_host(obj):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+
+    def to_jax(o):
+        if isinstance(o, np.ndarray):
+            import jax.numpy as jnp
+            return jnp.asarray(o)
+        if isinstance(o, dict):
+            return {k: to_jax(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(to_jax(v) for v in o)
+        return o
+
+    return to_jax(obj)

@@ -1,0 +1,9 @@
+"""In-repo model zoo (BASELINE.json configs).
+
+The reference keeps GPT/Llama/ERNIE/MoE/UNet in PaddleNLP/PaddleMIX; this repo
+supplies minimal pretrain-grade implementations as the config matrix demands:
+GPT-2 (345M single-device), Llama-2 (7B/65B hybrid), Mixtral-style MoE
+(expert parallel), SD UNet (conv+attn).
+"""
+
+from paddle_tpu.models.gpt import GPTConfig, GPTModel, GPTPretrainModel  # noqa: F401

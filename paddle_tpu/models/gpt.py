@@ -1,0 +1,142 @@
+"""GPT-2 — the single-device end-to-end config (BASELINE config #1).
+
+Capability reference: PaddleNLP's GPT pretrain on the reference substrate
+(SURVEY.md §2.7 note). TPU-first choices: pre-norm blocks in bf16-friendly
+form, attention through ops.flash_attention (MXU path), learned positional
+embeddings, weight-tied unembedding.
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 1024
+    intermediate_size: Optional[int] = None
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+
+    # gpt2-345m preset
+    @classmethod
+    def gpt2_medium(cls):
+        return cls(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @classmethod
+    def tiny(cls, vocab_size=1024):
+        return cls(vocab_size=vocab_size, hidden_size=128, num_layers=2,
+                   num_heads=4, max_position_embeddings=128,
+                   hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        w_init = init.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=w_init)
+        self.out_proj = nn.Linear(h, h, weight_attr=init.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)))
+        self.num_heads = nh
+        self.head_dim = h // nh
+        self.attn_dropout = cfg.attention_dropout_prob
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_dropout,
+            training=self.training)
+        return self.out_proj(out.reshape(b, s, h))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        w_init = init.Normal(0.0, cfg.initializer_range)
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.ffn_size, weight_attr=w_init)
+        self.fc_out = nn.Linear(cfg.ffn_size, cfg.hidden_size,
+                                weight_attr=init.Normal(
+                                    0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)))
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
+                                                approximate=True)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        w_init = init.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=w_init)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=w_init)
+        self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTPretrainModel(nn.Layer):
+    """LM head (tied) + causal LM loss."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.cfg = cfg
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        x = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = jnp.matmul(x, self.gpt.wte.weight.T)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+    def loss(self, logits, labels):
+        return F.cross_entropy(logits.reshape(-1, logits.shape[-1]),
+                               labels.reshape(-1))
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())

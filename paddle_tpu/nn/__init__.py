@@ -1,0 +1,53 @@
+from paddle_tpu.nn.layer import Layer, Parameter, functional_call, make_apply  # noqa: F401
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn.layers.common import (  # noqa: F401
+    Linear,
+    Embedding,
+    Dropout,
+    Identity,
+    Flatten,
+    ReLU,
+    ReLU6,
+    GELU,
+    Silu,
+    Sigmoid,
+    Tanh,
+    Softmax,
+    LeakyReLU,
+    Hardswish,
+    Mish,
+    Sequential,
+    LayerList,
+    LayerDict,
+    ParameterList,
+)
+from paddle_tpu.nn.layers.norm import (  # noqa: F401
+    LayerNorm,
+    RMSNorm,
+    BatchNorm,
+    BatchNorm2D,
+    GroupNorm,
+)
+from paddle_tpu.nn.layers.conv import (  # noqa: F401
+    Conv1D,
+    Conv2D,
+    Conv2DTranspose,
+    MaxPool2D,
+    AvgPool2D,
+    AdaptiveAvgPool2D,
+    Upsample,
+)
+from paddle_tpu.nn.layers.transformer import (  # noqa: F401
+    MultiHeadAttention,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from paddle_tpu.nn.loss import (  # noqa: F401
+    CrossEntropyLoss,
+    MSELoss,
+    L1Loss,
+    NLLLoss,
+    BCEWithLogitsLoss,
+    KLDivLoss,
+)

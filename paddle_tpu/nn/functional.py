@@ -1,0 +1,437 @@
+"""Functional NN ops (`paddle.nn.functional` parity).
+
+Ref: python/paddle/nn/functional/ — activations, linear, conv, pooling, norm,
+loss, attention. Each op is a jnp/lax composition that XLA fuses; the hot fused
+paths (flash attention, rms_norm, rope) additionally have Pallas TPU kernels in
+`paddle_tpu.ops`, which these wrappers dispatch to when profitable.
+"""
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import rng as _rng
+
+
+# ---- activations -----------------------------------------------------------
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardsigmoid(x):
+    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta=1.0):
+    return jax.nn.softplus(beta * x) / beta
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+# ---- linear / embedding ----------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). Weight layout (in, out) — matches the reference."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(ids, weight, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+# ---- dropout ---------------------------------------------------------------
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_name="dropout"):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return x * (1.0 - p)  # reference contract: infer scales by (1-p)
+        return x
+    key = _rng.next_rng_key(rng_name)
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# ---- normalization ---------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(x.ndim - len(tuple(normalized_shape)
+                 if not isinstance(normalized_shape, int) else (normalized_shape,)), x.ndim))
+    mean = jnp.mean(x.astype(jnp.float32), axis=axes, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=axes, keepdims=True)
+    y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    from paddle_tpu.ops import rms_norm as _rms
+    return _rms.rms_norm(x, weight, epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    ch_axis = 1 if data_format == "NCHW" else -1
+    axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[ch_axis % x.ndim] = x.shape[ch_axis % x.ndim]
+    y = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y, new_rm, new_rv
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = x.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mean) * lax.rsqrt(var + epsilon)
+    y = g.reshape(n, c, *spatial)
+    if weight is not None:
+        shape = (1, c) + (1,) * len(spatial)
+        y = y * weight.reshape(shape)
+        if bias is not None:
+            y = y + bias.reshape(shape)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+# ---- conv / pool -----------------------------------------------------------
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """weight layout: (out_ch, in_ch/groups, kh, kw) — reference layout."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, (tuple, list)) and padding and \
+            isinstance(padding[0], (tuple, list)):
+        pad = [tuple(p) for p in padding]
+    else:
+        p = _pair(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        y = y + bias.reshape(shape)
+    return y
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    # lift (N,C,L) → (N,C,L,1); pad only the L axis
+    pad = padding if isinstance(padding, str) else ((padding, padding), (0, 0))
+    y = conv2d(x[..., None], weight[..., None], None, (stride, 1), pad,
+               (dilation, 1), groups)[..., 0]
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1)
+    return y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, data_format="NCHW"):
+    """weight layout: (in_ch, out_ch, kh, kw) — reference layout."""
+    stride = _pair(stride)
+    p = _pair(padding)
+    op = _pair(output_padding)
+    kh, kw = weight.shape[2], weight.shape[3]
+    # out = (in-1)*stride - 2*pad + k + output_padding: extra rows go on the
+    # high side of the dilated input
+    pad = [(kh - 1 - p[0], kh - 1 - p[0] + op[0]),
+           (kw - 1 - p[1], kw - 1 - p[1] + op[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1], weight.shape[0], kh, kw),
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    w = jnp.flip(jnp.swapaxes(weight, 0, 1), axis=(2, 3))
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
+        dimension_numbers=dn)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        y = y + bias.reshape(shape)
+    return y
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    k, s = _pair(kernel_size), _pair(stride or kernel_size)
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    k, s = _pair(kernel_size), _pair(stride or kernel_size)
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+    return summed / counts
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out = _pair(output_size)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    assert h % out[0] == 0 and w % out[1] == 0, "adaptive pool needs divisible sizes"
+    return avg_pool2d(x, (h // out[0], w // out[1]), (h // out[0], w // out[1]),
+                      0, data_format)
+
+
+def interpolate(x, scale_factor=None, size=None, mode="nearest",
+                data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+    if data_format == "NCHW":
+        y = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    else:
+        y = jax.image.resize(x, (n, size[0], size[1], c), method=method)
+    return y.astype(x.dtype)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """`pad` is paddle-style: flat list, last dim first pairs for NCHW 4-tuples."""
+    if len(pad) == x.ndim * 2:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # pad applies to trailing spatial dims, reference order (left,right,top,bottom)
+        cfg = [(0, 0)] * x.ndim
+        n_spatial = len(pad) // 2
+        for i in range(n_spatial):
+            axis = x.ndim - 1 - i
+            cfg[axis] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    return jnp.pad(x, cfg, mode={"reflect": "reflect", "replicate": "edge"}[mode])
+
+
+# ---- losses ----------------------------------------------------------------
+
+def cross_entropy(logits, label, reduction="mean", soft_label=False,
+                  ignore_index=-100, axis=-1, label_smoothing=0.0):
+    logits_f32 = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits_f32, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        label = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(label, logits.shape[axis], axis=axis, dtype=jnp.float32)
+        if label_smoothing > 0.0:
+            n = logits.shape[axis]
+            oh = oh * (1.0 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(oh * logp, axis=axis)
+        valid = (label != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    return cross_entropy(logits, label, reduction="none", soft_label=soft_label,
+                         axis=axis)
+
+
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
+    loss = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def nll_loss(log_probs, label, reduction="mean"):
+    picked = jnp.take_along_axis(log_probs, label[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    loss = -picked
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction in ("sum", "batchmean"):
+        s = jnp.sum(loss)
+        return s / input.shape[0] if reduction == "batchmean" else s
+    return loss
+
+
+# ---- attention -------------------------------------------------------------
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None):
+    """q/k/v: (batch, seq, heads, head_dim) — the reference's layout.
+
+    Dispatches to the Pallas flash kernel on TPU when profitable
+    (paddle_tpu.ops.flash_attention), else the XLA softmax path.
+    """
+    from paddle_tpu.ops import flash_attention as fa
+    return fa.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
+        training=training, scale=scale)
+
+
+# ---- misc ------------------------------------------------------------------
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def label_smooth(label, epsilon=0.1):
+    n = label.shape[-1]
+    return label * (1 - epsilon) + epsilon / n
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    denom = jnp.maximum(jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True), epsilon)
+    return x / denom
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot_ / jnp.maximum(n1 * n2, eps)

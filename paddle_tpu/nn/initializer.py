@@ -1,0 +1,130 @@
+"""Weight initializers (`paddle.nn.initializer` parity).
+
+Ref: python/paddle/nn/initializer/ — Constant, Normal, TruncatedNormal, Uniform,
+XavierNormal/Uniform, KaimingNormal/Uniform, Assign. Initializers are callables
+`(shape, dtype) -> Array`, drawing from the global RNG (respecting `paddle_tpu.seed`).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import rng as _rng
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]  # Linear weight layout (in, out)
+    # conv kernels: (out_ch, in_ch, *spatial) layout (see nn/layers/conv.py)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        arr = jnp.asarray(self.value, dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), (arr.shape, shape)
+        return arr
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = _rng.next_rng_key("params")
+        return (self.mean + self.std *
+                jax.random.normal(k, tuple(shape))).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = _rng.next_rng_key("params")
+        return (self.mean + self.std *
+                jax.random.truncated_normal(k, -2.0, 2.0, tuple(shape))).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        k = _rng.next_rng_key("params")
+        return jax.random.uniform(k, tuple(shape), minval=self.low,
+                                  maxval=self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fan_in_out(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        k = _rng.next_rng_key("params")
+        return (std * jax.random.normal(k, tuple(shape))).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        k = _rng.next_rng_key("params")
+        return jax.random.uniform(k, tuple(shape), minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fan_in = self.fan_in or _fan_in_out(shape)[0]
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fan_in)
+        k = _rng.next_rng_key("params")
+        return (std * jax.random.normal(k, tuple(shape))).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fan_in = self.fan_in or _fan_in_out(shape)[0]
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        k = _rng.next_rng_key("params")
+        return jax.random.uniform(k, tuple(shape), minval=-limit,
+                                  maxval=limit).astype(dtype)

@@ -1,0 +1,358 @@
+"""Layer — the module system, and its functional bridge to jax.jit.
+
+The reference's `paddle.nn.Layer` (ref: python/paddle/nn/layer/layers.py) is a
+stateful module tree: parameters/buffers/sublayers registered by attribute
+assignment, `state_dict`/`set_state_dict`, forward hooks, train/eval modes.
+
+TPU-first design: the same stateful authoring UX, but parameters are jax
+Arrays and the whole tree is one pytree. Training runs through the functional
+bridge — `functional_call(layer, state, *args)` temporarily binds `state`
+(a flat {qualified_name: array} dict) into the tree, runs forward, and
+restores. Under `jax.jit` tracing this yields a pure function of the state,
+so `jax.grad`/`jax.value_and_grad` and GSPMD shardings apply directly; the
+per-op dispatch loop the reference runs every step exists here only at trace
+time (SURVEY.md §3.1).
+"""
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import to_jax_dtype, is_floating
+from paddle_tpu.core import rng as rng_mod
+
+
+class Parameter:
+    """A named, trainable-flagged slot holding a jax Array."""
+
+    __slots__ = ("value", "trainable", "name", "is_distributed", "pspec")
+
+    def __init__(self, value, trainable=True, name=None, pspec=None):
+        self.value = value
+        self.trainable = trainable
+        self.name = name
+        self.is_distributed = False
+        # PartitionSpec placement hint consumed by fleet/auto_parallel
+        # (≈ the reference's TensorDistAttr dims_mapping on DistTensor)
+        self.pspec = pspec
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def __repr__(self):
+        return f"Parameter(shape={tuple(self.value.shape)}, dtype={self.value.dtype}, trainable={self.trainable})"
+
+
+class Layer:
+    """Base class for all network modules (``paddle.nn.Layer`` parity)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_dtype", to_jax_dtype(dtype))
+        object.__setattr__(self, "_name_scope", name_scope or type(self).__name__)
+
+    # -- registration --------------------------------------------------------
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self._buffers.pop(name, None)
+            self._sub_layers.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self._parameters.pop(name, None)
+            self._buffers.pop(name, None)
+        else:
+            if name in self._parameters:
+                # assigning an array onto a parameter slot updates it in place
+                if isinstance(value, (jax.Array, np.ndarray)):
+                    self._parameters[name].value = jnp.asarray(value)
+                    return
+                del self._parameters[name]
+            if name in self._buffers:
+                if isinstance(value, (jax.Array, np.ndarray)):
+                    self._buffers[name] = jnp.asarray(value)
+                    return
+                del self._buffers[name]
+            self._sub_layers.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        d = self.__dict__
+        if name in d.get("_parameters", ()):
+            return d["_parameters"][name].value
+        if name in d.get("_buffers", ()):
+            return d["_buffers"][name]
+        if name in d.get("_sub_layers", ()):
+            return d["_sub_layers"][name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in (self._parameters, self._buffers, self._sub_layers):
+            if name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias=False, attr=None, trainable=True):
+        """Create + register-ready Parameter (assign it to an attribute)."""
+        from paddle_tpu.nn import initializer as init
+        dtype = to_jax_dtype(dtype) if dtype is not None else self._dtype
+        if default_initializer is None:
+            default_initializer = init.Constant(0.0) if is_bias else init.XavierNormal()
+        value = default_initializer(shape, dtype)
+        return Parameter(value, trainable=trainable)
+
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = jnp.asarray(tensor) if tensor is not None else None
+
+    # -- traversal -----------------------------------------------------------
+
+    def named_sublayers(self, prefix="", include_self=False) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for name, sub in self._sub_layers.items():
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_parameters(prefix=sp)
+
+    def parameters(self, include_sublayers=True):
+        if include_sublayers:
+            return [p for _, p in self.named_parameters()]
+        return list(self._parameters.values())
+
+    def named_buffers(self, prefix=""):
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for name, sub in self._sub_layers.items():
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_buffers(prefix=sp)
+
+    def buffers(self):
+        return [b for _, b in self.named_buffers()]
+
+    # -- state dict ----------------------------------------------------------
+
+    def state_dict(self, include_buffers=True) -> Dict[str, jax.Array]:
+        # plain dict: insertion-ordered and pytree-compatible with the plain
+        # dicts produced by optimizers/grads (OrderedDict has a distinct treedef)
+        out = {}
+        for name, p in self.named_parameters():
+            out[name] = p.value
+        if include_buffers:
+            for name, b in self.named_buffers():
+                if b is not None:
+                    out[name] = b
+        return out
+
+    def trainable_state(self) -> Dict[str, jax.Array]:
+        return {n: p.value for n, p in self.named_parameters() if p.trainable}
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        params = dict(self.named_parameters())
+        buffer_owners = self._buffer_owners()
+        for k, v in state_dict.items():
+            v = jnp.asarray(v)
+            if k in params:
+                params[k].value = v.astype(params[k].value.dtype)
+            elif k in buffer_owners:
+                owner, local = buffer_owners[k]
+                owner._buffers[local] = v
+            else:
+                unexpected.append(k)
+        for k in params:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def _buffer_owners(self):
+        out = {}
+        for prefix, layer in self.named_sublayers(include_self=True, prefix=""):
+            for name in layer._buffers:
+                out[f"{prefix}.{name}" if prefix else name] = (layer, name)
+        return out
+
+    # -- modes / transforms --------------------------------------------------
+
+    def train(self):
+        object.__setattr__(self, "training", True)
+        for l in self.sublayers():
+            object.__setattr__(l, "training", True)
+        return self
+
+    def eval(self):
+        object.__setattr__(self, "training", False)
+        for l in self.sublayers():
+            object.__setattr__(l, "training", False)
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None):
+        if dtype is not None:
+            dt = to_jax_dtype(dtype)
+            for _, p in self.named_parameters():
+                if is_floating(p.value.dtype):
+                    p.value = p.value.astype(dt)
+            for prefix, layer in self.named_sublayers(include_self=True):
+                for name, b in layer._buffers.items():
+                    if b is not None and is_floating(b.dtype):
+                        layer._buffers[name] = b.astype(dt)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def bfloat16(self):
+        return self.to(dtype=jnp.bfloat16)
+
+    def float(self):
+        return self.to(dtype=jnp.float32)
+
+    # -- hooks ---------------------------------------------------------------
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ----------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, args)
+            if out is not None:
+                args = out if isinstance(out, tuple) else (out,)
+        y = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, args, y)
+            if out is not None:
+                y = out
+        return y
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else f"{type(self).__name__}()"
+
+    # extra_repr parity
+    def full_name(self):
+        return self._name_scope
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)
+
+
+# ---- functional bridge -----------------------------------------------------
+
+@contextlib.contextmanager
+def _bind_state(layer: Layer, state: Dict[str, jax.Array]):
+    """Temporarily swap arrays from `state` into the layer tree."""
+    params = dict(layer.named_parameters())
+    buffer_owners = layer._buffer_owners()
+    saved = []
+    try:
+        for k, v in state.items():
+            if k in params:
+                saved.append(("p", params[k], params[k].value))
+                params[k].value = v
+            elif k in buffer_owners:
+                owner, local = buffer_owners[k]
+                saved.append(("b", (owner, local), owner._buffers[local]))
+                owner._buffers[local] = v
+            else:
+                raise KeyError(f"state key {k!r} not found in {type(layer).__name__}")
+        yield
+    finally:
+        for kind, slot, old in reversed(saved):
+            if kind == "p":
+                slot.value = old
+            else:
+                owner, local = slot
+                owner._buffers[local] = old
+
+
+def functional_call(layer: Layer, state: Dict[str, jax.Array], *args,
+                    rngs: Optional[Dict[str, jax.Array]] = None,
+                    mutable: bool = False, **kwargs):
+    """Run ``layer(*args)`` with `state` bound in — a pure function of `state`.
+
+    With ``mutable=True`` returns ``(out, new_buffers)`` where `new_buffers`
+    is the post-call value of every buffer (e.g. batchnorm running stats).
+    """
+    with _bind_state(layer, state):
+        with rng_mod.rng_guard(rngs or {}):
+            out = layer(*args, **kwargs)
+            if mutable:
+                new_buffers = {n: b for n, b in layer.named_buffers()
+                               if b is not None}
+                return out, new_buffers
+    return out
+
+
+def make_apply(layer: Layer) -> Callable:
+    """Return ``apply(state, *args, rngs=None) -> out`` — the jit-ready forward."""
+    def apply(state, *args, rngs=None, **kwargs):
+        return functional_call(layer, state, *args, rngs=rngs, **kwargs)
+    return apply

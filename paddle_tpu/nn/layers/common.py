@@ -1,0 +1,241 @@
+"""Common layers: Linear, Embedding, Dropout, activations, containers.
+
+Ref: python/paddle/nn/layer/{common.py,container.py,activation.py}.
+"""
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer, Parameter
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.core.dtype import to_jax_dtype
+
+
+class Linear(Layer):
+    """y = xW + b with W of shape (in_features, out_features) (reference layout)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None,
+                 name=None, dtype=None):
+        super().__init__()
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.XavierNormal()
+        self.weight = self.create_parameter(
+            (in_features, out_features), dtype=dtype, default_initializer=w_init)
+        if bias_attr is not False:
+            b_init = bias_attr if isinstance(bias_attr, init.Initializer) else init.Constant(0.0)
+            self.bias = self.create_parameter(
+                (out_features,), dtype=dtype, default_initializer=b_init, is_bias=True)
+        else:
+            self.bias = None
+        self.in_features, self.out_features = in_features, out_features
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias if "bias" in self._parameters else None)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None, dtype=None):
+        super().__init__()
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.Normal(0.0, 1.0)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), dtype=dtype, default_initializer=w_init)
+        self.padding_idx = padding_idx
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None, rng_name="dropout"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+        self.rng_name = rng_name
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode,
+                         rng_name=self.rng_name)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        from paddle_tpu import tensor as T
+        return T.flatten(x, self.start_axis, self.stop_axis)
+
+
+class _Activation(Layer):
+    _fn = None
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return type(self)._fn(x)
+
+
+class ReLU(_Activation):
+    _fn = staticmethod(F.relu)
+
+
+class ReLU6(_Activation):
+    _fn = staticmethod(F.relu6)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, approximate=self.approximate)
+
+
+class Silu(_Activation):
+    _fn = staticmethod(F.silu)
+
+
+class Sigmoid(_Activation):
+    _fn = staticmethod(F.sigmoid)
+
+
+class Tanh(_Activation):
+    _fn = staticmethod(F.tanh)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Hardswish(_Activation):
+    _fn = staticmethod(F.hardswish)
+
+
+class Mish(_Activation):
+    _fn = staticmethod(F.mish)
+
+
+# ---- containers ------------------------------------------------------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, l in layers[0]:
+                self.add_sublayer(str(name), l)
+        else:
+            for i, l in enumerate(layers):
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx if idx >= 0 else len(self) + idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for name, l in (sublayers or {}).items():
+            self.add_sublayer(name, l)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)].value
+
+    def __len__(self):
+        return len(self._parameters)

@@ -1,0 +1,122 @@
+"""Convolution and pooling layers (ref: python/paddle/nn/layer/conv.py, pooling.py)."""
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", dtype=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size, kernel_size)
+        fan_in = in_channels // groups * k[0] * k[1]
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else \
+            init.KaimingUniform(fan_in=fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k[0], k[1]), dtype=dtype,
+            default_initializer=w_init)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), dtype=dtype, default_initializer=init.Constant(0.0),
+                is_bias=True)
+        else:
+            self.bias = None
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        b = self.bias if "bias" in self._parameters else None
+        return F.conv2d(x, self.weight, b, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv1D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None, dtype=None):
+        super().__init__()
+        fan_in = in_channels // groups * kernel_size
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kernel_size), dtype=dtype,
+            default_initializer=init.KaimingUniform(fan_in=fan_in))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), dtype=dtype, default_initializer=init.Constant(0.0),
+                is_bias=True)
+        else:
+            self.bias = None
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+
+    def forward(self, x):
+        b = self.bias if "bias" in self._parameters else None
+        return F.conv1d(x, self.weight, b, self.stride, self.padding,
+                        self.dilation, self.groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, bias_attr=None,
+                 data_format="NCHW", dtype=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size, kernel_size)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels, k[0], k[1]), dtype=dtype,
+            default_initializer=init.KaimingUniform(fan_in=in_channels * k[0] * k[1]))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), dtype=dtype, default_initializer=init.Constant(0.0),
+                is_bias=True)
+        else:
+            self.bias = None
+        self.stride, self.padding, self.output_padding = stride, padding, output_padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        b = self.bias if "bias" in self._parameters else None
+        return F.conv2d_transpose(x, self.weight, b, self.stride, self.padding,
+                                  self.output_padding, self.data_format)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor, self.mode = size, scale_factor, mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.scale_factor, self.size, self.mode,
+                             self.data_format)

@@ -1,0 +1,103 @@
+"""Normalization layers: LayerNorm, RMSNorm, BatchNorm2D, GroupNorm.
+
+Ref: python/paddle/nn/layer/norm.py. RMSNorm routes through the Pallas kernel
+(paddle_tpu/ops/rms_norm.py ≈ the reference's phi rms_norm fusion kernel,
+paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None, dtype=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, dtype=dtype,
+                default_initializer=init.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                self.normalized_shape, dtype=dtype,
+                default_initializer=init.Constant(0.0), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        w = self.weight if "weight" in self._parameters else None
+        b = self.bias if "bias" in self._parameters else None
+        return F.layer_norm(x, self.normalized_shape, w, b, self.epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, dtype=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (hidden_size,), dtype=dtype, default_initializer=init.Constant(1.0))
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=init.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), default_initializer=init.Constant(0.0), is_bias=True)
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        y, new_rm, new_rv = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if self.training:
+            self._buffers["_mean"] = new_rm
+            self._buffers["_variance"] = new_rv
+        return y
+
+
+BatchNorm = BatchNorm2D
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                (num_channels,), default_initializer=init.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_channels,), default_initializer=init.Constant(0.0), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        w = self.weight if "weight" in self._parameters else None
+        b = self.bias if "bias" in self._parameters else None
+        return F.group_norm(x, self.num_groups, w, b, self.epsilon, self.data_format)

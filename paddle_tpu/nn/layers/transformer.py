@@ -1,0 +1,107 @@
+"""Transformer layers (ref: python/paddle/nn/layer/transformer.py).
+
+MultiHeadAttention uses the (batch, seq, heads, head_dim) internal layout and
+dispatches to the flash-attention path in `paddle_tpu.ops` — the TPU stand-in
+for the reference's fused_attention/flash_attn phi kernels.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layers.common import Linear, Dropout, LayerList
+from paddle_tpu.nn.layers.norm import LayerNorm
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
+                 need_weights=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        self.need_weights = need_weights
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, bias_attr=bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = query if value is None else value
+        b, sq = query.shape[0], query.shape[1]
+        sk = key.shape[1]
+        q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
+        k = self.k_proj(key).reshape(b, sk, self.num_heads, self.head_dim)
+        v = self.v_proj(value).reshape(b, sk, self.num_heads, self.head_dim)
+        if cache is not None:
+            k = jnp.concatenate([cache[0], k], axis=1)
+            v = jnp.concatenate([cache[1], v], axis=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            training=self.training)
+        out = out.reshape(b, sq, self.embed_dim)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+        self.normalize_before = normalize_before
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = residual + self.dropout1(self.self_attn(src, attn_mask=src_mask))
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout_act(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        if isinstance(encoder_layer, Layer):
+            # reference semantics: independent per-depth parameter copies
+            layers = [encoder_layer] + [copy.deepcopy(encoder_layer)
+                                        for _ in range(num_layers - 1)]
+        else:  # factory callable
+            layers = [encoder_layer() for _ in range(num_layers)]
+        self.layers = LayerList(layers)
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None and "norm" in self._sub_layers:
+            out = self.norm(out)
+        return out

@@ -1,0 +1,66 @@
+"""Loss layers (ref: python/paddle/nn/layer/loss.py)."""
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, reduction="mean", soft_label=False, ignore_index=-100,
+                 label_smoothing=0.0, axis=-1):
+        super().__init__()
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.ignore_index = ignore_index
+        self.label_smoothing = label_smoothing
+        self.axis = axis
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction=self.reduction,
+                               soft_label=self.soft_label,
+                               ignore_index=self.ignore_index,
+                               label_smoothing=self.label_smoothing, axis=self.axis)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.reduction)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self.reduction)

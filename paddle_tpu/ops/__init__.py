@@ -1,0 +1,32 @@
+"""Fusion ops — the TPU stand-ins for the reference's phi fusion kernels.
+
+Reference (SURVEY.md §2.2): paddle/phi/kernels/fusion/gpu/
+{fused_multi_transformer_op.cu, fused_rope_kernel.cu, rms_norm_kernel.cu},
+phi/kernels/gpu/flash_attn_kernel.cu. Here each op has (a) an XLA path —
+a jnp composition XLA fuses well — and (b) a Pallas TPU kernel for the cases
+where hand-tiling beats the compiler (long-seq attention). Dispatch is
+centralized in `use_pallas()`.
+"""
+
+import jax
+
+from paddle_tpu.core.flags import flag
+
+
+def on_tpu() -> bool:
+    try:
+        plat = jax.default_backend()
+    except Exception:
+        return False
+    return plat in ("tpu", "axon")
+
+
+def use_pallas() -> bool:
+    return bool(flag("FLAGS_use_pallas_kernels")) and on_tpu()
+
+
+from paddle_tpu.ops import flash_attention  # noqa: F401,E402
+from paddle_tpu.ops import rms_norm  # noqa: F401,E402
+from paddle_tpu.ops import rope  # noqa: F401,E402
+from paddle_tpu.ops.rope import fused_rotary_position_embedding  # noqa: F401,E402
+from paddle_tpu.ops.flash_attention import flash_attention as flash_attn  # noqa: F401,E402

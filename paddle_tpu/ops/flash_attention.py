@@ -1,0 +1,144 @@
+"""Flash attention — XLA path + Pallas TPU kernel.
+
+Reference: phi flash_attn kernel wrapping the vendored flash-attention-2 CUDA
+library (paddle/phi/kernels/gpu/flash_attn_kernel.cu, cmake/external/
+flashattn.cmake; python veneer paddle.nn.functional.flash_attention).
+
+Layouts follow the reference: q/k/v are (batch, seq, num_heads, head_dim).
+GQA/MQA supported via num_kv_heads < num_heads. The Pallas kernel (blockwise
+online-softmax, fp32 accumulators, causal block skipping) is used on TPU for
+long sequences; the XLA einsum path covers everything else (XLA already fuses
+the softmax chain and runs the matmuls on the MXU).
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k, n_rep):
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
+                   dropout_p=0.0, training=True):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # (b, h, sq, sk) scores in fp32
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal[None, None], scores, NEG_INF)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, NEG_INF)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from paddle_tpu.core import rng as _rng
+        key = _rng.next_rng_key("dropout")
+        keep = 1.0 - dropout_p
+        mask = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0).astype(probs.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+
+
+def flash_attention(q, k, v, dropout=0.0, causal=False, attn_mask=None,
+                    training=True, scale=None):
+    """paddle.nn.functional.flash_attention parity. Returns (out, None)."""
+    out = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout, is_causal=causal,
+        training=training, scale=scale)
+    return out, None
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None):
+    from paddle_tpu.ops import use_pallas
+    # Pallas path: TPU, no dropout, no arbitrary mask, long enough seq to win.
+    if (use_pallas() and dropout_p == 0.0 and attn_mask is None
+            and q.shape[1] == k.shape[1] and q.shape[1] >= 1024
+            and q.shape[1] % 512 == 0 and q.shape[-1] in (64, 128, 256)):
+        try:
+            return _flash_attention_pallas(q, k, v, is_causal, scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
+                          scale=scale, dropout_p=dropout_p, training=training)
+
+
+# ---- Pallas blockwise flash kernel ----------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
+def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = q.shape
+    n_rep = h // k.shape[2]
+    if n_rep != 1:
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    blk_q = min(512, s)
+    blk_k = min(512, s)
+    grid = (b, h, s // blk_q)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(2)
+        qv = q_ref[...].astype(jnp.float32) * sc  # (blk_q, d)
+
+        def body(ki, carry):
+            acc, m_prev, l_prev = carry
+            kv = pl.load(k_ref, (pl.dslice(ki * blk_k, blk_k), slice(None))).astype(jnp.float32)
+            vv = pl.load(v_ref, (pl.dslice(ki * blk_k, blk_k), slice(None))).astype(jnp.float32)
+            s_blk = qv @ kv.T  # (blk_q, blk_k)
+            if is_causal:
+                q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+                k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+            m_cur = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
+            alpha = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s_blk - m_cur[:, None])
+            l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + p @ vv
+            return acc, m_cur, l_cur
+
+        acc0 = jnp.zeros((blk_q, d), jnp.float32)
+        m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((blk_q,), jnp.float32)
+        if is_causal:
+            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k else (qi * blk_q) // blk_k + 1
+        else:
+            n_k = s // blk_k
+        acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, blk_q, None, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
+            pl.BlockSpec((None, s, None, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+            pl.BlockSpec((None, s, None, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, blk_q, None, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+    )(q, k, v)
+    return out

@@ -1,0 +1,76 @@
+"""RMSNorm — XLA path + Pallas TPU kernel.
+
+Reference: phi rms_norm fusion kernel
+(paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu; python veneer
+paddle.incubate.nn.functional.fused_rms_norm). On TPU the XLA fusion of
+square→mean→rsqrt→mul is already near-bandwidth-bound-optimal; the Pallas
+kernel exists to keep the reduction in fp32 while streaming bf16 rows through
+VMEM, and is enabled only on TPU backends.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _rms_norm_ref(x, weight, epsilon):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    return y
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    from paddle_tpu.ops import use_pallas
+    if use_pallas() and x.shape[-1] % 128 == 0 and x.ndim >= 2:
+        try:
+            return _rms_norm_pallas(x, weight, epsilon)
+        except Exception:
+            pass
+    return _rms_norm_ref(x, weight, epsilon)
+
+
+@functools.partial(jax.jit, static_argnames=("epsilon",))
+def _rms_norm_pallas(x, weight, epsilon):
+    from jax.experimental import pallas as pl
+
+    orig_shape = x.shape
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    block_rows = max(1, min(n, 512 * 1024 // (d * x2.dtype.itemsize)))
+    while n % block_rows:
+        block_rows -= 1
+
+    has_w = weight is not None
+
+    def kernel(x_ref, *rest):
+        if has_w:
+            w_ref, o_ref = rest
+        else:
+            (o_ref,) = rest
+        xv = x_ref[...].astype(jnp.float32)
+        var = jnp.mean(jnp.square(xv), axis=-1, keepdims=True)
+        y = xv * lax.rsqrt(var + epsilon)
+        y = y.astype(o_ref.dtype)
+        if has_w:
+            y = y * w_ref[...]
+        o_ref[...] = y
+
+    in_specs = [pl.BlockSpec((block_rows, d), lambda i: (i, 0))]
+    args = [x2]
+    if has_w:
+        in_specs.append(pl.BlockSpec((d,), lambda i: (0,)))
+        args.append(weight)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // block_rows,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+    )(*args)
+    return out.reshape(orig_shape)

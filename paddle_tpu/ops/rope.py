@@ -1,0 +1,69 @@
+"""Rotary position embedding (fused_rope parity).
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu, python veneer
+paddle.incubate.nn.functional.fused_rotary_position_embedding. On TPU the
+sin/cos gather + rotate is fully fused by XLA into surrounding matmuls, so the
+XLA path is the production path; layout is (batch, seq, heads, head_dim) and
+rotation follows the reference's interleaved-halves ("NeoX") convention.
+"""
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=32)
+def _freqs(head_dim: int, base: float):
+    return 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                 position_ids=None):
+    inv_freq = jnp.asarray(_freqs(head_dim, float(base)))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        t = position_ids.astype(jnp.float32)
+    freqs = jnp.einsum("...s,d->...sd", t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """x: (b, s, h, d); cos/sin: (s, d) or (b, s, d)."""
+    while cos.ndim < x.ndim:
+        cos = cos[None] if cos.ndim == 2 and x.ndim == 4 else cos[..., None, :]
+        sin = sin[None] if sin.ndim == 2 and x.ndim == 4 else sin[..., None, :]
+    # after loop: (1, s, 1, d) broadcastable — rebuild explicitly for clarity
+    return (x * cos + _rotate_half(x) * sin).astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    base=10000.0):
+    """Apply RoPE to q/k (v passes through) — reference API parity."""
+    b, s, h, d = q.shape
+    if cos is None or sin is None:
+        cos, sin = rope_cos_sin(s, d, base=base, dtype=jnp.float32,
+                                position_ids=position_ids)
+    if cos.ndim == 2:
+        cos_b = cos[None, :, None, :]
+        sin_b = sin[None, :, None, :]
+    elif cos.ndim == 3:
+        cos_b = cos[:, :, None, :]
+        sin_b = sin[:, :, None, :]
+    else:
+        cos_b, sin_b = cos, sin
+    qf = q.astype(jnp.float32)
+    out_q = (qf * cos_b + _rotate_half(qf) * sin_b).astype(q.dtype)
+    out_k = None
+    if k is not None:
+        kf = k.astype(jnp.float32)
+        out_k = (kf * cos_b + _rotate_half(kf) * sin_b).astype(k.dtype)
+    return out_q, out_k, v

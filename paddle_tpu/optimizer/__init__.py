@@ -1,0 +1,270 @@
+"""Optimizers (ref: python/paddle/optimizer/ — SGD, Momentum, Adam, AdamW, Lamb).
+
+Dual API, TPU-first:
+
+* **Functional** (the production path): ``state = opt.init_state(params)``;
+  ``new_params, new_state = opt.update(grads, state, params, step=...)`` — pure,
+  jit-able, shardable. Optimizer moments inherit parameter shardings by
+  construction (same tree structure), which is what makes ZeRO stage-1/2
+  "free" under GSPMD (SURVEY.md §2.6).
+* **Eager veneer** (dygraph parity): construct with ``parameters=model.parameters()``,
+  then ``opt.apply_gradients(grads_dict)`` / ``opt.step()`` mutate the layer's
+  arrays in place.
+
+Master weights: with ``multi_precision=True`` (the reference's AMP-O2 contract)
+fp32 master copies live in the optimizer state and bf16/fp16 params are re-cast
+from masters each step.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.optimizer.clip import (  # noqa: F401
+    ClipGradBase,
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from paddle_tpu.optimizer.lr import LRScheduler  # noqa: F401
+
+_tree_map = jax.tree_util.tree_map
+
+
+def _to_f32(t):
+    return _tree_map(lambda x: x.astype(jnp.float32), t)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, apply_decay_param_fun=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self.weight_decay = weight_decay if weight_decay is not None else 0.0
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self._step_count = 0
+        self._eager_state = None
+
+    # -- lr ------------------------------------------------------------------
+
+    def lr_value(self, step):
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            return self._lr.value(step)
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def get_lr(self):
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            return self._lr.get_lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    # -- functional API ------------------------------------------------------
+
+    def init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        slots = self._init_slots(params)
+        if self.multi_precision:
+            slots["master"] = _to_f32(params)
+        slots["step"] = jnp.zeros((), jnp.int32)
+        return slots
+
+    def _init_slots(self, params):
+        raise NotImplementedError
+
+    def update(self, grads, state, params, step=None):
+        """Pure update: returns (new_params, new_state)."""
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step_ = state["step"] if step is None else step
+        lr = self.lr_value(step_)
+        work = state.get("master", params)
+        gf = _to_f32(grads)
+        new_work, new_slots = self._apply(gf, work, state, lr, step_)
+        new_state = dict(state)
+        new_state.update(new_slots)
+        new_state["step"] = state["step"] + 1
+        if "master" in state:
+            new_state["master"] = new_work
+        new_params = _tree_map(lambda m, p: m.astype(p.dtype), new_work, params)
+        return new_params, new_state
+
+    def _apply(self, grads, params, state, lr, step):
+        raise NotImplementedError
+
+    def _decay_mask(self, params):
+        if self.apply_decay_param_fun is None:
+            return _tree_map(lambda _: True, params)
+        return {k: bool(self.apply_decay_param_fun(k)) for k in params}
+
+    # -- eager veneer --------------------------------------------------------
+
+    def apply_gradients(self, named_grads: Dict[str, jax.Array], model=None):
+        """Mutate registered Parameters (or `model`'s) in place — dygraph UX."""
+        if model is not None:
+            named_params = {k: p for k, p in model.named_parameters() if p.trainable}
+        else:
+            if self._parameters is None:
+                raise ValueError("pass parameters= at construction or model= here")
+            named_params = {p.name or str(i): p
+                            for i, p in enumerate(self._parameters) if p.trainable}
+        values = {k: p.value for k, p in named_params.items()}
+        grads = {k: named_grads[k] for k in values}
+        if self._eager_state is None:
+            self._eager_state = self.init_state(values)
+        new_values, self._eager_state = self.update(grads, self._eager_state, values)
+        for k, p in named_params.items():
+            p.value = new_values[k]
+        self._step_count += 1
+
+    def step(self):
+        raise RuntimeError(
+            "paddle_tpu has no implicit autograd tape: compute grads with "
+            "jax.grad over nn.functional_call (or paddle_tpu.grad) and call "
+            "opt.apply_gradients(grads, model=...), or use the functional "
+            "opt.update inside a jitted train step.")
+
+    def clear_grad(self):
+        pass
+
+    def state_dict(self):
+        sd = {"eager_state": self._eager_state, "step_count": self._step_count}
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            sd["lr"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        self._eager_state = sd.get("eager_state")
+        self._step_count = sd.get("step_count", 0)
+        if "lr" in sd and isinstance(self._lr, lr_mod.LRScheduler):
+            self._lr.set_state_dict(sd["lr"])
+
+
+class SGD(Optimizer):
+    def _init_slots(self, params):
+        return {}
+
+    def _apply(self, grads, params, state, lr, step):
+        if self.weight_decay:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        new = _tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=0.0, grad_clip=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slots(self, params):
+        return {"velocity": _tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+
+    def _apply(self, grads, params, state, lr, step):
+        if self.weight_decay:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        vel = _tree_map(lambda v, g: self.momentum * v + g, state["velocity"], grads)
+        if self.use_nesterov:
+            new = _tree_map(lambda p, v, g: p - lr * (g + self.momentum * v),
+                            params, vel, grads)
+        else:
+            new = _tree_map(lambda p, v: p - lr * v, params, vel)
+        return new, {"velocity": vel}
+
+
+class Adam(Optimizer):
+    _decoupled_wd = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, lazy_mode=False,
+                 apply_decay_param_fun=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, apply_decay_param_fun)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"moment1": _tree_map(z, params), "moment2": _tree_map(z, params)}
+
+    def _apply(self, grads, params, state, lr, step):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = (step + 1).astype(jnp.float32) if hasattr(step, "astype") else float(step + 1)
+        bias1 = 1.0 - b1 ** t
+        bias2 = 1.0 - b2 ** t
+        wd = self.weight_decay
+
+        if not self._decoupled_wd and wd:
+            grads = _tree_map(lambda g, p: g + wd * p, grads, params)
+
+        m1 = _tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["moment1"], grads)
+        m2 = _tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                       state["moment2"], grads)
+
+        decay_mask = self._decay_mask(params)
+
+        def upd(p, m, v, do_decay):
+            mhat = m / bias1
+            vhat = v / bias2
+            delta = mhat / (jnp.sqrt(vhat) + eps)
+            if self._decoupled_wd and wd and do_decay:
+                delta = delta + wd * p
+            return p - lr * delta
+
+        new = {k: upd(params[k], m1[k], m2[k], decay_mask[k]) for k in params}
+        return new, {"moment1": m1, "moment2": m2}
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, multi_precision=True,
+                 apply_decay_param_fun=None, lr_ratio=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision,
+                         apply_decay_param_fun=apply_decay_param_fun)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         multi_precision)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"moment1": _tree_map(z, params), "moment2": _tree_map(z, params)}
+
+    def _apply(self, grads, params, state, lr, step):
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
+        t = (step + 1).astype(jnp.float32) if hasattr(step, "astype") else float(step + 1)
+        bias1 = 1.0 - b1 ** t
+        bias2 = 1.0 - b2 ** t
+        m1 = _tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["moment1"], grads)
+        m2 = _tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                       state["moment2"], grads)
+
+        def upd(p, m, v):
+            r = m / bias1 / (jnp.sqrt(v / bias2) + eps) + wd * p
+            w_norm = jnp.linalg.norm(p)
+            r_norm = jnp.linalg.norm(r)
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            return p - lr * trust * r
+
+        new = _tree_map(upd, params, m1, m2)
+        return new, {"moment1": m1, "moment2": m2}
+
+
+from paddle_tpu.optimizer import lr  # noqa: F401,E402

@@ -1,0 +1,55 @@
+"""Gradient clipping (ref: python/paddle/nn/clip.py — ClipGradByGlobalNorm etc.).
+
+Clips are pure pytree→pytree transforms usable inside jit. Global-norm clip is
+the one Fleet wires through hybrid parallelism (HybridParallelOptimizer fuses
+the norm allreduce across mesh axes); here the grads live on the mesh, so the
+norm reduction is a single XLA reduction and GSPMD inserts the collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+    def global_norm(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in leaves))
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)

@@ -1,0 +1,144 @@
+"""LR schedulers (ref: python/paddle/optimizer/lr.py).
+
+Dual API: stateful (`.step()`, `.get_lr()` — dygraph parity) and pure
+(`.value(step)` — a jnp function of the step counter, used inside jitted train
+steps so the schedule compiles into the update).
+"""
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.step()
+
+    def value(self, step):
+        """Pure schedule: step (int or traced scalar) → lr."""
+        raise NotImplementedError
+
+    def get_lr(self):
+        return float(self.value(jnp.asarray(max(self.last_epoch, 0))))
+
+    def step(self, epoch=None):
+        self.last_epoch = self.last_epoch + 1 if epoch is None else epoch
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class ConstantLR(LRScheduler):
+    def value(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1,
+                 verbose=False):
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        s = jnp.maximum(step.astype(jnp.float32) if hasattr(step, "astype")
+                        else jnp.asarray(float(step)), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * self.warmup_steps ** -1.5)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        return self.base_lr * jnp.power(self.gamma, step)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size, self.gamma = step_size, gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        return self.base_lr * jnp.power(self.gamma, step // self.step_size)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones, self.gamma = list(milestones), gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        k = sum((jnp.asarray(step) >= m).astype(jnp.int32) for m in self.milestones)
+        return self.base_lr * jnp.power(self.gamma, k)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps, self.end_lr, self.power = decay_steps, end_lr, power
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        s = jnp.minimum(jnp.asarray(step, jnp.float32), self.decay_steps)
+        frac = (1.0 - s / self.decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0.0, last_epoch=-1,
+                 verbose=False):
+        self.T_max, self.eta_min = T_max, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1.0 + jnp.cos(math.pi * jnp.minimum(s, self.T_max) / self.T_max))
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr=0.0, end_lr=None,
+                 last_epoch=-1, verbose=False):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        peak = learning_rate.base_lr if self.inner else learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr if end_lr is not None else peak
+        super().__init__(peak, last_epoch, verbose)
+
+    def value(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            s, self.warmup_steps) / max(self.warmup_steps, 1)
+        if self.inner is not None:
+            after = self.inner.value(jnp.maximum(s - self.warmup_steps, 0))
+        else:
+            after = jnp.asarray(self.end_lr, jnp.float32)
+        return jnp.where(s < self.warmup_steps, warm, after)
+
+
+class WarmupCosine(LRScheduler):
+    """Linear warmup → cosine decay to `min_ratio`*peak — the LLM pretrain staple."""
+
+    def __init__(self, learning_rate, warmup_steps, total_steps, min_ratio=0.1,
+                 last_epoch=-1, verbose=False):
+        self.warmup_steps, self.total_steps, self.min_ratio = warmup_steps, total_steps, min_ratio
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.base_lr * jnp.minimum(s, self.warmup_steps) / max(self.warmup_steps, 1)
+        prog = jnp.clip((s - self.warmup_steps) /
+                        max(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.base_lr * (self.min_ratio + (1 - self.min_ratio) * 0.5 *
+                              (1.0 + jnp.cos(math.pi * prog)))
+        return jnp.where(s < self.warmup_steps, warm, cos)

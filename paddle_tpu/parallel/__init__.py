@@ -1,0 +1,49 @@
+"""Distributed/parallelism package — exposed as both `paddle_tpu.parallel` and
+`paddle_tpu.distributed` (reference namespace).
+
+SURVEY.md §2.5/§2.6: replaces ProcessGroupNCCL + Fleet with a named JAX mesh
+over ICI/DCN, GSPMD shardings, and explicit collective veneers.
+"""
+
+from paddle_tpu.parallel.env import (  # noqa: F401
+    init_parallel_env,
+    get_rank,
+    get_world_size,
+    is_initialized,
+    ParallelEnv,
+)
+from paddle_tpu.parallel.collective import (  # noqa: F401
+    all_reduce,
+    all_gather,
+    reduce,
+    broadcast,
+    scatter,
+    reduce_scatter,
+    alltoall,
+    all_to_all,
+    send,
+    recv,
+    barrier,
+    new_group,
+    ReduceOp,
+)
+from paddle_tpu.parallel.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    build_mesh,
+)
+from paddle_tpu.parallel.strategy import DistributedStrategy  # noqa: F401
+from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401
+from paddle_tpu.parallel import fleet  # noqa: F401
+from paddle_tpu.parallel import env  # noqa: F401
+from paddle_tpu.parallel import sharding  # noqa: F401
+from paddle_tpu.parallel import auto_parallel as auto  # noqa: F401
+from paddle_tpu.parallel.auto_parallel import (  # noqa: F401
+    ProcessMesh,
+    shard_tensor,
+    Shard,
+    Replicate,
+    Partial,
+)
+from paddle_tpu.parallel.launch import spawn  # noqa: F401

@@ -1,0 +1,159 @@
+"""Semi-auto parallel API (≈ paddle.distributed.auto_parallel).
+
+Reference (SURVEY.md §3.5): `ProcessMesh` + per-tensor `dist_attr`
+(dims_mapping); static pipeline Completer→Partitioner→Resharder; 2.6 dynamic
+`shard_tensor(x, mesh, [Shard(0), Replicate()])` with C++ DistTensor + SPMD
+rules (paddle/phi/infermeta/spmd_rules/).
+
+This maps 1:1 onto GSPMD: placements ≈ PartitionSpec, the Completer ≈ XLA
+sharding propagation, the Resharder ≈ XLA resharding. The build therefore
+provides the API veneer; jit does the machinery.
+"""
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.layer import Layer, Parameter
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD resolves partials automatically at
+    use sites; kept for dist_attr parity."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-D logical process mesh with named dims (reference parity object).
+
+    Wraps a jax Mesh; `dim_names` become mesh axis names.
+    """
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray, Mesh],
+                 dim_names: Optional[List[str]] = None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self.shape = list(mesh.devices.shape)
+            self.dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = np.asarray(jax.devices())
+        flat = arr.reshape(-1)
+        sel = devs[flat]
+        self._jax_mesh = Mesh(sel.reshape(arr.shape), axis_names=tuple(self.dim_names))
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._jax_mesh.devices.reshape(-1)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_pspec(placements: Sequence[Placement], mesh: ProcessMesh,
+                         ndim: int) -> P:
+    """[Shard(0), Replicate()] over mesh dims → PartitionSpec over tensor dims.
+
+    The i-th placement describes how the i-th MESH dim acts on the tensor
+    (reference semantics): Shard(d) shards tensor dim d over mesh dim i.
+    """
+    spec = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis = mesh.dim_names[mesh_dim]
+            cur = spec[pl.dim]
+            if cur is None:
+                spec[pl.dim] = axis
+            elif isinstance(cur, tuple):
+                spec[pl.dim] = cur + (axis,)
+            else:
+                spec[pl.dim] = (cur, axis)
+    return P(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Place `x` (array or Parameter) on `mesh` per `placements` — the dynamic
+    DistTensor API. Returns the resharded array (or mutates the Parameter)."""
+    if isinstance(x, Parameter):
+        spec = _placements_to_pspec(placements, mesh, x.value.ndim)
+        x.pspec = spec
+        x.value = jax.device_put(x.value, NamedSharding(mesh.mesh, spec))
+        x.is_distributed = True
+        return x
+    spec = _placements_to_pspec(placements, mesh, x.ndim)
+    return jax.device_put(x, NamedSharding(mesh.mesh, spec))
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements):
+    """Explicit resharding (≈ the Resharder's r_to_s/s_to_r/p_to_r rules —
+    all subsumed by device_put with a new sharding)."""
+    spec = _placements_to_pspec(placements, mesh, x.ndim)
+    return jax.device_put(x, NamedSharding(mesh.mesh, spec))
+
+
+def shard_layer(layer: Layer, mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply `shard_fn(name, sublayer, mesh)` over the layer tree
+    (reference: paddle.distributed.shard_layer)."""
+    if shard_fn is None:
+        def shard_fn(name, sub, mesh_):
+            for pname, p in sub._parameters.items():
+                shard_tensor(p, mesh_, [Replicate()] * len(mesh_.shape))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, mesh)
+    return layer
+
+
+def get_placements(x, mesh: ProcessMesh):
+    """Inverse mapping for checkpoint metadata: array sharding → placements."""
+    if not isinstance(x, jax.Array) or not isinstance(x.sharding, NamedSharding):
+        return [Replicate()] * len(mesh.shape)
+    spec = x.sharding.spec
+    placements: List[Placement] = [Replicate()] * len(mesh.shape)
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[mesh.dim_names.index(ax)] = Shard(tdim)
+    return placements

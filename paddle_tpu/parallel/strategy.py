@@ -1,0 +1,90 @@
+"""DistributedStrategy — the single config object for all parallelism.
+
+Reference: paddle/fluid/framework/distributed_strategy.proto +
+python/paddle/distributed/fleet/base/distributed_strategy.py. Kept as the
+"one strategy object configures everything" UX (SURVEY.md §5-config), but as a
+plain dataclass tree instead of protobuf.
+"""
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = -1          # -1: infer from device count
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    ep_degree: int = 1           # expert parallel (carved out of dp)
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class AmpConfig:
+    enable: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O2"
+    init_loss_scaling: float = 2.0 ** 15
+    use_dynamic_loss_scaling: bool = True
+
+
+@dataclass
+class ShardingConfig:
+    stage: int = 1               # ZeRO stage 1/2/3
+    offload: bool = False
+
+
+@dataclass
+class RecomputeConfig:
+    enable: bool = False
+    # names of remat policies: 'full', 'dots_saveable', 'nothing_saveable'
+    policy: str = "full"
+
+
+@dataclass
+class PipelineConfig:
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"   # or 'gpipe'
+
+
+@dataclass
+class MoEConfig:
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    gate: str = "gshard"          # 'gshard' (top2) | 'switch' (top1)
+
+
+@dataclass
+class DistributedStrategy:
+    hybrid_configs_: HybridConfig = field(default_factory=HybridConfig)
+    amp: bool = False
+    amp_configs: AmpConfig = field(default_factory=AmpConfig)
+    sharding: bool = False
+    sharding_configs: ShardingConfig = field(default_factory=ShardingConfig)
+    recompute: bool = False
+    recompute_configs: RecomputeConfig = field(default_factory=RecomputeConfig)
+    pipeline: bool = False
+    pipeline_configs: PipelineConfig = field(default_factory=PipelineConfig)
+    moe_configs: MoEConfig = field(default_factory=MoEConfig)
+    gradient_merge: bool = False
+    gradient_merge_configs: Dict[str, Any] = field(default_factory=lambda: {"k_steps": 1})
+    find_unused_parameters: bool = False
+
+    # reference exposes hybrid_configs as a dict property users assign to
+    @property
+    def hybrid_configs(self) -> Dict[str, int]:
+        return self.hybrid_configs_.as_dict()
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg: Dict[str, int]):
+        for k, v in cfg.items():
+            if hasattr(self.hybrid_configs_, k):
+                setattr(self.hybrid_configs_, k, v)
+            else:
+                raise KeyError(f"unknown hybrid config {k!r}")

@@ -1,0 +1,149 @@
+"""Profiler veneer (≈ paddle.profiler) + training observability.
+
+Reference (SURVEY.md §5): Profiler with scheduler windows, RecordEvent ranges,
+chrome-trace export (python/paddle/profiler/, CUPTI CudaTracer). TPU-native:
+jax.profiler emits XPlane traces viewable in TensorBoard/Perfetto;
+RecordEvent maps to jax.profiler ranges. MFU/tokens-per-sec metrics are
+first-class (BASELINE.md north star) via `StepTimer`/`MetricsLogger`.
+"""
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir="./profiler_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._active = False
+        self._step = 0
+        self.scheduler = scheduler  # (start_batch, end_batch) window
+
+    def start(self):
+        if not self.timer_only:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def step(self):
+        self._step += 1
+        if self.scheduler and not self.timer_only:
+            start, end = self.scheduler
+            if self._step == start and not self._active:
+                self.start()
+            elif self._step == end and self._active:
+                self.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return f"profiler traces in {self.log_dir} (TensorBoard/Perfetto xplane)"
+
+
+@contextlib.contextmanager
+def RecordEvent(name: str, event_type=None):
+    """User range (reference RecordEvent) → jax named trace annotation."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def export_chrome_tracing(dir_name: str):
+    def handler(prof):
+        pass
+    return handler
+
+
+# ---- MFU / throughput metrics ---------------------------------------------
+
+# bf16 peak FLOPs/chip for known TPU generations (approx, dense)
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak_flops(default=197e12):
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    for k, v in TPU_PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return default
+
+
+class StepTimer:
+    """Per-step wall timing with warmup discard; reports tokens/s/chip + MFU."""
+
+    def __init__(self, model_flops_per_token: Optional[float] = None,
+                 warmup: int = 2):
+        self.times = []
+        self.warmup = warmup
+        self.flops_per_token = model_flops_per_token
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+
+    def mean_step_time(self):
+        xs = self.times[self.warmup:] or self.times
+        return sum(xs) / max(len(xs), 1)
+
+    def tokens_per_sec(self, tokens_per_step, n_chips=1):
+        return tokens_per_step / self.mean_step_time() / n_chips
+
+    def mfu(self, tokens_per_step, n_chips=1, peak=None):
+        if self.flops_per_token is None:
+            return None
+        peak = peak or detect_peak_flops()
+        achieved = self.flops_per_token * tokens_per_step / self.mean_step_time()
+        return achieved / (peak * n_chips)
+
+
+class MetricsLogger:
+    """Structured JSONL metrics (SURVEY.md §5-metrics: step time, tokens/s/chip,
+    MFU as first-class outputs)."""
+
+    def __init__(self, path="metrics.jsonl"):
+        self.path = path
+
+    def log(self, **metrics):
+        metrics.setdefault("ts", time.time())
+        with open(self.path, "a") as f:
+            f.write(json.dumps(metrics) + "\n")
+
+
+def model_flops_per_token(n_params: int) -> float:
+    """Transformer ≈ 6 * N flops/token for fwd+bwd (standard estimate)."""
+    return 6.0 * n_params

@@ -1,0 +1,499 @@
+"""Tensor API veneer — paddle-style creation/math/manipulation ops over jnp.
+
+The reference binds ~400 tensor methods through pybind `_C_ops` to phi kernels
+(ref: python/paddle/tensor/{creation,math,manipulation,linalg}.py). On TPU every
+op is a jnp call that XLA fuses; this module provides the paddle-shaped names
+(axis= keyword, paddle argument orders) so reference users find what they expect.
+
+Tensors ARE jax.Arrays — no wrapper class. `Tensor` is an alias usable in
+isinstance checks and annotations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import to_jax_dtype, get_default_dtype
+from paddle_tpu.core import rng as _rng
+
+Tensor = jax.Array
+
+
+# ---- creation --------------------------------------------------------------
+
+def to_tensor(data, dtype=None, stop_gradient=True):
+    if dtype is not None:
+        return jnp.asarray(data, dtype=to_jax_dtype(dtype))
+    arr = np.asarray(data) if not isinstance(data, (jax.Array, np.ndarray)) else data
+    if isinstance(arr, np.ndarray) and arr.dtype == np.float64:
+        arr = arr.astype(np.float32)  # paddle defaults float data to fp32
+    return jnp.asarray(arr)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=to_jax_dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=to_jax_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, dtype=to_jax_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype))
+
+
+def rand(shape, dtype=None):
+    return jax.random.uniform(_rng.next_rng_key(), shape,
+                              dtype=to_jax_dtype(dtype) if dtype else get_default_dtype())
+
+
+def randn(shape, dtype=None):
+    return jax.random.normal(_rng.next_rng_key(), tuple(shape),
+                             dtype=to_jax_dtype(dtype) if dtype else get_default_dtype())
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_rng.next_rng_key(), tuple(shape), low, high,
+                              dtype=to_jax_dtype(dtype))
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(_rng.next_rng_key(), n).astype(to_jax_dtype(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return mean + std * jax.random.normal(_rng.next_rng_key(), tuple(shape),
+                                          dtype=get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(_rng.next_rng_key(), tuple(shape),
+                              dtype=to_jax_dtype(dtype) if dtype else get_default_dtype(),
+                              minval=min, maxval=max)
+
+
+# ---- manipulation ----------------------------------------------------------
+
+def concat(x, axis=0):
+    return jnp.concatenate(x, axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    # paddle semantics: list of section sizes, -1 means remainder
+    sizes = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        i = sizes.index(-1)
+        sizes[i] = total - (sum(sizes) + 1)
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.split(x, chunks, axis=axis)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    if stop_axis < 0:
+        stop_axis += ndim
+    if start_axis < 0:
+        start_axis += ndim
+    new_shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def cast(x, dtype):
+    return x.astype(to_jax_dtype(dtype))
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.where(condition)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return nz
+    return jnp.stack(nz, axis=1)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    return jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                      return_counts=return_counts)
+
+
+# ---- math ------------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+# ---- reductions ------------------------------------------------------------
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=to_jax_dtype(dtype) if dtype else None,
+                   keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(to_jax_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(to_jax_dtype(dtype))
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+# ---- comparisons -----------------------------------------------------------
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# ---- sort / search ---------------------------------------------------------
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if not largest:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+# ---- meta ------------------------------------------------------------------
+
+def numel(x):
+    return int(np.prod(x.shape)) if x.shape else 1
+
+
+def shape(x):
+    return list(x.shape)

@@ -1,0 +1,3 @@
+from paddle_tpu.utils.nan_inf import check_numerics, nan_inf_guard  # noqa: F401
+from paddle_tpu.utils import recompute  # noqa: F401
+from paddle_tpu.utils.recompute import recompute as recompute_fn  # noqa: F401

@@ -1,0 +1,48 @@
+"""NaN/Inf watcher (≈ FLAGS_check_nan_inf walking op outputs —
+paddle/fluid/framework/details/nan_inf_utils_detail.cc).
+
+TPU-native: per-op scanning would break fusion; instead scan the step's
+OUTPUT pytrees (loss/grads/params) — one fused reduction per tensor — plus
+jax's debug_nans for eager pinpointing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import flag
+
+
+def tree_nonfinite_count(tree):
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves)
+
+
+def check_numerics(tree, name="tensors", raise_error=True):
+    """Host-side check (call on step outputs when FLAGS_check_nan_inf)."""
+    if not flag("FLAGS_check_nan_inf"):
+        return True
+    n = int(tree_nonfinite_count(tree))
+    if n:
+        msg = f"[paddle_tpu] {n} non-finite values detected in {name}"
+        if raise_error:
+            raise FloatingPointError(msg)
+        print(msg)
+        return False
+    return True
+
+
+def nan_inf_guard(step_fn):
+    """Wrap a train step: after each call, scan loss/grads when the flag is on."""
+    def wrapped(*args, **kwargs):
+        out = step_fn(*args, **kwargs)
+        if flag("FLAGS_check_nan_inf"):
+            check_numerics(out, name="train step outputs")
+        return out
+    return wrapped
+
+
+def enable_debug_nans(enable=True):
+    jax.config.update("jax_debug_nans", enable)

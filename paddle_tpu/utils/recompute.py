@@ -1,0 +1,45 @@
+"""Activation recompute (≈ paddle.distributed.fleet.utils.recompute —
+PyLayer segment replay with RNG state restore, fleet/recompute/recompute.py).
+
+TPU-native: jax.checkpoint IS recompute — XLA rematerializes the segment in
+backward, and functional RNG keys replay identically by construction (no RNG
+state save/restore machinery needed).
+"""
+
+import functools
+
+import jax
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              policy=None, **kwargs):
+    """Checkpoint `function(*args)` — gradients recompute the forward."""
+    ck = jax.checkpoint(function, policy=policy)
+    return ck(*args, **kwargs)
+
+
+def recompute_sequential(functions, x, segments=1):
+    """Checkpoint a sequence in `segments` chunks (recompute_sequential parity)."""
+    funcs = list(functions)
+    n = len(funcs)
+    seg_size = max(1, n // max(segments, 1))
+
+    def run_segment(fs):
+        def seg(y):
+            for f in fs:
+                y = f(y)
+            return y
+        return seg
+
+    i = 0
+    while i < n:
+        seg = run_segment(funcs[i:i + seg_size])
+        x = jax.checkpoint(seg)(x)
+        i += seg_size
+    return x
+
+
+def recompute_wrapper(policy=None):
+    def deco(fn):
+        return functools.wraps(fn)(jax.checkpoint(fn, policy=policy))
+    return deco

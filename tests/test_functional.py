@@ -1,0 +1,215 @@
+"""Numeric tests of nn.functional ops vs NumPy references (SURVEY.md §4 OpTest
+pattern: run op against a NumPy reference, check_output)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+rs = np.random.RandomState(0)
+
+
+def test_linear_matches_numpy():
+    x = rs.randn(4, 8).astype(np.float32)
+    w = rs.randn(8, 3).astype(np.float32)
+    b = rs.randn(3).astype(np.float32)
+    out = F.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5)
+
+
+def test_softmax_cross_entropy_matches_numpy():
+    logits = rs.randn(6, 10).astype(np.float32)
+    labels = rs.randint(0, 10, (6,))
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels]).mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = rs.randn(4, 5).astype(np.float32)
+    labels = np.array([1, 2, -100, 3])
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                          ignore_index=-100)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    valid = labels != -100
+    ref = -np.log(p[np.arange(4), np.where(valid, labels, 0)])[valid].mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+
+def test_layer_norm_matches_numpy():
+    x = rs.randn(2, 3, 8).astype(np.float32)
+    w = rs.randn(8).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    out = F.layer_norm(jnp.asarray(x), (8,), jnp.asarray(w), jnp.asarray(b))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_matches_numpy():
+    x = rs.randn(2, 4, 16).astype(np.float32)
+    w = rs.randn(16).astype(np.float32)
+    out = F.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-6)
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_matches_scipy_style():
+    x = rs.randn(1, 2, 5, 5).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    out = F.conv2d(jnp.asarray(x), jnp.asarray(w), padding=1)
+    assert out.shape == (1, 3, 5, 5)
+    # check center element against direct computation
+    patch = x[0, :, 1:4, 1:4]
+    ref = (patch * w[0]).sum()
+    np.testing.assert_allclose(float(out[0, 0, 2, 2]), ref, rtol=1e-4)
+
+
+def test_pools():
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    mx = F.max_pool2d(x, 2, 2)
+    av = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_array_equal(np.asarray(mx)[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(np.asarray(av)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_attention_matches_reference():
+    b, s, h, d = 2, 16, 4, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # numpy reference
+    qn, kn, vn = map(np.asarray, (q, k, v))
+    scores = np.einsum("bqhd,bkhd->bhqk", qn, kn) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask[None, None], scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, vn)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_gqa():
+    b, s, hq, hkv, d = 1, 8, 8, 2, 16
+    q = jnp.asarray(rs.randn(b, s, hq, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert out.shape == (b, s, hq, d)
+
+
+def test_rope():
+    from paddle_tpu.ops.rope import fused_rotary_position_embedding, rope_cos_sin
+    b, s, h, d = 2, 8, 2, 16
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    q2, k2, _ = fused_rotary_position_embedding(q, k)
+    assert q2.shape == q.shape and k2.shape == k.shape
+    # position 0 is unrotated
+    np.testing.assert_allclose(np.asarray(q2[:, 0]), np.asarray(q[:, 0]),
+                               rtol=1e-5)
+    # norms preserved (rotation)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q2), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-4)
+
+
+def test_dropout_scaling():
+    x = jnp.ones((1000,))
+    paddle.seed(3)
+    y = F.dropout(x, 0.5, training=True)
+    kept = float((np.asarray(y) > 0).mean())
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(np.asarray(y)[np.asarray(y) > 0], 2.0)
+    # eval mode: identity
+    np.testing.assert_array_equal(np.asarray(F.dropout(x, 0.5, training=False)),
+                                  np.asarray(x))
+
+
+def test_activations():
+    x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(np.asarray(F.relu(x)), [0, 0, 0, 0.5, 2.0])
+    np.testing.assert_allclose(np.asarray(F.silu(x)),
+                               np.asarray(x) / (1 + np.exp(-np.asarray(x))),
+                               rtol=1e-5)
+
+
+def test_interpolate_nearest():
+    x = jnp.arange(4.0).reshape(1, 1, 2, 2)
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == (1, 1, 4, 4)
+    np.testing.assert_array_equal(np.asarray(y[0, 0, :2, :2]),
+                                  [[0, 0], [0, 0]])
+    np.testing.assert_array_equal(np.asarray(y[0, 0, 2:, 2:]),
+                                  [[3, 3], [3, 3]])
+
+
+def test_conv1d_padding_regression():
+    # regression: padding once leaked onto the lifted width axis
+    x = jnp.ones((1, 1, 5))
+    w = jnp.ones((1, 1, 3))
+    y = F.conv1d(x, w, padding=1)
+    assert y.shape == (1, 1, 5)
+    np.testing.assert_allclose(np.asarray(y)[0, 0], [2, 3, 3, 3, 2])
+
+
+def test_conv2d_transpose_output_padding():
+    x = jnp.ones((1, 2, 4, 4))
+    w = jnp.ones((2, 3, 3, 3))
+    y0 = F.conv2d_transpose(x, w, stride=2, padding=1)
+    y1 = F.conv2d_transpose(x, w, stride=2, padding=1, output_padding=1)
+    assert y0.shape == (1, 3, 7, 7)
+    assert y1.shape == (1, 3, 8, 8)
+
+
+def test_dropout_downscale_in_infer():
+    x = jnp.ones((8,))
+    y = F.dropout(x, 0.25, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(np.asarray(y), 0.75)
+
+
+def test_transformer_encoder_independent_layers():
+    from paddle_tpu import nn
+    enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32), 3)
+    names = [n for n, _ in enc.named_parameters()]
+    assert len(names) == len(set(names))
+    l0 = enc.layers[0]
+    l1 = enc.layers[1]
+    assert l0 is not l1
+    l1.linear1._parameters["weight"].value = jnp.zeros_like(l1.linear1.weight)
+    assert float(jnp.abs(l0.linear1.weight).sum()) > 0
+
+
+def test_dataloader_shuffles_each_epoch_and_propagates_errors():
+    import paddle_tpu.io as io
+    ds = io.TensorDataset([np.arange(32)])
+    dl = io.DataLoader(ds, batch_size=32, shuffle=True)
+    e1 = np.concatenate([b[0] for b in dl])
+    e2 = np.concatenate([b[0] for b in dl])
+    assert not np.array_equal(e1, e2)
+
+    class Bad(io.Dataset):
+        def __len__(self):
+            return 4
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("corrupt record")
+            return np.zeros(2)
+
+    dl2 = io.DataLoader(Bad(), batch_size=1, num_workers=2)
+    with pytest.raises(ValueError, match="corrupt record"):
+        list(dl2)
+
+
+def test_initializer_conv_fans():
+    from paddle_tpu.nn.initializer import _fan_in_out
+    assert _fan_in_out((64, 3, 3, 3)) == (27, 576)
+    assert _fan_in_out((8, 16)) == (8, 16)

@@ -1,0 +1,144 @@
+"""Layer system: registration, state_dict, functional bridge, hooks, modes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional_call
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        return self.fc2(self.drop(self.act(self.fc1(x))))
+
+
+def test_parameter_registration():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert m.fc1.weight.shape == (8, 16)
+
+
+def test_state_dict_roundtrip():
+    m = MLP()
+    sd = m.state_dict()
+    m2 = MLP()
+    missing, unexpected = m2.set_state_dict(sd)
+    assert not missing and not unexpected
+    for k in sd:
+        np.testing.assert_array_equal(np.asarray(m2.state_dict()[k]),
+                                      np.asarray(sd[k]))
+
+
+def test_forward_eager():
+    m = MLP().eval()
+    x = paddle.randn((2, 8))
+    y = m(x)
+    assert y.shape == (2, 4)
+
+
+def test_functional_call_pure():
+    m = MLP().eval()
+    x = paddle.randn((2, 8))
+    sd = m.state_dict()
+    y1 = m(x)
+    zeros = {k: jnp.zeros_like(v) for k, v in sd.items()}
+    y0 = functional_call(m, zeros, x)
+    np.testing.assert_array_equal(np.asarray(y0), 0.0)
+    # original params restored after the call
+    y2 = m(x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_functional_grad():
+    m = MLP().eval()
+    x = paddle.randn((4, 8))
+    sd = m.trainable_state()
+
+    def loss_fn(s):
+        return jnp.mean(functional_call(m, s, x) ** 2)
+
+    grads = jax.grad(loss_fn)(sd)
+    assert set(grads) == set(sd)
+    assert all(g.shape == sd[k].shape for k, g in grads.items())
+    assert float(jnp.abs(grads["fc1.weight"]).sum()) > 0
+
+
+def test_jit_functional():
+    m = MLP().eval()
+    sd = m.state_dict()
+    x = paddle.randn((2, 8))
+
+    @jax.jit
+    def f(s, x):
+        return functional_call(m, s, x)
+
+    y = f(sd, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(m(x)), rtol=1e-6)
+
+
+def test_train_eval_modes():
+    m = MLP()
+    assert m.training and m.drop.training
+    m.eval()
+    assert not m.training and not m.drop.training
+
+
+def test_dropout_determinism_with_rngs():
+    m = MLP().train()
+    x = paddle.randn((2, 8))
+    sd = m.state_dict()
+    key = jax.random.PRNGKey(42)
+    y1 = functional_call(m, sd, x, rngs={"dropout": key})
+    y2 = functional_call(m, sd, x, rngs={"dropout": key})
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    y3 = functional_call(m, sd, x, rngs={"dropout": jax.random.PRNGKey(7)})
+    assert not np.array_equal(np.asarray(y1), np.asarray(y3))
+
+
+def test_hooks():
+    m = nn.Linear(4, 4)
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1) or out)
+    m(paddle.randn((1, 4)))
+    assert calls == [1]
+    h.remove()
+    m(paddle.randn((1, 4)))
+    assert calls == [1]
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = s(paddle.randn((3, 4)))
+    assert y.shape == (3, 2)
+    assert len(s) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.named_parameters())) == 6
+
+
+def test_to_dtype():
+    m = MLP()
+    m.bfloat16()
+    assert m.fc1.weight.dtype == jnp.bfloat16
+    m.float()
+    assert m.fc1.weight.dtype == jnp.float32
+
+
+def test_buffers():
+    bn = nn.BatchNorm2D(3)
+    assert "_mean" in dict(bn.named_buffers())
+    x = paddle.randn((2, 3, 4, 4))
+    bn.train()
+    _ = bn(x)
+    # running stats updated
+    assert float(jnp.abs(bn._mean).sum()) > 0

@@ -1,0 +1,147 @@
+"""Optimizer numerics vs NumPy references + scheduler/clip behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+
+
+def _simple_params():
+    return {"w": jnp.asarray([[1.0, 2.0], [3.0, 4.0]]),
+            "b": jnp.asarray([0.5, -0.5])}
+
+
+def _grads():
+    return {"w": jnp.asarray([[0.1, 0.1], [0.1, 0.1]]),
+            "b": jnp.asarray([0.2, 0.2])}
+
+
+def test_sgd_step():
+    p = _simple_params()
+    opt = opt_mod.SGD(learning_rate=0.1, multi_precision=False)
+    st = opt.init_state(p)
+    newp, _ = opt.update(_grads(), st, p)
+    np.testing.assert_allclose(np.asarray(newp["w"]),
+                               np.asarray(p["w"]) - 0.1 * 0.1, rtol=1e-6)
+
+
+def test_momentum_matches_reference():
+    p = _simple_params()
+    opt = opt_mod.Momentum(learning_rate=0.1, momentum=0.9, multi_precision=False)
+    st = opt.init_state(p)
+    g = _grads()
+    p1, st = opt.update(g, st, p)
+    p2, st = opt.update(g, st, p1)
+    # v1 = g; p1 = p - lr*g ; v2 = 0.9g + g; p2 = p1 - lr*1.9g
+    np.testing.assert_allclose(np.asarray(p2["b"]),
+                               np.asarray(p["b"]) - 0.1 * 0.2 - 0.1 * 1.9 * 0.2,
+                               rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    rs = np.random.RandomState(0)
+    w0 = rs.randn(3, 3).astype(np.float32)
+    g0 = rs.randn(3, 3).astype(np.float32)
+    p = {"w": jnp.asarray(w0)}
+    opt = opt_mod.Adam(learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, multi_precision=False)
+    st = opt.init_state(p)
+    newp, _ = opt.update({"w": jnp.asarray(g0)}, st, p)
+    m = 0.1 * g0
+    v = 0.001 * g0 ** 2
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = w0 - 1e-3 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(newp["w"]), ref, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = {"w": jnp.ones((2, 2))}
+    g = {"w": jnp.zeros((2, 2))}
+    opt = opt_mod.AdamW(learning_rate=0.1, weight_decay=0.1,
+                        multi_precision=False)
+    st = opt.init_state(p)
+    newp, _ = opt.update(g, st, p)
+    # zero grad → update is pure decay: w - lr*wd*w
+    np.testing.assert_allclose(np.asarray(newp["w"]), 1.0 - 0.1 * 0.1, rtol=1e-5)
+
+
+def test_adamw_master_weights_bf16():
+    p = {"w": jnp.ones((4,), jnp.bfloat16)}
+    opt = opt_mod.AdamW(learning_rate=1e-4, multi_precision=True)
+    st = opt.init_state(p)
+    assert st["master"]["w"].dtype == jnp.float32
+    g = {"w": jnp.full((4,), 0.001, jnp.bfloat16)}
+    newp, newst = opt.update(g, st, p)
+    assert newp["w"].dtype == jnp.bfloat16
+    assert newst["master"]["w"].dtype == jnp.float32
+    # master moved even though bf16 param may round
+    assert float(jnp.abs(newst["master"]["w"] - 1.0).sum()) > 0
+
+
+def test_global_norm_clip():
+    g = {"a": jnp.asarray([3.0, 4.0])}  # norm 5
+    clip = ClipGradByGlobalNorm(1.0)
+    out = clip(g)
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.6, 0.8], rtol=1e-5)
+    # under the limit: untouched
+    g2 = {"a": jnp.asarray([0.3, 0.4])}
+    np.testing.assert_allclose(np.asarray(clip(g2)["a"]), [0.3, 0.4], rtol=1e-6)
+
+
+def test_optimizer_with_clip_in_update():
+    p = {"w": jnp.zeros((2,))}
+    opt = opt_mod.SGD(learning_rate=1.0, grad_clip=ClipGradByGlobalNorm(1.0),
+                      multi_precision=False)
+    st = opt.init_state(p)
+    newp, _ = opt.update({"w": jnp.asarray([30.0, 40.0])}, st, p)
+    np.testing.assert_allclose(np.asarray(newp["w"]), [-0.6, -0.8], rtol=1e-5)
+
+
+def test_lr_schedulers():
+    sch = lr_mod.WarmupCosine(1.0, warmup_steps=10, total_steps=110, min_ratio=0.1)
+    assert abs(float(sch.value(0))) < 1e-6
+    np.testing.assert_allclose(float(sch.value(5)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(sch.value(10)), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(sch.value(110)), 0.1, rtol=1e-4)
+    step_sch = lr_mod.StepDecay(0.1, step_size=10, gamma=0.1)
+    np.testing.assert_allclose(float(step_sch.value(25)), 0.1 * 0.01, rtol=1e-5)
+
+
+def test_scheduler_in_optimizer():
+    sch = lr_mod.ExponentialDecay(0.1, gamma=0.5)
+    opt = opt_mod.SGD(learning_rate=sch, multi_precision=False)
+    p = {"w": jnp.asarray([1.0])}
+    st = opt.init_state(p)
+    p1, st = opt.update({"w": jnp.asarray([1.0])}, st, p)   # step 0: lr=0.1
+    p2, st = opt.update({"w": jnp.asarray([1.0])}, st, p1)  # step 1: lr=0.05
+    np.testing.assert_allclose(np.asarray(p2["w"]), 1.0 - 0.1 - 0.05, rtol=1e-5)
+
+
+def test_eager_apply_gradients():
+    m = paddle.nn.Linear(2, 2, bias_attr=False)
+    w_before = np.asarray(m.weight)
+    opt = opt_mod.SGD(learning_rate=0.5, parameters=m.parameters(),
+                      multi_precision=False)
+    grads = {"weight": jnp.ones((2, 2))}
+    opt.apply_gradients(grads, model=m)
+    np.testing.assert_allclose(np.asarray(m.weight), w_before - 0.5, rtol=1e-6)
+
+
+def test_jit_update():
+    p = {"w": jnp.ones((8, 8))}
+    opt = opt_mod.AdamW(learning_rate=1e-3)
+    st = opt.init_state(p)
+
+    @jax.jit
+    def step(p, st, g):
+        return opt.update(g, st, p)
+
+    g = {"w": jnp.full((8, 8), 0.1)}
+    p1, st1 = step(p, st, g)
+    p2, _ = step(p1, st1, g)
+    assert float(jnp.abs(p2["w"] - p["w"]).sum()) > 0
