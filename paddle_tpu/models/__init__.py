@@ -7,3 +7,8 @@ GPT-2 (345M single-device), Llama-2 (7B/65B hybrid), Mixtral-style MoE
 """
 
 from paddle_tpu.models.gpt import GPTConfig, GPTModel, GPTPretrainModel  # noqa: F401
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaModel,
+    LlamaForCausalLM,
+)

@@ -1,0 +1,205 @@
+"""Llama family — the hybrid-parallel flagship (BASELINE configs #2).
+
+Capability reference: PaddleNLP's Llama pretrain runs on the reference
+substrate via Fleet hybrid parallel (SURVEY.md §2.7 note, §6 config matrix:
+"Llama-2 7B/65B hybrid mp×pp×sharding-2").
+
+TPU-first choices:
+* attention + MLP built from the tensor-parallel layers (parallel/mp_layers):
+  q/k/v/gate/up are column-parallel, o/down are row-parallel, the embedding is
+  vocab-parallel — on a 1-device mesh they degrade to dense layers, so one
+  implementation serves tests, single-chip and the full mesh.
+* GQA (num_kv_heads < num_heads) with head counts divisible by the mp degree.
+* RoPE via ops.rope (XLA fuses the rotation into the attention matmuls),
+  RMSNorm via ops.rms_norm (Pallas on TPU), attention via
+  F.scaled_dot_product_attention (Pallas flash path on TPU).
+* weights default to the reference's init (normal(0, initializer_range)).
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.ops import rope as rope_ops
+from paddle_tpu.parallel import mp_layers as mp
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None      # None → MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_base: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    # sequence-parallel activations between TP regions (Megatron-SP)
+    sequence_parallel: bool = False
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, vocab_size=256):
+        return cls(vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+                   num_layers=2, num_heads=4, num_kv_heads=2,
+                   max_position_embeddings=128)
+
+    @classmethod
+    def llama2_7b(cls):
+        return cls()
+
+    @classmethod
+    def llama2_13b(cls):
+        return cls(hidden_size=5120, intermediate_size=13824, num_layers=40,
+                   num_heads=40)
+
+    @classmethod
+    def llama_65b(cls):
+        return cls(hidden_size=8192, intermediate_size=22016, num_layers=80,
+                   num_heads=64)
+
+    @classmethod
+    def llama2_70b(cls):
+        return cls(hidden_size=8192, intermediate_size=28672, num_layers=80,
+                   num_heads=64, num_kv_heads=8)
+
+
+def _tp_classes(cfg: LlamaConfig):
+    """Column/row TP layer classes, SP variants when sequence_parallel."""
+    if cfg.sequence_parallel:
+        return mp.ColumnSequenceParallelLinear, mp.RowSequenceParallelLinear
+    return mp.ColumnParallelLinear, mp.RowParallelLinear
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                          cfg.head_dim)
+        w = init.Normal(0.0, cfg.initializer_range)
+        col, row = _tp_classes(cfg)
+        self.q_proj = col(h, nh * hd, weight_attr=w, has_bias=False,
+                          gather_output=False)
+        self.k_proj = col(h, nkv * hd, weight_attr=w, has_bias=False,
+                          gather_output=False)
+        self.v_proj = col(h, nkv * hd, weight_attr=w, has_bias=False,
+                          gather_output=False)
+        self.o_proj = row(nh * hd, h, weight_attr=init.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
+            has_bias=False, input_is_parallel=True)
+        self.cfg = cfg
+
+    def forward(self, x, cos=None, sin=None, attn_mask=None):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        if cos is None or sin is None:
+            cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+        q = rope_ops.apply_rotary_pos_emb(q, cos, sin)
+        k = rope_ops.apply_rotary_pos_emb(k, cos, sin)
+        # always causal; an attn_mask (e.g. padding) composes with it
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=True)
+        return self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, ffn = cfg.hidden_size, cfg.intermediate_size
+        w = init.Normal(0.0, cfg.initializer_range)
+        col, row = _tp_classes(cfg)
+        self.gate_proj = col(h, ffn, weight_attr=w, has_bias=False,
+                             gather_output=False)
+        self.up_proj = col(h, ffn, weight_attr=w, has_bias=False,
+                           gather_output=False)
+        self.down_proj = row(ffn, h, weight_attr=init.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos=None, sin=None, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = mp.VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=init.Normal(0.0, cfg.initializer_range))
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            # vocab-sharded logits stay sharded into the parallel loss —
+            # never materialize a replicated (b, s, vocab) activation
+            self.lm_head = mp.ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size,
+                weight_attr=init.Normal(0.0, cfg.initializer_range),
+                has_bias=False, gather_output=False)
+        self.loss_fn = mp.ParallelCrossEntropy()
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.model(input_ids, attn_mask)
+        if self.cfg.tie_word_embeddings:
+            logits = jnp.matmul(x, self.model.embed_tokens.weight.T)
+            return mp.constrain(logits, mp._last_dim_spec(mp.MP_AXIS))
+        return self.lm_head(x)
+
+    def loss(self, logits, labels):
+        return jnp.mean(self.loss_fn(logits, labels))
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())

@@ -69,7 +69,13 @@ class Optimizer:
     def init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
         slots = self._init_slots(params)
         if self.multi_precision:
-            slots["master"] = _to_f32(params)
+            # master copies only for low-precision params (the reference's
+            # AMP-O2 contract); fp32 params update in place — also keeps
+            # state/master buffers distinct so jit donation never aliases.
+            masters = {k: v.astype(jnp.float32) for k, v in params.items()
+                       if v.dtype != jnp.float32}
+            if masters:
+                slots["master"] = masters
         slots["step"] = jnp.zeros((), jnp.int32)
         return slots
 
@@ -82,14 +88,16 @@ class Optimizer:
             grads = self.grad_clip(grads)
         step_ = state["step"] if step is None else step
         lr = self.lr_value(step_)
-        work = state.get("master", params)
+        masters = state.get("master")
+        work = ({k: masters[k] if k in masters else params[k] for k in params}
+                if masters else params)
         gf = _to_f32(grads)
         new_work, new_slots = self._apply(gf, work, state, lr, step_)
         new_state = dict(state)
         new_state.update(new_slots)
         new_state["step"] = state["step"] + 1
-        if "master" in state:
-            new_state["master"] = new_work
+        if masters:
+            new_state["master"] = {k: new_work[k] for k in masters}
         new_params = _tree_map(lambda m, p: m.astype(p.dtype), new_work, params)
         return new_params, new_state
 

@@ -47,3 +47,11 @@ from paddle_tpu.parallel.auto_parallel import (  # noqa: F401
     Partial,
 )
 from paddle_tpu.parallel.launch import spawn  # noqa: F401
+from paddle_tpu.parallel import mp_layers  # noqa: F401
+from paddle_tpu.parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    ParallelCrossEntropy,
+    split_layer as split,
+)
