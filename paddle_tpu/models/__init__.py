@@ -12,3 +12,8 @@ from paddle_tpu.models.llama import (  # noqa: F401
     LlamaModel,
     LlamaForCausalLM,
 )
+from paddle_tpu.models.mixtral import (  # noqa: F401
+    MixtralConfig,
+    MixtralModel,
+    MixtralForCausalLM,
+)

@@ -176,7 +176,65 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class CausalLMBase(nn.Layer):
+    """Shared scaffolding for decoder-only LMs built on `.model` (with
+    embed_tokens/layers/norm), `.lm_head` and `.loss_fn` attributes."""
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+    def _pipeline_block_apply(self, template):
+        """(one_block_state, h) -> h, built over `template`. Subclasses with
+        per-block extra losses return (h, extra) instead."""
+        from paddle_tpu.nn.layer import functional_call
+        cfg = self.cfg
+
+        def block_apply(st, h):
+            s = h.shape[1]
+            cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim,
+                                             base=cfg.rope_base)
+            return functional_call(template, st, h, cos, sin, None)
+
+        return block_apply
+
+    def pipeline_parts(self):
+        """Factor the model for the SPMD pipeline schedule
+        (parallel.pipeline.make_pipeline_train_step)."""
+        from paddle_tpu.nn.layer import functional_call
+        from paddle_tpu.parallel.pipeline import PipelineParts, part_specs
+
+        if self.cfg.tie_word_embeddings:
+            raise ValueError(
+                "pipeline_parts requires tie_word_embeddings=False (tied "
+                "embed/head across pipeline stages needs a SharedLayerDesc-"
+                "style grad sync; untie for pp training)")
+        embed = self.model.embed_tokens
+        blocks = list(self.model.layers)
+        template = blocks[0]
+        head = _LMHead(self.model.norm, self.lm_head, self.loss_fn)
+        block_apply = self._pipeline_block_apply(template)
+
+        def embed_apply(st, ids):
+            return functional_call(embed, st, ids)
+
+        def head_apply(st, h, labels):
+            return functional_call(head, st, h, labels)
+
+        return PipelineParts(
+            embed_state=embed.trainable_state(),
+            embed_apply=embed_apply,
+            block_states=[b.trainable_state() for b in blocks],
+            block_apply=block_apply,
+            head_state=head.trainable_state(),
+            head_apply=head_apply,
+            embed_pspecs=part_specs(embed),
+            block_pspecs=part_specs(template),
+            head_pspecs=part_specs(head),
+        )
+
+
+class LlamaForCausalLM(CausalLMBase):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
@@ -198,8 +256,19 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(x)
 
     def loss(self, logits, labels):
-        return jnp.mean(self.loss_fn(logits, labels))
+        # reduction='mean' divides by the count of non-ignored labels
+        return self.loss_fn(logits, labels, reduction="mean")
 
-    def num_params(self):
-        import numpy as np
-        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+class _LMHead(nn.Layer):
+    """Final norm + unembedding + mean parallel-CE loss (pipeline tail)."""
+
+    def __init__(self, norm, lm_head, loss_fn):
+        super().__init__()
+        self.norm = norm
+        self.lm_head = lm_head
+        self.loss_fn = loss_fn
+
+    def forward(self, h, labels):
+        logits = self.lm_head(self.norm(h))
+        return self.loss_fn(logits, labels, reduction="mean")

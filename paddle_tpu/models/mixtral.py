@@ -1,0 +1,150 @@
+"""Mixtral / DeepSeekMoE — expert-parallel configs (BASELINE config #4).
+
+Capability reference: "DeepSeekMoE / Mixtral (Fleet expert-parallel
+alltoall)" rides the reference's MoELayer + global_scatter/global_gather
+stack (SURVEY.md §2.6-EP); the models themselves live in PaddleNLP.
+
+Architecture: Llama decoder (GQA attention, RMSNorm, RoPE) with the FFN
+replaced by a token-choice MoE (nn.layers.moe.MoELayer); DeepSeekMoE-style
+shared experts (always-on SwiGLU alongside the routed experts) optional.
+Forward returns (logits, aux_loss) — the load-balance aux must reach the
+task loss, including through the pipeline schedule (block_apply returns the
+weighted aux per block).
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn.layers.moe import MoELayer
+from paddle_tpu.ops import rope as rope_ops
+from paddle_tpu.parallel import mp_layers as mp
+from paddle_tpu.models.llama import (
+    CausalLMBase,
+    LlamaConfig,
+    LlamaAttention,
+    LlamaMLP,
+)
+
+
+@dataclasses.dataclass
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    num_shared_experts: int = 0       # DeepSeekMoE: always-on experts
+    moe_gate: str = "gshard"          # 'gshard' (top-k) | 'switch' (top-1)
+
+    @classmethod
+    def tiny(cls, vocab_size=256):
+        return cls(vocab_size=vocab_size, hidden_size=64, intermediate_size=96,
+                   num_layers=2, num_heads=4, num_kv_heads=2,
+                   max_position_embeddings=128, num_experts=4, top_k=2)
+
+    @classmethod
+    def mixtral_8x7b(cls):
+        return cls(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                   num_layers=32, num_heads=32, num_kv_heads=8,
+                   num_experts=8, top_k=2)
+
+    @classmethod
+    def deepseek_moe_16b(cls):
+        # fine-grained experts + 2 shared (DeepSeekMoE scheme)
+        return cls(vocab_size=102400, hidden_size=2048, intermediate_size=1408,
+                   num_layers=28, num_heads=16, num_experts=64, top_k=6,
+                   num_shared_experts=2)
+
+
+class MixtralDecoderLayer(nn.Layer):
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.moe = MoELayer(cfg.hidden_size, cfg.intermediate_size,
+                            cfg.num_experts, top_k=cfg.top_k,
+                            capacity_factor=cfg.capacity_factor,
+                            gate=cfg.moe_gate,
+                            initializer_range=cfg.initializer_range)
+        if cfg.num_shared_experts:
+            shared_cfg = dataclasses.replace(
+                cfg, intermediate_size=cfg.intermediate_size
+                * cfg.num_shared_experts)
+            self.shared_mlp = LlamaMLP(shared_cfg)
+        self.cfg = cfg
+
+    def forward(self, x, cos=None, sin=None, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        h = self.post_attention_layernorm(x)
+        moe_out, aux = self.moe(h)
+        if self.cfg.num_shared_experts:
+            moe_out = moe_out + self.shared_mlp(h)
+        return x + moe_out, aux
+
+
+class MixtralModel(nn.Layer):
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__()
+        self.cfg = cfg
+        from paddle_tpu.nn import initializer as init
+        self.embed_tokens = mp.VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=init.Normal(0.0, cfg.initializer_range))
+        self.layers = nn.LayerList([MixtralDecoderLayer(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+        x = self.embed_tokens(input_ids)
+        aux_total = jnp.zeros((), jnp.float32)
+        for layer in self.layers:
+            x, aux = layer(x, cos, sin, attn_mask)
+            aux_total = aux_total + aux
+        return self.norm(x), aux_total
+
+
+class MixtralForCausalLM(CausalLMBase):
+    """Forward returns (logits, weighted_aux); loss() adds them."""
+
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__()
+        if cfg.tie_word_embeddings:
+            raise ValueError(
+                "MixtralForCausalLM does not support tie_word_embeddings")
+        self.cfg = cfg
+        self.model = MixtralModel(cfg)
+        from paddle_tpu.nn import initializer as init
+        self.lm_head = mp.ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size,
+            weight_attr=init.Normal(0.0, cfg.initializer_range),
+            has_bias=False, gather_output=False)
+        self.loss_fn = mp.ParallelCrossEntropy()
+
+    def forward(self, input_ids, attn_mask=None):
+        x, aux = self.model(input_ids, attn_mask)
+        return self.lm_head(x), self.cfg.aux_loss_weight * aux
+
+    def loss(self, outputs, labels):
+        logits, aux = outputs
+        return self.loss_fn(logits, labels, reduction="mean") + aux
+
+    def _pipeline_block_apply(self, template):
+        from paddle_tpu.nn.layer import functional_call
+        cfg = self.cfg
+
+        def block_apply(st, h):
+            s = h.shape[1]
+            cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim,
+                                             base=cfg.rope_base)
+            h2, aux = functional_call(template, st, h, cos, sin, None)
+            return h2, cfg.aux_loss_weight * aux
+
+        return block_apply

@@ -43,6 +43,11 @@ from paddle_tpu.nn.layers.transformer import (  # noqa: F401
     TransformerEncoder,
     TransformerEncoderLayer,
 )
+from paddle_tpu.nn.layers.moe import (  # noqa: F401
+    MoELayer,
+    GShardGate,
+    SwitchGate,
+)
 from paddle_tpu.nn.loss import (  # noqa: F401
     CrossEntropyLoss,
     MSELoss,

@@ -1,0 +1,191 @@
+"""Mixture-of-experts: top-k gating + expert-parallel grouped experts.
+
+Reference (SURVEY.md §2.6-EP): `MoELayer` with GShard top-2 / Switch top-1
+gates (python/paddle/incubate/distributed/models/moe/{moe_layer.py,gate/}),
+token dispatch via the `global_scatter`/`global_gather` NCCL all-to-all ops
+(paddle/fluid/operators/collective/global_scatter_op.cu).
+
+TPU-first design:
+* experts live as ONE grouped weight per projection, shape
+  (num_experts, d_in, d_out), expert dim sharded over the expert-parallel
+  mesh axes — a single einsum runs all local experts on the MXU.
+* dispatch/combine are GShard-style one-hot capacity tensors; constraining
+  the dispatched activations to the expert sharding makes GSPMD emit the
+  all_to_all the reference issues by hand.
+* capacity is static (capacity_factor · k · tokens / E) so shapes stay
+  XLA-friendly; overflow tokens are dropped exactly like the reference.
+* the load-balancing aux loss is returned alongside the output; model code
+  adds it to the task loss (the pipeline schedule threads it per-stage).
+"""
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.parallel.mp_layers import constrain
+
+EP_AXES = ("dp",)   # default: expert parallelism rides the dp axis
+
+
+def _ep_spec(ep_axes, ndim, extra=None):
+    """Spec sharding dim0 (experts) over ep_axes; `extra` maps dim→axis."""
+    dims = [None] * ndim
+    dims[0] = ep_axes if len(ep_axes) > 1 else ep_axes[0]
+    for d, a in (extra or {}).items():
+        dims[d] = a
+    return P(*dims)
+
+
+def topk_gating(logits, k: int, capacity: int, normalize_topk: bool = True):
+    """GShard-style top-k gating with static capacity.
+
+    logits: (tokens, E) fp32. Returns (combine (T, E, C), dispatch bool
+    (T, E, C), aux_loss scalar). Choice 0 for all tokens claims capacity
+    before choice 1 (reference GShardGate priority semantics).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)          # (T, k)
+    if normalize_topk:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # load-balance aux (Switch/GShard): E * Σ_e mean_prob_e · frac_routed_e
+    me = jnp.mean(probs, axis=0)                           # (E,)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                  axis=0)                                  # (E,)
+    aux = e * jnp.sum(me * ce)
+
+    # position in each expert's queue, choices processed in priority order:
+    # flatten (k, T) so all choice-0 tokens precede choice-1 tokens
+    mask = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)     # (T, k, E)
+    mask_kt = jnp.swapaxes(mask, 0, 1).reshape(k * t, e)    # (k*T, E)
+    pos_kt = jnp.cumsum(mask_kt, axis=0) - mask_kt          # claimed before me
+    pos = jnp.swapaxes(pos_kt.reshape(k, t, e), 0, 1)       # (T, k, E)
+    pos = jnp.sum(pos * mask, axis=-1)                      # (T, k)
+    keep = (pos < capacity) & (gate_vals > 0.0)             # (T, k)
+
+    # combine/dispatch (T, E, C)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)   # (T, k, C)
+    contrib = (gate_vals * keep)[..., None] * pos_oh            # (T, k, C)
+    combine = jnp.einsum("tkc,tke->tec", contrib,
+                         mask.astype(jnp.float32))
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class GShardGate(Layer):
+    """Top-2 gate (reference: moe/gate/gshard_gate.py)."""
+
+    top_k = 2
+
+    def __init__(self, hidden_size, num_experts, capacity_factor=1.25):
+        super().__init__()
+        self.proj = _GateProj(hidden_size, num_experts)
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+
+    def forward(self, x_tokens):
+        logits = self.proj(x_tokens)
+        t = x_tokens.shape[0]
+        cap = max(4, int(math.ceil(
+            self.capacity_factor * self.top_k * t / self.num_experts)))
+        return topk_gating(logits, self.top_k, cap)
+
+
+class SwitchGate(GShardGate):
+    """Top-1 gate (reference: moe/gate/switch_gate.py)."""
+
+    top_k = 1
+
+
+class _GateProj(Layer):
+    def __init__(self, hidden_size, num_experts):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (hidden_size, num_experts),
+            default_initializer=init.Normal(0.0, 0.02))
+
+    def forward(self, x):
+        # router math in fp32 (reference casts too — routing is precision-
+        # sensitive)
+        return jnp.matmul(x.astype(jnp.float32),
+                          self.weight.astype(jnp.float32))
+
+
+class GroupedSwiGLUExperts(Layer):
+    """All experts' SwiGLU FFNs as three grouped (E, ·, ·) weights."""
+
+    def __init__(self, num_experts, hidden_size, ffn_size, initializer_range=0.02,
+                 ep_axes: Sequence[str] = EP_AXES, mp_axis: str = "mp",
+                 dtype=None):
+        super().__init__()
+        w = init.Normal(0.0, initializer_range)
+        e, h, f = num_experts, hidden_size, ffn_size
+        self.w_gate = self.create_parameter((e, h, f), dtype=dtype,
+                                            default_initializer=w)
+        self.w_up = self.create_parameter((e, h, f), dtype=dtype,
+                                          default_initializer=w)
+        self.w_down = self.create_parameter((e, f, h), dtype=dtype,
+                                            default_initializer=w)
+        ep = tuple(ep_axes)
+        self._parameters["w_gate"].pspec = _ep_spec(ep, 3, {2: mp_axis})
+        self._parameters["w_up"].pspec = _ep_spec(ep, 3, {2: mp_axis})
+        self._parameters["w_down"].pspec = _ep_spec(ep, 3, {1: mp_axis})
+        self.ep_axes = ep
+        self.mp_axis = mp_axis
+
+    def forward(self, xe):
+        """xe: (E, C_total, h) dispatched tokens → (E, C_total, h)."""
+        spec = lambda nd: _ep_spec(self.ep_axes, nd)
+        for a in self.ep_axes:
+            xe = constrain(xe, spec, a)     # all_to_all into expert shards
+        h1 = jnp.einsum("ech,ehf->ecf", xe, self.w_gate)
+        h2 = jnp.einsum("ech,ehf->ecf", xe, self.w_up)
+        y = jnp.einsum("ecf,efh->ech", F.silu(h1) * h2, self.w_down)
+        for a in self.ep_axes:
+            y = constrain(y, spec, a)
+        return y
+
+
+class MoELayer(Layer):
+    """Token-choice MoE block: gate → all_to_all dispatch → grouped experts
+    → combine. Returns (output, aux_loss).
+
+    Reference parity: paddle.incubate.distributed.models.moe.MoELayer
+    (gate=GShard top-2 or Switch top-1, capacity dropping, aux loss).
+    """
+
+    def __init__(self, hidden_size, ffn_size, num_experts, top_k=None,
+                 capacity_factor=1.25, gate: str = "gshard",
+                 initializer_range=0.02, ep_axes: Sequence[str] = EP_AXES,
+                 mp_axis: str = "mp", dtype=None):
+        super().__init__()
+        gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate]
+        if gate == "switch" and top_k not in (None, 1):
+            raise ValueError(f"gate='switch' is top-1 routing; got top_k={top_k}")
+        self.gate = gate_cls(hidden_size, num_experts,
+                             capacity_factor=capacity_factor)
+        if top_k is not None:
+            self.gate.top_k = top_k
+        self.experts = GroupedSwiGLUExperts(
+            num_experts, hidden_size, ffn_size,
+            initializer_range=initializer_range, ep_axes=ep_axes,
+            mp_axis=mp_axis, dtype=dtype)
+        self.num_experts = num_experts
+        self.hidden_size = hidden_size
+
+    def forward(self, x) -> Tuple[jax.Array, jax.Array]:
+        b, s, h = x.shape
+        xt = x.reshape(b * s, h)
+        combine, dispatch, aux = self.gate(xt)            # (T, E, C)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+        ye = self.experts(xe)                             # (E, C, h)
+        yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+        return yt.reshape(b, s, h), aux

@@ -158,6 +158,21 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
     hcg = hcg or _fleet.get_hybrid_communicate_group() or get_hybrid_communicate_group()
     if isinstance(model, DataParallel):
         model = model.inner_layer
+    from paddle_tpu.parallel.pipeline import PipelineParallel
+    if isinstance(model, PipelineParallel):
+        model = model.inner_layer
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not hasattr(model, "pipeline_parts"):
+            raise ValueError(
+                f"pp_degree>1 but {type(model).__name__} does not implement "
+                "pipeline_parts(); see parallel.pipeline.PipelineParts")
+        if loss_fn is not None:
+            raise ValueError(
+                "pp_degree>1 computes the loss in the model's pipeline head "
+                "(PipelineParts.head_apply); pass loss_fn=None")
+        from paddle_tpu.parallel.pipeline import make_pipeline_train_step
+        return make_pipeline_train_step(model, optimizer, strategy=strategy,
+                                        hcg=hcg, donate=donate)
     mesh = hcg.mesh
     stage = strategy.sharding_configs.stage if strategy.sharding else 0
     degree = hcg.get_sharding_parallel_world_size()

@@ -48,10 +48,26 @@ def _active_mesh(axis: str):
 
 
 def constrain(x, spec_for_ndim, axis: str = MP_AXIS):
-    """Apply a sharding constraint if a hybrid mesh with `axis` is active.
+    """Apply a sharding constraint if a mesh with `axis` is active.
 
     `spec_for_ndim(ndim) -> PartitionSpec` builds the rank-appropriate spec.
+
+    Dispatch: when an ambient abstract mesh is set (under ``jax.set_mesh`` —
+    notably inside a partial-manual ``shard_map`` like the pipeline schedule),
+    use a bare PartitionSpec so the constraint applies to the mesh's Auto
+    axes; axes the caller has taken Manual are skipped (explicit collectives
+    own them there). Otherwise fall back to the hybrid group's concrete mesh.
     """
+    try:
+        from jax.sharding import get_abstract_mesh, AxisType
+        am = get_abstract_mesh()
+    except ImportError:                      # older jax
+        am = None
+    if am is not None and not am.empty and axis in am.axis_names:
+        types = dict(zip(am.axis_names, am.axis_types))
+        if types[axis] == AxisType.Manual or am.shape[axis] <= 1:
+            return x
+        return jax.lax.with_sharding_constraint(x, spec_for_ndim(x.ndim))
     mesh = _active_mesh(axis)
     if mesh is None:
         return x
@@ -192,11 +208,11 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
         self.axis = axis
 
-    def forward(self, logits, labels, soft_label=False):
+    def forward(self, logits, labels, soft_label=False, reduction="none"):
         logits = constrain(logits, _last_dim_spec(self.axis), self.axis)
         return F.cross_entropy(logits, labels, soft_label=soft_label,
                                ignore_index=self.ignore_index,
-                               reduction="none")
+                               reduction=reduction)
 
 
 # ---- Megatron sequence parallelism (SP over the mp axis) -------------------

@@ -131,6 +131,248 @@ class PipelineParallel(Layer):
 
 # ---- SPMD pipeline schedule (collective-permute pipelining) ---------------
 
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as _jnp
+
+
+@dataclasses.dataclass
+class PipelineParts:
+    """A model factored for the SPMD pipeline: embed → N identical blocks →
+    head(loss). Models expose this via ``.pipeline_parts()``.
+
+    The reference expresses the same factoring as a LayerDesc list fed to
+    PipelineLayer (pp_layers.py); identical-block stacking is the TPU twist
+    that lets stage weights live as one (n_stages, per_stage, ...) array
+    sharded over the pp mesh axis.
+    """
+
+    embed_state: Dict[str, Any]
+    embed_apply: Callable            # (embed_state, batch_ids) -> h
+    block_states: List[Dict[str, Any]]   # per-layer, identical structure
+    block_apply: Callable            # (one_block_state, h) -> h
+    head_state: Dict[str, Any]
+    head_apply: Callable             # (head_state, h, labels) -> scalar loss
+    embed_pspecs: Dict[str, Any]
+    block_pspecs: Dict[str, Any]     # specs for ONE block (unstacked)
+    head_pspecs: Dict[str, Any]
+
+
+def _norm_pspec(p, ndim):
+    """Normalize a Parameter.pspec (possibly None/short) to `ndim` entries."""
+    from jax.sharding import PartitionSpec as P
+    if p is None:
+        return P(*([None] * ndim))
+    entries = list(p) + [None] * (ndim - len(tuple(p)))
+    return P(*entries[:ndim])
+
+
+def part_specs(layer) -> Dict[str, Any]:
+    return {name: _norm_pspec(getattr(param, "pspec", None), param.value.ndim)
+            for name, param in layer.named_parameters() if param.trainable}
+
+
+def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
+                             donate: bool = True):
+    """Compiled pp×mp×dp×sharding train step via collective-permute pipelining.
+
+    One jit: embed + a scan over (n_micro + n_stages - 1) ticks, each tick
+    running this stage's block stack and rotating activations to the next
+    stage with ppermute (reference 1F1B/NCCL-p2p analog — SURVEY.md §3.3);
+    TP/DP/ZeRO ride the mesh's Auto axes via GSPMD inside the same program.
+    Schedule is GPipe-style accumulation (activations for in-flight
+    microbatches are rematerialized when strategy.recompute is on).
+
+    Returns (step_fn, init_fn); state is a flat dict with ``embed.``/
+    ``blocks.``/``head.`` key prefixes, block params stacked
+    (n_stages, per_stage, ...) and sharded over the "pp" axis.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel import fleet as fleet_mod
+    from paddle_tpu.parallel import sharding as sharding_mod
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.topology import get_hybrid_communicate_group
+
+    strategy = strategy or DistributedStrategy()
+    hcg = hcg or fleet_mod.get_fleet().get_hybrid_communicate_group() \
+        or get_hybrid_communicate_group()
+    mesh = hcg.mesh
+    n_stages = hcg.get_pipe_parallel_world_size()
+    n_micro = strategy.pipeline_configs.accumulate_steps
+    if n_micro < n_stages:
+        n_micro = n_stages  # keep the bubble bounded; reference asserts too
+
+    parts: PipelineParts = model.pipeline_parts()
+    n_layers = len(parts.block_states)
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by pp={n_stages}")
+    per_stage = n_layers // n_stages
+
+    # ---- flat state: embed. / blocks.(stacked) / head. ----
+    stacked = {
+        k: _jnp.stack([st[k] for st in parts.block_states]).reshape(
+            (n_stages, per_stage) + parts.block_states[0][k].shape)
+        for k in parts.block_states[0]}
+    state0 = {}
+    state0.update({f"embed.{k}": v for k, v in parts.embed_state.items()})
+    state0.update({f"blocks.{k}": v for k, v in stacked.items()})
+    state0.update({f"head.{k}": v for k, v in parts.head_state.items()})
+
+    # ---- shardings: pp on the stage dim, TP placements, ZeRO composition ----
+    zstage = strategy.sharding_configs.stage if strategy.sharding else 0
+    zdeg = hcg.get_sharding_parallel_world_size()
+
+    pspecs = {}
+    for k, spec in parts.embed_pspecs.items():
+        pspecs[f"embed.{k}"] = spec
+    for k, spec in parts.block_pspecs.items():
+        pspecs[f"blocks.{k}"] = P("pp", None, *tuple(spec))
+    for k, spec in parts.head_pspecs.items():
+        pspecs[f"head.{k}"] = spec
+    if zstage >= 3 and zdeg > 1:
+        pspecs = {k: sharding_mod.param_pspec(state0[k].shape, zdeg,
+                                              existing=pspecs[k])
+                  for k in pspecs}
+    ospecs = sharding_mod.opt_state_specs(pspecs, zstage, zdeg, state0)
+
+    dp_axes = tuple(a for a in ("dp", "sharding")
+                    if a in mesh.axis_names and mesh.shape[a] > 1)
+    bspec = P(dp_axes if dp_axes else None)
+
+    remat = strategy.recompute
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def split_state(flat):
+        e = {k[len("embed."):]: v for k, v in flat.items()
+             if k.startswith("embed.")}
+        b = {k[len("blocks."):]: v for k, v in flat.items()
+             if k.startswith("blocks.")}
+        h = {k[len("head."):]: v for k, v in flat.items()
+             if k.startswith("head.")}
+        return e, b, h
+
+    def pipeline_loss(flat_state, ids_mb, labels_mb):
+        """ids_mb/labels_mb: (n_micro, mb, seq)."""
+        embed_st, blocks_st, head_st = split_state(flat_state)
+
+        def inner(blocks_local, embed_st, head_st, ids_mb, labels_mb):
+            stage = jax.lax.axis_index("pp")
+            blocks_me = jax.tree_util.tree_map(lambda a: a[0], blocks_local)
+            total = n_micro + n_stages - 1
+
+            def stage_fwd(h):
+                def body(h, one_layer):
+                    out = parts.block_apply(one_layer, h)
+                    if isinstance(out, tuple):   # (h, extra_loss) — e.g. MoE aux
+                        return out[0], out[1].astype(_jnp.float32)
+                    return out, _jnp.zeros((), _jnp.float32)
+                h, extras = jax.lax.scan(body, h, blocks_me)
+                return h, _jnp.sum(extras)
+
+            def tick(carry, t):
+                h_carry, loss_acc = carry
+                ids_t = jax.lax.dynamic_index_in_dim(
+                    ids_mb, _jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+                h_in = parts.embed_apply(embed_st, ids_t)
+                h = _jnp.where(stage == 0, h_in, h_carry)
+                h_out, extra = stage_fwd(h)
+                out_idx = t - (n_stages - 1)
+                lbl = jax.lax.dynamic_index_in_dim(
+                    labels_mb, _jnp.clip(out_idx, 0, n_micro - 1), 0,
+                    keepdims=False)
+                mb_loss = parts.head_apply(head_st, h_out, lbl)
+                emit = (stage == n_stages - 1) & (out_idx >= 0)
+                # stage s holds microbatch (t - s); its extra losses count
+                # only while that microbatch is real (not a bubble tick)
+                valid = (t >= stage) & (t - stage < n_micro)
+                loss_acc = (loss_acc + _jnp.where(emit, mb_loss, 0.0)
+                            + _jnp.where(valid, extra, 0.0))
+                h_next = jax.lax.ppermute(h_out, "pp", perm)
+                return (h_next, loss_acc), None
+
+            if remat:
+                tick = jax.checkpoint(tick)
+
+            mb = ids_mb.shape[1]
+            seq = ids_mb.shape[2]
+            h0_probe = jax.eval_shape(
+                lambda s, i: parts.embed_apply(s, i), embed_st,
+                jax.ShapeDtypeStruct((mb, seq), ids_mb.dtype))
+            h0 = _jnp.zeros(h0_probe.shape, h0_probe.dtype)
+            # carries vary per-stage: mark them varying over the manual axis
+            h0 = jax.lax.pcast(h0, ("pp",), to="varying")
+            loss0 = jax.lax.pcast(_jnp.zeros((), _jnp.float32), ("pp",),
+                                  to="varying")
+            (_, loss_acc), _ = jax.lax.scan(tick, (h0, loss0),
+                                            _jnp.arange(total))
+            return jax.lax.psum(loss_acc, "pp") / n_micro
+
+        f = jax.shard_map(
+            inner, mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(), P(), P(), P()),
+            out_specs=P())
+        return f(blocks_st, embed_st, head_st, ids_mb, labels_mb)
+
+    def _step(flat_state, opt_state, ids_mb, labels_mb):
+        loss, grads = jax.value_and_grad(pipeline_loss)(
+            flat_state, ids_mb, labels_mb)
+        grads = {k: jax.lax.with_sharding_constraint(
+            g, NamedSharding(mesh, pspecs[k])) for k, g in grads.items()}
+        new_state, new_opt = optimizer.update(grads, opt_state, flat_state)
+        new_state = {k: jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, pspecs[k])) for k, v in new_state.items()}
+        return new_state, new_opt, loss
+
+    jit_step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
+
+    def init_fn():
+        placed = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+                  for k, v in state0.items()}
+        opt_state = optimizer.init_state(placed)
+
+        def place_slot(tree):
+            if isinstance(tree, dict):
+                return {k: jax.device_put(v, NamedSharding(
+                    mesh, ospecs.get(k, P()))) for k, v in tree.items()}
+            return tree
+        opt_state = {slot: place_slot(t) for slot, t in opt_state.items()}
+        return placed, opt_state
+
+    def step_fn(state, opt_state, batch):
+        """batch: dict with 'input' (B, seq) and 'labels' (B, seq);
+        B must be divisible by n_micro."""
+        ids, labels = batch["input"], batch["labels"]
+        B = ids.shape[0]
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+        mb = B // n_micro
+        dp_total = 1
+        for a in dp_axes:
+            dp_total *= mesh.shape[a]
+        if dp_total > 1 and mb % dp_total == 0:
+            mb_spec = bspec
+        else:
+            mb_spec = P(None)
+            if dp_total > 1:
+                import warnings
+                warnings.warn(
+                    f"microbatch size {mb} not divisible by dp×sharding="
+                    f"{dp_total}: replicating the batch across those axes "
+                    "(no data parallelism this step)", stacklevel=2)
+        ids_mb = ids.reshape(n_micro, mb, *ids.shape[1:])
+        labels_mb = labels.reshape(n_micro, mb, *labels.shape[1:])
+        ids_mb = jax.device_put(ids_mb, NamedSharding(
+            mesh, P(None, *tuple(mb_spec))))
+        labels_mb = jax.device_put(labels_mb, NamedSharding(
+            mesh, P(None, *tuple(mb_spec))))
+        with jax.set_mesh(mesh):
+            return jit_step(state, opt_state, ids_mb, labels_mb)
+
+    return step_fn, init_fn
+
+
 def pipeline_spmd_fn(stage_fn: Callable, n_stages: int, n_micro: int,
                      axis_name: str = "pp"):
     """Build a pipelined forward over stage-stacked params.

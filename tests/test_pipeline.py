@@ -1,0 +1,85 @@
+"""Pipeline-parallel invariance: pp2×mp2×dp2 loss == single-device loss.
+
+Reference pattern (SURVEY.md §4-hybrid): launch procs, assert loss curves
+match the single-process run. Here: one SPMD program on the 8-device CPU
+mesh vs the plain eager forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.parallel import fleet
+from paddle_tpu.parallel.pipeline import make_pipeline_train_step
+from paddle_tpu.parallel.strategy import DistributedStrategy
+from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+
+@pytest.fixture
+def pp_fleet():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 4
+    f = fleet.init(is_collective=True, strategy=s)
+    yield f, s
+    set_hybrid_communicate_group(None)
+
+
+def test_pipeline_matches_single_device(pp_fleet):
+    f, s = pp_fleet
+    cfg = LlamaConfig.tiny()
+    cfg.tie_word_embeddings = False
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+
+    rng = np.random.RandomState(0)
+    B, seq = 8, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, seq + 1)))
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    ref_loss = float(model.loss(model(x), y))
+
+    opt = AdamW(learning_rate=1e-3)
+    step_fn, init_fn = make_pipeline_train_step(model, opt, strategy=s)
+    state, opt_state = init_fn()
+    state, opt_state, loss0 = step_fn(state, opt_state,
+                                      {"input": x, "labels": y})
+    np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
+
+    for _ in range(4):
+        state, opt_state, loss = step_fn(state, opt_state,
+                                         {"input": x, "labels": y})
+    assert float(loss) < float(loss0)
+
+
+def test_pipeline_with_recompute_matches(pp_fleet):
+    f, s = pp_fleet
+    s.recompute = True
+    cfg = LlamaConfig.tiny()
+    cfg.tie_word_embeddings = False
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 17)))
+    x, y = ids[:, :-1], ids[:, 1:]
+    ref_loss = float(model.loss(model(x), y))
+    opt = AdamW(learning_rate=1e-3)
+    step_fn, init_fn = make_pipeline_train_step(model, opt, strategy=s)
+    state, opt_state = init_fn()
+    _, _, loss0 = step_fn(state, opt_state, {"input": x, "labels": y})
+    np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
+
+
+def test_pipeline_requires_untied_embeddings(pp_fleet):
+    cfg = LlamaConfig.tiny()
+    cfg.tie_word_embeddings = True
+    model = LlamaForCausalLM(cfg)
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        model.pipeline_parts()
