@@ -1,0 +1,111 @@
+"""Headline benchmark: GPT-2 345M pretrain tokens/sec/chip (+ MFU).
+
+BASELINE.md config #1 ("GPT-2 345M single-device"). The reference repo
+publishes no numbers (BASELINE.json "published": {}), so `vs_baseline`
+reports measured MFU relative to the driver's north-star 45% MFU target —
+1.0 means the north star is met on this chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / trillium
+}
+
+
+def main():
+    import paddle_tpu
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    paddle_tpu.seed(0)
+    cfg = GPTConfig.gpt2_medium()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    if not on_tpu:          # CPU smoke: shrink so the bench still completes
+        cfg = GPTConfig(vocab_size=50304, hidden_size=256, num_layers=4,
+                        num_heads=8, max_position_embeddings=1024,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+    model = GPTPretrainModel(cfg).bfloat16()
+    n_params = model.num_params()
+
+    B, S = (2, 1024) if on_tpu else (2, 256)
+    opt = AdamW(learning_rate=1e-4)
+    state = model.trainable_state()
+    opt_state = opt.init_state(state)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S + 1)))
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    n_steps = 20 if on_tpu else 3
+
+    def one_step(carry, _):
+        state, opt_state = carry
+        def loss_fn(s):
+            logits = functional_call(model, s, x)
+            return model.loss(logits, y)
+        loss, grads = jax.value_and_grad(loss_fn)(state)
+        state, opt_state = opt.update(grads, opt_state, state)
+        return (state, opt_state), loss
+
+    @jax.jit
+    def run_steps(state, opt_state):
+        (state, opt_state), losses = jax.lax.scan(
+            one_step, (state, opt_state), None, length=n_steps)
+        return state, opt_state, losses
+
+    # warmup/compile (also amortizes any host↔device tunnel latency out of
+    # the timed region — one dispatch covers all n_steps)
+    state, opt_state, losses = run_steps(state, opt_state)
+    float(losses[-1])
+
+    t0 = time.perf_counter()
+    state, opt_state, losses = run_steps(state, opt_state)
+    loss = losses[-1]
+    float(loss)          # full host sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = B * S
+    tok_s = tokens_per_step * n_steps / dt
+
+    # train FLOPs/token ≈ 6N + attention term 12·L·h·S (h=hidden, causal ½·2)
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S
+    peak = PEAK_FLOPS.get(dev.device_kind, 197e12 if on_tpu else 1e12)
+    mfu = tok_s * flops_per_token / peak
+
+    print(json.dumps({
+        "metric": "gpt2-345m tokens/sec/chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "params": n_params,
+        "device": dev.device_kind,
+        "batch": B, "seq": S, "steps": n_steps,
+        "step_time_ms": round(1000 * dt / n_steps, 2),
+        "final_loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
