@@ -44,6 +44,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     # sequence-parallel activations between TP regions (Megatron-SP)
     sequence_parallel: bool = False
+    # long-context strategy over the 'sep' mesh axis: None | 'ring' | 'ulysses'
+    context_parallel: Optional[str] = None
 
     @property
     def kv_heads(self):
@@ -114,9 +116,15 @@ class LlamaAttention(nn.Layer):
             cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
         q = rope_ops.apply_rotary_pos_emb(q, cos, sin)
         k = rope_ops.apply_rotary_pos_emb(k, cos, sin)
-        # always causal; an attn_mask (e.g. padding) composes with it
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=True)
+        if cfg.context_parallel:
+            from paddle_tpu.parallel.context_parallel import (
+                context_parallel_attention)
+            out = context_parallel_attention(q, k, v, axis="sep",
+                                             mode=cfg.context_parallel)
+        else:
+            # always causal; an attn_mask (e.g. padding) composes with it
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True)
         return self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
 
 

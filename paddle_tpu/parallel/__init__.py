@@ -48,6 +48,12 @@ from paddle_tpu.parallel.auto_parallel import (  # noqa: F401
 )
 from paddle_tpu.parallel.launch import spawn  # noqa: F401
 from paddle_tpu.parallel import mp_layers  # noqa: F401
+from paddle_tpu.parallel import context_parallel  # noqa: F401
+from paddle_tpu.parallel.context_parallel import (  # noqa: F401
+    context_parallel_attention,
+    ring_attention_local,
+    ulysses_attention_local,
+)
 from paddle_tpu.parallel.mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     RowParallelLinear,

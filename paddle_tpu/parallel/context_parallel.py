@@ -1,0 +1,139 @@
+"""Long-context strategies: ring attention and Ulysses head-sharding.
+
+Reference (SURVEY.md §5-long-context): core Paddle ships only Megatron-SP
+(+ a `sep` topology axis); ring/blockwise attention and Ulysses live in the
+PaddleNLP ecosystem. Capability parity here = both strategies, TPU-native:
+
+* **Ring attention** — q/k/v sharded along sequence over the `sep` mesh
+  axis; each of the n ring steps computes a blockwise flash update (online
+  softmax, fp32 accumulators) of local Q against the KV chunk currently in
+  hand, then rotates KV to the next device with `ppermute` over the ICI
+  ring. Compute of step i overlaps the permute of step i+1 via XLA's
+  latency-hiding scheduler — the blockwise-ring-attention recipe.
+* **Ulysses** — two `all_to_all`s re-shard (seq-sharded → head-sharded)
+  around an ordinary full-sequence attention; cheaper comm volume than a
+  full allgather, the standard alternative when head count ≥ sep degree.
+
+Both run inside a partial-manual shard_map over the sep axis and compose
+with the other mesh axes (dp/mp/...) handled by GSPMD, and both are
+differentiable (scan + ppermute/all_to_all transpose cleanly).
+"""
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k, n_rep):
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Blockwise ring attention. MUST run inside shard_map manual over
+    `axis_name`; q/k/v are the local seq shards (b, s_loc, h, d)."""
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32) * sc
+    # positions of my queries within the global sequence
+    q_pos = me * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def ring_step(carry, i):
+        acc, m_prev, l_prev, kv = carry
+        k_i, v_i = kv
+        # the KV chunk in hand at step i originated on shard (me - i) mod n
+        src = (me - i) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_i.astype(jnp.float32))
+        if causal:
+            k_pos = src * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            mask = q_pos >= k_pos
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_cur = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(scores - m_cur[..., None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_i.astype(jnp.float32))
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        return (acc, m_cur, l_cur, kv), None
+
+    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    acc0 = vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
+    m0 = vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((b, h, s_loc), jnp.float32))
+    (acc, m, l, _), _ = jax.lax.scan(
+        ring_step, (acc0, m0, l0, (k, v)), jnp.arange(n))
+    # fully-masked rows (can't happen for causal self-attn, but keep safe)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                            scale: Optional[float] = None):
+    """Ulysses: all_to_all seq↔head re-shard around full attention.
+    MUST run inside shard_map manual over `axis_name`; q/k/v local
+    (b, s_loc, h, d) with h divisible by the sep degree."""
+    from paddle_tpu.ops.flash_attention import _xla_attention
+    n = jax.lax.axis_size(axis_name)
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs head counts divisible by sep={n}; "
+            f"got q heads {q.shape[2]}, kv heads {k.shape[2]}")
+
+    def to_heads(x):   # (b, s_loc, h, d) -> (b, s_full, h/n, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_seq(x):     # (b, s_full, h/n, d) -> (b, s_loc, h, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = _xla_attention(qh, kh, vh, is_causal=causal, scale=scale,
+                         dropout_p=0.0)
+    return to_seq(out)
+
+
+def context_parallel_attention(q, k, v, mesh=None, axis: str = "sep",
+                               mode: str = "ring", causal: bool = True,
+                               scale: Optional[float] = None):
+    """GSPMD-level entry: q/k/v (b, s, h, d) seq-sharded (or shardable) over
+    `axis`; wraps the local kernel in a partial-manual shard_map. No-op
+    degenerates to plain attention when the axis is absent or degree 1."""
+    from paddle_tpu.ops.flash_attention import _xla_attention
+    from paddle_tpu.parallel.topology import get_hybrid_communicate_group
+
+    if mesh is None:
+        hcg = get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg is not None else None
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return _xla_attention(q, k, v, is_causal=causal, scale=scale,
+                              dropout_p=0.0)
+
+    local = {"ring": ring_attention_local,
+             "ulysses": ulysses_attention_local}[mode]
+    spec = P(None, axis, None, None)
+    f = jax.shard_map(
+        partial(local, axis_name=axis, causal=causal, scale=scale),
+        mesh=mesh, axis_names={axis},
+        in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)
