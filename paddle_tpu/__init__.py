@@ -149,8 +149,10 @@ from paddle_tpu import amp  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import ops  # noqa: F401
 from paddle_tpu import parallel  # noqa: F401
-# Paddle-style alias: paddle.distributed.*
+# Paddle-style alias: paddle.distributed.* (also importable as a module path)
+import sys as _sys
 from paddle_tpu import parallel as distributed  # noqa: F401
+_sys.modules[__name__ + ".distributed"] = distributed
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import utils  # noqa: F401

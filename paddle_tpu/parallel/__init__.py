@@ -49,6 +49,13 @@ from paddle_tpu.parallel.auto_parallel import (  # noqa: F401
 from paddle_tpu.parallel.launch import spawn  # noqa: F401
 from paddle_tpu.parallel import mp_layers  # noqa: F401
 from paddle_tpu.parallel import context_parallel  # noqa: F401
+from paddle_tpu.parallel import checkpoint  # noqa: F401
+from paddle_tpu.parallel.checkpoint import (  # noqa: F401
+    save_state_dict,
+    load_state_dict,
+    CheckpointManager,
+)
+from paddle_tpu.parallel.elastic import ElasticTrainLoop  # noqa: F401
 from paddle_tpu.parallel.context_parallel import (  # noqa: F401
     context_parallel_attention,
     ring_attention_local,

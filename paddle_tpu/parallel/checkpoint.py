@@ -1,0 +1,136 @@
+"""Distributed checkpointing: sharding-aware save/load with resharding.
+
+Reference (SURVEY.md §5-checkpoint): per-rank shard files via
+`fleet.save_persistables`, unified dist checkpoint with re-sharding on load
+in python/paddle/distributed/checkpoint/{save_state_dict,load_state_dict}.py.
+
+TPU-native: Orbax. Arrays save with their shardings (each host writes its
+shards — multi-host safe); on load the caller supplies target shardings and
+Orbax reshards, so a checkpoint written on an mp×pp×sharding mesh restores
+onto any other topology — the 65B resume-across-topologies requirement.
+`CheckpointManager` adds step numbering, keep-K retention, async save and
+latest-step auto-resume (the launcher's restart-from-checkpoint recovery).
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def _abstract_target(tree, shardings=None, mesh: Optional[Mesh] = None,
+                     specs=None):
+    """Abstract pytree with target shardings for resharding-on-load.
+
+    `tree` may hold real arrays OR jax.ShapeDtypeStruct. Shardings come from
+    `shardings` (pytree of Sharding), or (mesh, specs {key: PartitionSpec})
+    for flat dicts, or the arrays' current shardings.
+    """
+    def one(path_key, leaf):
+        shape = leaf.shape
+        dtype = leaf.dtype
+        sh = None
+        if shardings is not None:
+            sh = shardings[path_key] if isinstance(shardings, dict) else None
+        elif mesh is not None:
+            spec = (specs or {}).get(path_key, P())
+            sh = NamedSharding(mesh, spec)
+        elif isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            sh = leaf.sharding
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = _abstract_target(v, shardings, mesh, specs)
+            else:
+                out[k] = one(k, v)
+        return out
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str):
+    """Save a (possibly sharded) pytree of arrays to `path` (a directory)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), state_dict, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_state_dict(path: str, target=None, mesh: Optional[Mesh] = None,
+                    specs=None, shardings=None):
+    """Load from `path`. With `target` (pytree of arrays or ShapeDtypeStruct)
+    and/or (mesh, specs) the restore reshards onto the requested placement;
+    with nothing it restores as saved (single-process)."""
+    ckptr = _checkpointer()
+    path = os.path.abspath(path)
+    if target is None and mesh is None and shardings is None:
+        return ckptr.restore(path)
+    abstract = _abstract_target(target, shardings=shardings, mesh=mesh,
+                                specs=specs) if target is not None else None
+    return ckptr.restore(path, abstract)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention, async save and auto-resume.
+
+    Parity: the reference launcher's restart-from-checkpoint loop + 2.6's
+    unified dist checkpoint; implemented over orbax.CheckpointManager.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mngr = ocp.CheckpointManager(self._dir, options=self._options)
+
+    def save(self, step: int, state: Dict[str, Any], force: bool = False):
+        import orbax.checkpoint as ocp
+        return self._mngr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+
+    def restore(self, step: Optional[int] = None, target=None,
+                mesh: Optional[Mesh] = None, specs=None):
+        import orbax.checkpoint as ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = (_abstract_target(target, mesh=mesh, specs=specs)
+                    if target is not None else None)
+        if abstract is None:
+            return self._mngr.restore(step)
+        return self._mngr.restore(step,
+                                  args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return self._mngr.all_steps()
+
+    def wait_until_finished(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+
+def save_persistables(model, optimizer=None, path: str = "checkpoint",
+                      opt_state=None):
+    """fleet.save_persistables parity: model (+optimizer) state to `path`."""
+    tree = {"model": model.state_dict()}
+    if opt_state is not None:
+        tree["optimizer"] = opt_state
+    save_state_dict(tree, path)

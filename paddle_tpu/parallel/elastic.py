@@ -1,0 +1,71 @@
+"""Elastic training: failure detection + restart-from-checkpoint.
+
+Reference (SURVEY.md §5-failure): fleet/elastic/manager.py — ElasticManager
+registers ranks in etcd, heartbeats, and on membership change the launcher
+kills and relaunches workers; recovery is restart-from-latest-checkpoint,
+not in-flight repair. Failure detection otherwise = the launcher watch loop
+reaping dead children + NCCL timeouts.
+
+TPU-native: multi-host membership/rendezvous belongs to
+`jax.distributed.initialize` (DCN); what the framework owns is the
+restart-from-checkpoint semantics. `ElasticTrainLoop` supervises a train
+loop in-process: periodic (async) checkpoints via CheckpointManager, crash →
+restore latest → resume, bounded restarts — the same recovery contract,
+testable single-host by injecting faults (SURVEY.md §5: tests kill procs)."""
+
+import logging
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("paddle_tpu.elastic")
+
+
+class ElasticTrainLoop:
+    """Supervised training with checkpoint/resume recovery.
+
+    train_step(state, step) -> state : one (or k) optimizer steps; `state`
+    is any orbax-serializable pytree (e.g. {"model":…, "opt":…}).
+    """
+
+    def __init__(self, checkpoint_manager, train_step: Callable,
+                 init_state: Callable, max_restarts: int = 3,
+                 save_every: int = 100,
+                 restore_target: Optional[Callable] = None):
+        self.mngr = checkpoint_manager
+        self.train_step = train_step
+        self.init_state = init_state
+        self.max_restarts = max_restarts
+        self.save_every = save_every
+        self.restore_target = restore_target
+        self.restarts = 0
+
+    def _resume(self):
+        step = self.mngr.latest_step()
+        if step is None:
+            return self.init_state(), 0
+        target = self.restore_target() if self.restore_target else None
+        state = self.mngr.restore(step, target=target)
+        logger.info("resumed from checkpoint step %d", step)
+        return state, step + 1
+
+    def run(self, total_steps: int):
+        state, start = self._resume()
+        step = start
+        while step < total_steps:
+            try:
+                state = self.train_step(state, step)
+                if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
+                    self.mngr.save(step, state)
+                step += 1
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:   # noqa: BLE001 — supervisor boundary
+                self.restarts += 1
+                logger.warning("train step %d failed (%s); restart %d/%d",
+                               step, e, self.restarts, self.max_restarts)
+                if self.restarts > self.max_restarts:
+                    raise
+                self.mngr.wait_until_finished()
+                state, step = self._resume()
+        self.mngr.wait_until_finished()
+        return state

@@ -1,0 +1,81 @@
+"""Distributed checkpoint: resharding-on-load, manager retention, elastic
+resume with fault injection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu
+from paddle_tpu.parallel.checkpoint import (
+    CheckpointManager,
+    load_state_dict,
+    save_state_dict,
+)
+from paddle_tpu.parallel.elastic import ElasticTrainLoop
+from paddle_tpu.parallel.topology import build_mesh
+
+
+def test_save_sharded_restore_resharded(tmp_path):
+    mesh_a = build_mesh({"mp": 4, "dp": 2})
+    mesh_b = build_mesh({"mp": 2, "dp": 4})
+    w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    state = {"w": jax.device_put(w, NamedSharding(mesh_a, P("mp", None))),
+             "b": jax.device_put(jnp.ones(8), NamedSharding(mesh_a, P()))}
+    save_state_dict(state, str(tmp_path / "ckpt"))
+
+    restored = load_state_dict(str(tmp_path / "ckpt"), target=state,
+                               mesh=mesh_b,
+                               specs={"w": P(None, "mp"), "b": P("dp")})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(w))
+    got = restored["w"].sharding
+    assert got.spec == P(None, "mp")
+    np.testing.assert_array_equal(np.asarray(restored["b"]), np.ones(8))
+
+
+def test_plain_roundtrip(tmp_path):
+    state = {"x": jnp.arange(10.0), "nested": {"y": jnp.ones((2, 3))}}
+    save_state_dict(state, str(tmp_path / "c"))
+    back = load_state_dict(str(tmp_path / "c"))
+    np.testing.assert_array_equal(np.asarray(back["x"]),
+                                  np.asarray(state["x"]))
+    np.testing.assert_array_equal(np.asarray(back["nested"]["y"]),
+                                  np.ones((2, 3)))
+
+
+def test_manager_retention_and_latest(tmp_path):
+    m = CheckpointManager(str(tmp_path / "run"), max_to_keep=2,
+                          async_save=False)
+    for s in range(4):
+        m.save(s, {"v": jnp.full((2,), float(s))})
+    m.wait_until_finished()
+    assert m.latest_step() == 3
+    assert len(m.all_steps()) == 2      # keep-K retention
+    back = m.restore()
+    np.testing.assert_array_equal(np.asarray(back["v"]), [3.0, 3.0])
+    m.close()
+
+
+def test_elastic_loop_resumes_after_crash(tmp_path):
+    m = CheckpointManager(str(tmp_path / "run"), max_to_keep=3,
+                          async_save=False)
+    crashed = {"done": False}
+
+    def init_state():
+        return {"step_sum": jnp.zeros(())}
+
+    def train_step(state, step):
+        if step == 5 and not crashed["done"]:
+            crashed["done"] = True
+            raise RuntimeError("injected fault")
+        return {"step_sum": state["step_sum"] + step}
+
+    loop = ElasticTrainLoop(m, train_step, init_state, max_restarts=2,
+                            save_every=2)
+    final = loop.run(total_steps=8)
+    # crash at step 5 → resume from ckpt of step 4 (saved at (4+1)%2? steps
+    # 1,3,5… save_every=2 saves after steps 1,3,5,7) → no lost progress
+    assert loop.restarts == 1
+    assert float(final["step_sum"]) == sum(range(8))
+    m.close()
