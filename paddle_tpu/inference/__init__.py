@@ -1,0 +1,112 @@
+"""Inference: jitted KV-cache decoding + Predictor veneer.
+
+Reference (SURVEY.md §2.4-inference, §2.2-fusion): AnalysisPredictor loads a
+saved program and runs IR-optimized inference; generation-time decode rides
+the fused_multi_transformer / masked_multihead_attention CUDA kernels.
+
+TPU-native: the whole decode step (all layers, cache update, sampling) is
+ONE jitted program with donated cache buffers — XLA fuses what
+fused_multi_transformer hand-fuses; there is no separate "optimized
+program" artifact because jit compilation IS the optimization pass.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.layer import functional_call
+
+
+def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """logits (b, vocab) → token ids (b,). Greedy when temperature == 0."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
+             top_p=1.0, eos_token_id: Optional[int] = None, seed: int = 0,
+             state: Optional[Dict] = None, cache_dtype=jnp.bfloat16):
+    """Autoregressive generation with a preallocated KV cache.
+
+    model must expose forward(ids, cache=..., start_pos=...) and
+    init_cache(batch, max_len) (LlamaForCausalLM-style). Returns
+    (b, prompt+new) token ids including the prompt.
+    """
+    input_ids = jnp.asarray(input_ids)
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    state = state if state is not None else model.trainable_state()
+    cache = model.init_cache(b, total, dtype=cache_dtype)
+
+    @jax.jit
+    def prefill(state, cache, ids):
+        out, cache = functional_call(model, state, ids, cache=cache,
+                                     start_pos=0)
+        return out[:, -1, :], cache
+
+    @jax.jit
+    def decode_step(state, cache, tok, pos, key):
+        out, cache = functional_call(model, state, tok[:, None], cache=cache,
+                                     start_pos=pos)
+        nxt = _sample_logits(out[:, -1, :], key, temperature, top_k, top_p)
+        return nxt, cache
+
+    logits, cache = prefill(state, cache, input_ids)
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    tok = _sample_logits(logits, k0, temperature, top_k, top_p)
+
+    out_tokens = [tok]
+    finished = np.zeros((b,), bool)
+    for i in range(1, max_new_tokens):
+        if eos_token_id is not None:
+            finished |= np.asarray(tok) == eos_token_id
+            if finished.all():
+                break
+        key, ki = jax.random.split(key)
+        tok, cache = decode_step(state, cache, tok, prompt_len + i - 1, ki)
+        out_tokens.append(tok)
+
+    return jnp.concatenate([input_ids] + [t[:, None] for t in out_tokens],
+                           axis=1)
+
+
+class Predictor:
+    """AnalysisPredictor parity: load a saved model + config, run jitted
+    batched forward."""
+
+    def __init__(self, model, state: Optional[Dict] = None):
+        self.model = model
+        self.state = state if state is not None else model.trainable_state()
+        self._fwd = jax.jit(
+            lambda st, *args, **kw: functional_call(model, st, *args, **kw))
+
+    @classmethod
+    def from_checkpoint(cls, model, path):
+        from paddle_tpu.framework.io import load
+        sd = load(path)
+        model.set_state_dict(sd)
+        return cls(model)
+
+    def run(self, *args, **kwargs):
+        return self._fwd(self.state, *args, **kwargs)
+
+    __call__ = run
+
+    def generate(self, input_ids, **kwargs):
+        return generate(self.model, input_ids, state=self.state, **kwargs)

@@ -106,16 +106,36 @@ class LlamaAttention(nn.Layer):
             has_bias=False, input_is_parallel=True)
         self.cfg = cfg
 
-    def forward(self, x, cos=None, sin=None, attn_mask=None):
+    def forward(self, x, cos=None, sin=None, attn_mask=None, cache=None,
+                start_pos=0):
         cfg = self.cfg
         b, s, _ = x.shape
         q = self.q_proj(x).reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = self.k_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         v = self.v_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         if cos is None or sin is None:
-            cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+            pos = start_pos + jnp.arange(s)
+            cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim,
+                                             base=cfg.rope_base,
+                                             position_ids=pos)
         q = rope_ops.apply_rotary_pos_emb(q, cos, sin)
         k = rope_ops.apply_rotary_pos_emb(k, cos, sin)
+        if cache is not None:
+            # decode: write k/v at [start_pos, start_pos+s), attend to the
+            # filled prefix (static max length, position-masked)
+            import jax as _jax
+            k_cache = _jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], k.astype(cache["k"].dtype), start_pos, axis=1)
+            v_cache = _jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], v.astype(cache["v"].dtype), start_pos, axis=1)
+            max_len = k_cache.shape[1]
+            q_pos = start_pos + jnp.arange(s)[:, None]          # (s, 1)
+            k_pos = jnp.arange(max_len)[None, :]                 # (1, max)
+            mask = (k_pos <= q_pos)[None, None]                  # causal+fill
+            out = F.scaled_dot_product_attention(
+                q, k_cache, v_cache, attn_mask=mask, is_causal=False)
+            out = self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
+            return out, {"k": k_cache, "v": v_cache}
         if cfg.context_parallel:
             from paddle_tpu.parallel.context_parallel import (
                 context_parallel_attention)
@@ -157,7 +177,15 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos=None, sin=None, attn_mask=None):
+    def forward(self, x, cos=None, sin=None, attn_mask=None, cache=None,
+                start_pos=0):
+        if cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x), cos,
+                                             sin, attn_mask, cache=cache,
+                                             start_pos=start_pos)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -174,11 +202,20 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, start_pos=0):
         cfg = self.cfg
         s = input_ids.shape[1]
-        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+        pos = start_pos + jnp.arange(s) if cache is not None else None
+        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base,
+                                         position_ids=pos)
         x = self.embed_tokens(input_ids)
+        if cache is not None:
+            new_cache = []
+            for i, layer in enumerate(self.layers):
+                x, c = layer(x, cos, sin, attn_mask, cache=cache[i],
+                             start_pos=start_pos)
+                new_cache.append(c)
+            return self.norm(x), new_cache
         for layer in self.layers:
             x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
@@ -256,12 +293,26 @@ class LlamaForCausalLM(CausalLMBase):
                 has_bias=False, gather_output=False)
         self.loss_fn = mp.ParallelCrossEntropy()
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, start_pos=0):
+        if cache is not None:
+            x, new_cache = self.model(input_ids, attn_mask, cache=cache,
+                                      start_pos=start_pos)
+            return self._unembed(x), new_cache
         x = self.model(input_ids, attn_mask)
+        return self._unembed(x)
+
+    def _unembed(self, x):
         if self.cfg.tie_word_embeddings:
             logits = jnp.matmul(x, self.model.embed_tokens.weight.T)
             return mp.constrain(logits, mp._last_dim_spec(mp.MP_AXIS))
         return self.lm_head(x)
+
+    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
+        """Preallocated KV cache: one {'k','v'} buffer pair per layer."""
+        cfg = self.cfg
+        shape = (batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for _ in range(cfg.num_layers)]
 
     def loss(self, logits, labels):
         # reduction='mean' divides by the count of non-ignored labels
