@@ -1,0 +1,76 @@
+// Native data-pipeline kernels for paddle_tpu.
+//
+// Reference (SURVEY.md §2.7-data): the reference backs paddle.io.DataLoader
+// with C++ reader ops and shared-memory worker queues
+// (paddle/fluid/operators/reader/, python/paddle/io/). On TPU the device
+// side is jax; the host-side hot loops — deterministic epoch shuffling and
+// packing tokenized documents into fixed-length training rows — are the
+// native surface, implemented here and exposed through ctypes
+// (paddle_tpu/io/native.py), with NumPy fallbacks when no toolchain exists.
+//
+// Build: g++ -O3 -shared -fPIC -o libpaddle_tpu_data.so data_pipeline.cc
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// splitmix64 — deterministic, seed-stable across platforms
+static inline uint64_t next_rand(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Fisher-Yates over an index array (epoch shuffle).
+void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+  uint64_t s = seed ^ 0xda3e39cb94b95bdbULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = next_rand(&s) % static_cast<uint64_t>(i + 1);
+    int64_t t = idx[i];
+    idx[i] = idx[j];
+    idx[j] = t;
+  }
+}
+
+// Pack documents (concatenated token stream + offsets) into fixed-length
+// rows, separated by eos_id, documents taken in doc_order. Rows are filled
+// greedily and split across row boundaries (standard LM pretrain packing).
+// Returns the number of rows fully written.
+int64_t pack_documents(const int32_t* tokens, const int64_t* doc_offsets,
+                       int64_t n_docs, const int64_t* doc_order,
+                       int32_t* out, int64_t rows, int64_t row_len,
+                       int32_t eos_id) {
+  int64_t r = 0, c = 0;
+  for (int64_t d = 0; d < n_docs && r < rows; ++d) {
+    int64_t doc = doc_order ? doc_order[d] : d;
+    int64_t beg = doc_offsets[doc], end = doc_offsets[doc + 1];
+    for (int64_t t = beg; t < end && r < rows; ++t) {
+      out[r * row_len + c] = tokens[t];
+      if (++c == row_len) { c = 0; ++r; }
+    }
+    if (r >= rows) break;
+    out[r * row_len + c] = eos_id;
+    if (++c == row_len) { c = 0; ++r; }
+  }
+  // pad the trailing partial row with eos
+  if (r < rows && c > 0) {
+    for (; c < row_len; ++c) out[r * row_len + c] = eos_id;
+    ++r;
+  }
+  return r;
+}
+
+// Gather rows from a flat token buffer: out[i] = tokens[idx[i]*row_len ..]
+// (shuffled batch assembly without Python-loop copies).
+void gather_rows(const int32_t* tokens, const int64_t* idx, int64_t n_rows,
+                 int64_t row_len, int32_t* out) {
+  for (int64_t i = 0; i < n_rows; ++i) {
+    std::memcpy(out + i * row_len, tokens + idx[i] * row_len,
+                sizeof(int32_t) * static_cast<size_t>(row_len));
+  }
+}
+
+}  // extern "C"

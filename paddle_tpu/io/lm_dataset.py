@@ -1,0 +1,54 @@
+"""LM pretrain data: packed fixed-length rows over tokenized documents.
+
+The packing/shuffle/gather hot loops run in native code
+(csrc/data_pipeline.cc via io.native); this module is the Dataset-level
+veneer used by the pretrain configs (BASELINE GPT-2/Llama)."""
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+from paddle_tpu.io import native
+
+
+class PackedTokenDataset(Dataset):
+    """Documents → eos-joined packed rows of seq_len+1 tokens; __getitem__
+    yields {'input': (seq_len,), 'labels': (seq_len,)} shifted pairs."""
+
+    def __init__(self, tokens, doc_offsets=None, seq_len: int = 1024,
+                 eos_id: int = 0, shuffle_docs: bool = False, seed: int = 0):
+        tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+        if doc_offsets is None:
+            doc_offsets = np.asarray([0, tokens.size], dtype=np.int64)
+        order = None
+        if shuffle_docs:
+            order = native.shuffle_indices(len(doc_offsets) - 1, seed)
+        self.rows = native.pack_documents(tokens, doc_offsets, seq_len + 1,
+                                          eos_id, doc_order=order)
+        self.seq_len = seq_len
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, idx) -> Dict[str, np.ndarray]:
+        row = self.rows[idx]
+        return {"input": row[:-1], "labels": row[1:]}
+
+    def epoch_batches(self, batch_size: int, seed: int = 0,
+                      drop_last: bool = True):
+        """Fast path: native shuffle + native row gather, no per-sample
+        Python loop (the C++ buffered-reader analog for in-memory data)."""
+        idx = native.shuffle_indices(len(self.rows), seed)
+        n = (len(idx) // batch_size) * batch_size if drop_last else len(idx)
+        for i in range(0, n, batch_size):
+            batch = native.gather_rows(self.rows, idx[i:i + batch_size])
+            yield {"input": batch[:, :-1], "labels": batch[:, 1:]}
+
+
+def from_token_file(path: str, seq_len: int = 1024, eos_id: int = 0,
+                    dtype=np.uint16) -> PackedTokenDataset:
+    """Memory-mapped flat token file (GPT-2-style .bin) → packed dataset."""
+    toks = np.memmap(path, dtype=dtype, mode="r")
+    return PackedTokenDataset(np.asarray(toks, dtype=np.int32),
+                              seq_len=seq_len, eos_id=eos_id)

@@ -1,0 +1,138 @@
+"""ctypes bridge to the native data-pipeline kernels (csrc/data_pipeline.cc).
+
+Builds the shared library on first use with g++ (cached next to csrc/);
+every entry point has a NumPy fallback so the package works without a
+toolchain. The reference's analogous native surface is the C++ reader-op /
+shared-memory DataLoader stack (SURVEY.md §2.7-data)."""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("paddle_tpu.io.native")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _csrc_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def _build_and_load():
+    src = os.path.join(_csrc_dir(), "data_pipeline.cc")
+    out = os.path.join(_csrc_dir(), "libpaddle_tpu_data.so")
+    if not os.path.exists(src):
+        return None
+    if (not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(src)):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", out, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native data pipeline build failed (%s); "
+                           "using NumPy fallbacks", e)
+            return None
+    lib = ctypes.CDLL(out)
+    lib.shuffle_indices.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64]
+    lib.pack_documents.restype = ctypes.c_int64
+    lib.pack_documents.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32]
+    lib.gather_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+    return lib
+
+
+def get_lib():
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _tried = True
+            _lib = _build_and_load()
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic epoch-shuffled index permutation of [0, n)."""
+    idx = np.arange(n, dtype=np.int64)
+    lib = get_lib()
+    if lib is not None:
+        lib.shuffle_indices(_i64p(idx), n, np.uint64(seed))
+        return idx
+    rs = np.random.RandomState(np.uint32(seed & 0xFFFFFFFF))
+    rs.shuffle(idx)
+    return idx
+
+
+def pack_documents(tokens: np.ndarray, doc_offsets: np.ndarray, row_len: int,
+                   eos_id: int, doc_order: np.ndarray = None) -> np.ndarray:
+    """Pack a concatenated token stream into (rows, row_len) int32 training
+    rows with eos separators; documents split across row boundaries."""
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    doc_offsets = np.ascontiguousarray(doc_offsets, dtype=np.int64)
+    n_docs = len(doc_offsets) - 1
+    total = int(tokens.size + n_docs)     # tokens + eos per doc
+    rows = (total + row_len - 1) // row_len
+    out = np.full((rows, row_len), eos_id, dtype=np.int32)
+    lib = get_lib()
+    if lib is not None:
+        order_p = (_i64p(np.ascontiguousarray(doc_order, dtype=np.int64))
+                   if doc_order is not None else
+                   ctypes.POINTER(ctypes.c_int64)())
+        if doc_order is not None:
+            doc_order = np.ascontiguousarray(doc_order, dtype=np.int64)
+            order_p = _i64p(doc_order)
+        written = lib.pack_documents(_i32p(tokens), _i64p(doc_offsets),
+                                     n_docs, order_p, _i32p(out), rows,
+                                     row_len, eos_id)
+        return out[:written]
+    # NumPy fallback
+    order = doc_order if doc_order is not None else np.arange(n_docs)
+    stream = []
+    for d in order:
+        stream.append(tokens[doc_offsets[d]:doc_offsets[d + 1]])
+        stream.append(np.asarray([eos_id], np.int32))
+    flat = np.concatenate(stream) if stream else np.zeros(0, np.int32)
+    n_full = min(len(flat) // row_len, rows)
+    out[:n_full] = flat[:n_full * row_len].reshape(n_full, row_len)
+    rem = flat[n_full * row_len:]
+    if len(rem) and n_full < rows:
+        out[n_full, :len(rem)] = rem
+        return out[:n_full + 1]
+    return out[:n_full]
+
+
+def gather_rows(tokens: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """tokens (N, row_len) int32, idx (b,) → (b, row_len) batch."""
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty((len(idx), tokens.shape[1]), dtype=np.int32)
+        lib.gather_rows(_i32p(tokens), _i64p(idx), len(idx),
+                        tokens.shape[1], _i32p(out))
+        return out
+    return tokens[idx]

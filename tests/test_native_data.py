@@ -1,0 +1,81 @@
+"""Native data-pipeline kernels: build, correctness, fallback parity."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import native
+from paddle_tpu.io.lm_dataset import PackedTokenDataset
+
+
+def test_native_lib_builds():
+    assert native.native_available(), \
+        "g++ is present in this image; the native lib must build"
+
+
+def test_shuffle_deterministic_and_permutation():
+    a = native.shuffle_indices(100, seed=7)
+    b = native.shuffle_indices(100, seed=7)
+    c = native.shuffle_indices(100, seed=8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    np.testing.assert_array_equal(np.sort(a), np.arange(100))
+
+
+def test_pack_documents_matches_fallback():
+    rng = np.random.RandomState(0)
+    docs = [rng.randint(1, 100, rng.randint(3, 40)).astype(np.int32)
+            for _ in range(13)]
+    tokens = np.concatenate(docs)
+    offsets = np.zeros(len(docs) + 1, np.int64)
+    offsets[1:] = np.cumsum([len(d) for d in docs])
+
+    rows_native = native.pack_documents(tokens, offsets, 16, eos_id=0)
+    lib = native._lib
+    try:
+        native._lib = None        # force NumPy fallback
+        rows_py = native.pack_documents(tokens, offsets, 16, eos_id=0)
+    finally:
+        native._lib = lib
+    np.testing.assert_array_equal(rows_native, rows_py)
+    # every token present exactly once (packing loses nothing)
+    flat = rows_native.ravel()
+    nonzero = flat[flat != 0]
+    np.testing.assert_array_equal(np.sort(nonzero), np.sort(tokens))
+
+
+def test_gather_rows():
+    rows = np.arange(40, dtype=np.int32).reshape(10, 4)
+    idx = np.asarray([3, 1, 7])
+    got = native.gather_rows(rows, idx)
+    np.testing.assert_array_equal(got, rows[idx])
+
+
+def test_packed_dataset_end_to_end():
+    rng = np.random.RandomState(1)
+    docs = [rng.randint(1, 50, rng.randint(5, 30)).astype(np.int32)
+            for _ in range(8)]
+    tokens = np.concatenate(docs)
+    offsets = np.zeros(len(docs) + 1, np.int64)
+    offsets[1:] = np.cumsum([len(d) for d in docs])
+
+    ds = PackedTokenDataset(tokens, offsets, seq_len=8, eos_id=0)
+    s = ds[0]
+    assert s["input"].shape == (8,) and s["labels"].shape == (8,)
+    np.testing.assert_array_equal(s["input"][1:], s["labels"][:-1])
+
+    batches = list(ds.epoch_batches(batch_size=2, seed=0))
+    assert batches and batches[0]["input"].shape == (2, 8)
+    # shifted-pair invariant holds through the native gather
+    b0 = batches[0]
+    np.testing.assert_array_equal(b0["input"][:, 1:], b0["labels"][:, :-1])
+
+
+def test_dataloader_with_packed_dataset():
+    from paddle_tpu.io import DataLoader
+    rng = np.random.RandomState(2)
+    tokens = rng.randint(1, 50, 300).astype(np.int32)
+    ds = PackedTokenDataset(tokens, seq_len=10, eos_id=0)
+    dl = DataLoader(ds, batch_size=4, shuffle=True, drop_last=True,
+                    num_workers=2)
+    batches = list(dl)
+    assert batches and batches[0]["input"].shape == (4, 10)
