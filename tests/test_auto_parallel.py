@@ -1,0 +1,94 @@
+"""Semi-auto API: shard_tensor placements, Engine.fit, launch env."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu
+from paddle_tpu.parallel.auto_parallel import (
+    Engine,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    get_placements,
+    shard_tensor,
+)
+from paddle_tpu.parallel import fleet
+from paddle_tpu.parallel.strategy import DistributedStrategy
+from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+
+def test_shard_tensor_placements_roundtrip():
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    w = jnp.arange(32.0).reshape(4, 8)
+    placed = shard_tensor(w, mesh, [Shard(0), Shard(1)])
+    assert placed.sharding.spec == P("x", "y")
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(w))
+    back = get_placements(placed, mesh)
+    assert back == [Shard(0), Shard(1)]
+
+    r = shard_tensor(w, mesh, [Replicate(), Shard(0)])
+    assert r.sharding.spec == P("y", None)
+
+
+def test_engine_fit_decreases_loss():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                        "sharding_degree": 2}
+    s.sharding = True
+    s.sharding_configs.stage = 2
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = LlamaConfig.tiny()
+        paddle_tpu.seed(0)
+        model = LlamaForCausalLM(cfg)
+        eng = Engine(model, loss=model.loss,
+                     optimizer=AdamW(learning_rate=2e-3), strategy=s)
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 17))
+        batch = {"input": jnp.asarray(ids[:, :-1]),
+                 "labels": jnp.asarray(ids[:, 1:])}
+        hist = eng.fit([batch] * 10, epochs=1, log_interval=1)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_engine_save_load(tmp_path):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = LlamaConfig.tiny()
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    eng = Engine(model, loss=model.loss, optimizer=AdamW(learning_rate=1e-3))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 17))
+    batch = {"input": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}
+    eng.fit([batch] * 2, epochs=1, log_interval=1)
+    eng.save(str(tmp_path / "engine_ckpt"))
+
+    w_before = np.asarray(eng.state["model.embed_tokens.weight"])
+    eng.fit([batch] * 2, epochs=1, log_interval=1)
+    eng.load(str(tmp_path / "engine_ckpt"))
+    np.testing.assert_array_equal(
+        np.asarray(eng.state["model.embed_tokens.weight"]), w_before)
+    set_hybrid_communicate_group(None)
+
+
+def _spawn_worker(rank, total):
+    import os
+    assert os.environ["PADDLE_TRAINER_ID"] == str(rank)
+    assert os.environ["PADDLE_TRAINERS_NUM"] == str(total)
+
+
+def test_spawn_sets_env():
+    from paddle_tpu.parallel.launch import spawn
+    spawn(_spawn_worker, args=(2,), nprocs=2)
