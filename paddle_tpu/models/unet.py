@@ -1,0 +1,279 @@
+"""Stable-Diffusion UNet (BASELINE config #5: "conv+attn Phi fusion → Pallas").
+
+Capability reference: ppdiffusers' UNet2DConditionModel rides the reference's
+conv/fused-attention kernels (SURVEY.md §2.7 note). TPU-first: convs lower to
+XLA's MXU conv path; the spatial/cross attention reuses
+F.scaled_dot_product_attention (Pallas flash path on TPU); GroupNorm+SiLU
+chains are XLA-fused.
+
+Structure (SD 1.x): sinusoidal timestep embedding → MLP; down/up blocks of
+[ResBlock, SpatialTransformer(self-attn + cross-attn to text context)] with
+skip connections; NCHW layout like the reference.
+"""
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    model_channels: int = 320
+    channel_mult: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    attention_levels: Tuple[int, ...] = (0, 1, 2)   # levels with transformers
+    num_heads: int = 8
+    context_dim: Optional[int] = 768                 # None → self-attn only
+    groups: int = 32
+
+    @classmethod
+    def sd15(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(in_channels=4, out_channels=4, model_channels=32,
+                   channel_mult=(1, 2), num_res_blocks=1,
+                   attention_levels=(1,), num_heads=4, context_dim=16,
+                   groups=8)
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal embeddings (b,) → (b, dim)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_ch), in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.temb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(min(groups, out_ch), out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.skip = (nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch
+                     else nn.Identity())
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.temb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        return self.skip(x) + h
+
+
+class _CrossAttention(nn.Layer):
+    def __init__(self, dim, ctx_dim, num_heads):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.to_q = nn.Linear(dim, dim, bias_attr=False)
+        self.to_k = nn.Linear(ctx_dim, dim, bias_attr=False)
+        self.to_v = nn.Linear(ctx_dim, dim, bias_attr=False)
+        self.to_out = nn.Linear(dim, dim)
+
+    def forward(self, x, ctx=None):
+        ctx = x if ctx is None else ctx
+        b, s, _ = x.shape
+        sk = ctx.shape[1]
+        q = self.to_q(x).reshape(b, s, self.num_heads, self.head_dim)
+        k = self.to_k(ctx).reshape(b, sk, self.num_heads, self.head_dim)
+        v = self.to_v(ctx).reshape(b, sk, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(q, k, v)
+        return self.to_out(out.reshape(b, s, -1))
+
+
+class _GEGLU(nn.Layer):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = nn.Linear(dim, inner * 2)
+        self.out = nn.Linear(inner, dim)
+
+    def forward(self, x):
+        a, g = jnp.split(self.proj(x), 2, axis=-1)
+        return self.out(a * F.gelu(g))
+
+
+class SpatialTransformer(nn.Layer):
+    """GN → 1x1 in → [self-attn, cross-attn, GEGLU-FF] → 1x1 out (+residual)."""
+
+    def __init__(self, ch, num_heads, ctx_dim, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(min(groups, ch), ch)
+        self.proj_in = nn.Conv2D(ch, ch, 1)
+        self.norm1 = nn.LayerNorm(ch)
+        self.attn1 = _CrossAttention(ch, ch, num_heads)
+        self.norm2 = nn.LayerNorm(ch)
+        self.attn2 = _CrossAttention(ch, ctx_dim if ctx_dim else ch, num_heads)
+        self.norm3 = nn.LayerNorm(ch)
+        self.ff = _GEGLU(ch, 4 * ch)
+        self.proj_out = nn.Conv2D(ch, ch, 1)
+        self.has_ctx = ctx_dim is not None
+
+    def forward(self, x, ctx=None):
+        b, c, h, w = x.shape
+        res = x
+        y = self.proj_in(self.norm(x))
+        y = y.reshape(b, c, h * w).transpose(0, 2, 1)        # (b, hw, c)
+        y = y + self.attn1(self.norm1(y))
+        y = y + self.attn2(self.norm2(y), ctx if self.has_ctx else None)
+        y = y + self.ff(self.norm3(y))
+        y = y.transpose(0, 2, 1).reshape(b, c, h, w)
+        return res + self.proj_out(y)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest",
+                          data_format="NCHW")
+        return self.conv(x)
+
+
+class UNetModel(nn.Layer):
+    def __init__(self, cfg: UNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        mc = cfg.model_channels
+        temb_ch = mc * 4
+        self.time_mlp1 = nn.Linear(mc, temb_ch)
+        self.time_mlp2 = nn.Linear(temb_ch, temb_ch)
+        self.conv_in = nn.Conv2D(cfg.in_channels, mc, 3, padding=1)
+
+        chans = [mc]
+        ch = mc
+        self.down_blocks = nn.LayerList()
+        self.down_attns = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = mc * mult
+            for _ in range(cfg.num_res_blocks):
+                self.down_blocks.append(ResBlock(ch, out_ch, temb_ch,
+                                                 cfg.groups))
+                ch = out_ch
+                self.down_attns.append(
+                    SpatialTransformer(ch, cfg.num_heads, cfg.context_dim,
+                                       cfg.groups)
+                    if level in cfg.attention_levels else nn.Identity())
+                chans.append(ch)
+            if level != len(cfg.channel_mult) - 1:
+                self.downsamplers.append(Downsample(ch))
+                chans.append(ch)
+            else:
+                self.downsamplers.append(nn.Identity())
+
+        self.mid_block1 = ResBlock(ch, ch, temb_ch, cfg.groups)
+        self.mid_attn = SpatialTransformer(ch, cfg.num_heads, cfg.context_dim,
+                                           cfg.groups)
+        self.mid_block2 = ResBlock(ch, ch, temb_ch, cfg.groups)
+
+        self.up_blocks = nn.LayerList()
+        self.up_attns = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        for level, mult in reversed(list(enumerate(cfg.channel_mult))):
+            out_ch = mc * mult
+            for i in range(cfg.num_res_blocks + 1):
+                skip = chans.pop()
+                self.up_blocks.append(ResBlock(ch + skip, out_ch, temb_ch,
+                                               cfg.groups))
+                ch = out_ch
+                self.up_attns.append(
+                    SpatialTransformer(ch, cfg.num_heads, cfg.context_dim,
+                                       cfg.groups)
+                    if level in cfg.attention_levels else nn.Identity())
+            if level != 0:
+                self.upsamplers.append(Upsample(ch))
+            else:
+                self.upsamplers.append(nn.Identity())
+
+        self.norm_out = nn.GroupNorm(min(cfg.groups, ch), ch)
+        self.conv_out = nn.Conv2D(ch, cfg.out_channels, 3, padding=1)
+
+    def forward(self, x, timesteps, context=None):
+        cfg = self.cfg
+        temb = timestep_embedding(timesteps, cfg.model_channels)
+        temb = self.time_mlp2(F.silu(self.time_mlp1(temb)))
+
+        h = self.conv_in(x)
+        skips = [h]
+        bi = 0
+        for level in range(len(cfg.channel_mult)):
+            for _ in range(cfg.num_res_blocks):
+                h = self.down_blocks[bi](h, temb)
+                attn = self.down_attns[bi]
+                h = attn(h, context) if isinstance(
+                    attn, SpatialTransformer) else attn(h)
+                skips.append(h)
+                bi += 1
+            ds = self.downsamplers[level]
+            if not isinstance(ds, nn.Identity):
+                h = ds(h)
+                skips.append(h)
+
+        h = self.mid_block1(h, temb)
+        h = self.mid_attn(h, context)
+        h = self.mid_block2(h, temb)
+
+        bi = 0
+        for li, level in enumerate(reversed(range(len(cfg.channel_mult)))):
+            for _ in range(cfg.num_res_blocks + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=1)
+                h = self.up_blocks[bi](h, temb)
+                attn = self.up_attns[bi]
+                h = attn(h, context) if isinstance(
+                    attn, SpatialTransformer) else attn(h)
+                bi += 1
+            us = self.upsamplers[li]
+            if not isinstance(us, nn.Identity):
+                h = us(h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+
+def ddpm_loss(model_or_state, model, x0, t, noise, context=None,
+              alphas_cumprod=None):
+    """ε-prediction MSE (the SD pretrain objective)."""
+    import jax
+    from paddle_tpu.nn.layer import functional_call
+    a = alphas_cumprod[t][:, None, None, None]
+    xt = jnp.sqrt(a) * x0 + jnp.sqrt(1.0 - a) * noise
+    if isinstance(model_or_state, dict):
+        eps = functional_call(model, model_or_state, xt, t, context)
+    else:
+        eps = model(xt, t, context)
+    return jnp.mean((eps - noise) ** 2)
+
+
+def cosine_alphas_cumprod(T=1000, s=0.008):
+    t = jnp.arange(T + 1, dtype=jnp.float32) / T
+    f = jnp.cos((t + s) / (1 + s) * math.pi / 2) ** 2
+    return jnp.clip(f[1:] / f[0], 1e-5, 1.0)
