@@ -48,7 +48,7 @@ def main():
     model = GPTPretrainModel(cfg).bfloat16()
     n_params = model.num_params()
 
-    B, S = (2, 1024) if on_tpu else (2, 256)
+    B, S = (4, 1024) if on_tpu else (2, 256)
     opt = AdamW(learning_rate=1e-4)
     state = model.trainable_state()
     opt_state = opt.init_state(state)

@@ -76,11 +76,35 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             and q.shape[1] == k.shape[1] and q.shape[1] >= 1024
             and q.shape[1] % 512 == 0 and q.shape[-1] in (64, 128, 256)):
         try:
-            return _flash_attention_pallas(q, k, v, is_causal, scale)
+            return _flash_attention_vjp(q, k, v, is_causal, scale)
         except Exception:
             pass
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           scale=scale, dropout_p=dropout_p, training=training)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_vjp(q, k, v, is_causal, scale):
+    """Pallas forward; backward recomputes through the XLA composition (a
+    dedicated Pallas backward kernel is a later optimization — the forward
+    is where inference/prefill time goes)."""
+    return _flash_attention_pallas(q, k, v, is_causal, scale)
+
+
+def _flash_vjp_fwd(q, k, v, is_causal, scale):
+    return _flash_attention_pallas(q, k, v, is_causal, scale), (q, k, v)
+
+
+def _flash_vjp_bwd(is_causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, is_causal=is_causal,
+                                          scale=scale, dropout_p=0.0),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 # ---- Pallas blockwise flash kernel ----------------------------------------
@@ -96,6 +120,11 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
         v = _repeat_kv(v, n_rep)
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
+    # TPU tiling wants the trailing block dims to be (seq, head_dim)
+    qt = jnp.transpose(q, (0, 2, 1, 3))     # (b, h, s, d)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
     blk_q = min(512, s)
     blk_k = min(512, s)
     grid = (b, h, s // blk_q)
@@ -106,8 +135,8 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
 
         def body(ki, carry):
             acc, m_prev, l_prev = carry
-            kv = pl.load(k_ref, (pl.dslice(ki * blk_k, blk_k), slice(None))).astype(jnp.float32)
-            vv = pl.load(v_ref, (pl.dslice(ki * blk_k, blk_k), slice(None))).astype(jnp.float32)
+            kv = k_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
+            vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = qv @ kv.T  # (blk_q, blk_k)
             if is_causal:
                 q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
@@ -124,6 +153,7 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
         m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
         l0 = jnp.zeros((blk_q,), jnp.float32)
         if is_causal:
+            # only blocks at or below the diagonal contribute
             n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k else (qi * blk_q) // blk_k + 1
         else:
             n_k = s // blk_k
@@ -134,11 +164,12 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, blk_q, None, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
-            pl.BlockSpec((None, s, None, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
-            pl.BlockSpec((None, s, None, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, blk_q, None, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
-    )(q, k, v)
-    return out
+        out_specs=pl.BlockSpec((None, None, blk_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
