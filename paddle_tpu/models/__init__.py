@@ -17,3 +17,9 @@ from paddle_tpu.models.mixtral import (  # noqa: F401
     MixtralModel,
     MixtralForCausalLM,
 )
+from paddle_tpu.models.ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieModel,
+    ErnieForPretraining,
+)
+from paddle_tpu.models.unet import UNetConfig, UNetModel  # noqa: F401

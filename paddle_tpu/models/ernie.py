@@ -1,0 +1,166 @@
+"""ERNIE 3.0 (Titan-style) — semi-auto parallel config (BASELINE config #3).
+
+Capability reference: ERNIE-3.0's unified pretraining splits a big shared
+"universal representation" transformer from thin task-specific modules (NLU
+masked-LM with bidirectional attention; NLG causal) — trained on the
+reference substrate via the auto_parallel engine (SURVEY.md §6 configs).
+
+This implementation: a bidirectional encoder backbone built from the TP
+layers + a causal NLG branch sharing the backbone, masked-LM and causal-LM
+losses. Run it under parallel.auto.Engine on a TPU mesh — the semi-auto
+path (shard_tensor placements + GSPMD propagation) is exactly what the
+reference's Completer/Partitioner/Resharder pipeline produces."""
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.parallel import mp_layers as mp
+
+
+@dataclasses.dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12        # universal representation depth
+    num_task_layers: int = 2           # task-specific (NLU/NLG) depth
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+
+    @classmethod
+    def tiny(cls, vocab_size=256):
+        return cls(vocab_size=vocab_size, hidden_size=64,
+                   num_hidden_layers=2, num_task_layers=1, num_heads=4,
+                   intermediate_size=128, max_position_embeddings=64,
+                   hidden_dropout_prob=0.0)
+
+    @classmethod
+    def ernie3_titan(cls):
+        # 260B-class: 48 shared + 12 task layers, hidden 12288 (paper scale)
+        return cls(vocab_size=40000, hidden_size=12288,
+                   num_hidden_layers=48, num_task_layers=12, num_heads=96,
+                   intermediate_size=49152, max_position_embeddings=2048)
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        w = init.Normal(0.0, cfg.initializer_range)
+        self.qkv = mp.ColumnParallelLinear(h, 3 * h, weight_attr=w,
+                                           gather_output=False)
+        self.out = mp.RowParallelLinear(h, h, weight_attr=w,
+                                        input_is_parallel=True)
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+
+    def forward(self, x, attn_mask=None, causal=False):
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=causal)
+        return self.out(out.reshape(b, s, h))
+
+
+class ErnieLayer(nn.Layer):
+    """Post-norm encoder block (BERT/ERNIE convention)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        w = init.Normal(0.0, cfg.initializer_range)
+        self.attn = ErnieSelfAttention(cfg)
+        self.norm1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.fc1 = mp.ColumnParallelLinear(h, cfg.intermediate_size,
+                                           weight_attr=w, gather_output=False)
+        self.fc2 = mp.RowParallelLinear(cfg.intermediate_size, h,
+                                        weight_attr=w, input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None, causal=False):
+        x = self.norm1(x + self.dropout(self.attn(x, attn_mask, causal)))
+        x = self.norm2(x + self.dropout(self.fc2(F.gelu(self.fc1(x)))))
+        return x
+
+
+class ErnieModel(nn.Layer):
+    """Shared universal-representation backbone."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        w = init.Normal(0.0, cfg.initializer_range)
+        self.word_emb = mp.VocabParallelEmbedding(cfg.vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=w)
+        self.pos_emb = nn.Embedding(cfg.max_position_embeddings,
+                                    cfg.hidden_size, weight_attr=w)
+        self.type_emb = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size,
+                                     weight_attr=w)
+        self.emb_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.layers = nn.LayerList([ErnieLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None,
+                causal=False):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.word_emb(input_ids) + self.pos_emb(pos)
+        if token_type_ids is not None:
+            x = x + self.type_emb(token_type_ids)
+        x = self.dropout(self.emb_norm(x))
+        for layer in self.layers:
+            x = layer(x, attn_mask, causal)
+        return x
+
+
+class ErnieForPretraining(nn.Layer):
+    """NLU branch (bidirectional masked-LM) + NLG branch (causal LM), both
+    over the shared backbone — the ERNIE 3.0 task split."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.nlu_layers = nn.LayerList([ErnieLayer(cfg)
+                                        for _ in range(cfg.num_task_layers)])
+        self.nlg_layers = nn.LayerList([ErnieLayer(cfg)
+                                        for _ in range(cfg.num_task_layers)])
+        w = init.Normal(0.0, cfg.initializer_range)
+        self.mlm_head = mp.ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, weight_attr=w, has_bias=False,
+            gather_output=False)
+        self.loss_fn = mp.ParallelCrossEntropy()
+
+    def forward(self, input_ids, token_type_ids=None, branch="nlu"):
+        causal = branch == "nlg"
+        x = self.ernie(input_ids, token_type_ids, causal=causal)
+        task_layers = self.nlg_layers if causal else self.nlu_layers
+        for layer in task_layers:
+            x = layer(x, causal=causal)
+        return self.mlm_head(x)
+
+    def loss(self, logits, labels):
+        """labels: ignore_index=-100 marks unmasked positions (MLM) or
+        padding (NLG)."""
+        return self.loss_fn(logits, labels, reduction="mean")
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())

@@ -140,3 +140,59 @@ class GPTPretrainModel(nn.Layer):
     def num_params(self):
         import numpy as np
         return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+    def pipeline_parts(self):
+        """Factor for the SPMD pipeline (parallel.pipeline)."""
+        from paddle_tpu.nn.layer import functional_call
+        from paddle_tpu.parallel.pipeline import PipelineParts, part_specs
+
+        if self.cfg.tie_word_embeddings:
+            raise ValueError(
+                "pipeline_parts requires tie_word_embeddings=False (tied "
+                "embed/head across stages needs SharedLayerDesc-style grad "
+                "sync; set GPTConfig.tie_word_embeddings=False)")
+        embed = _GPTEmbed(self.gpt.wte, self.gpt.wpe, self.gpt.drop)
+        blocks = list(self.gpt.h)
+        template = blocks[0]
+        head = _GPTHead(self.gpt.ln_f, self.lm_head, self.loss)
+
+        def embed_apply(st, ids):
+            return functional_call(embed, st, ids)
+
+        def block_apply(st, h):
+            return functional_call(template, st, h)
+
+        def head_apply(st, h, labels):
+            return functional_call(head, st, h, labels)
+
+        return PipelineParts(
+            embed_state=embed.trainable_state(),
+            embed_apply=embed_apply,
+            block_states=[b.trainable_state() for b in blocks],
+            block_apply=block_apply,
+            head_state=head.trainable_state(),
+            head_apply=head_apply,
+            embed_pspecs=part_specs(embed),
+            block_pspecs=part_specs(template),
+            head_pspecs=part_specs(head),
+        )
+
+
+class _GPTEmbed(nn.Layer):
+    def __init__(self, wte, wpe, drop):
+        super().__init__()
+        self.wte, self.wpe, self.drop = wte, wpe, drop
+
+    def forward(self, ids):
+        pos = jnp.arange(ids.shape[1])[None, :]
+        return self.drop(self.wte(ids) + self.wpe(pos))
+
+
+class _GPTHead(nn.Layer):
+    def __init__(self, ln_f, lm_head, loss_fn):
+        super().__init__()
+        self.ln_f, self.lm_head = ln_f, lm_head
+        self.loss_fn = loss_fn       # the model's own .loss — one definition
+
+    def forward(self, h, labels):
+        return self.loss_fn(self.lm_head(self.ln_f(h)), labels)
