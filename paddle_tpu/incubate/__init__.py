@@ -1,0 +1,3 @@
+"""paddle.incubate namespace parity (fused layers & functional)."""
+
+from paddle_tpu.incubate import nn  # noqa: F401

@@ -1,0 +1,181 @@
+"""Fused transformer layers (≈ paddle.incubate.nn).
+
+Reference (SURVEY.md §2.7-incubate): Python wrappers over the Phi fusion
+kernels — FusedMultiHeadAttention, FusedFeedForward, FusedMultiTransformer
+(the whole-decoder inference kernel, fused_multi_transformer_op.cu).
+
+TPU-native: "fused" means ONE lax.scan over layer-stacked weights inside one
+jit — XLA keeps the whole decoder in registers/VMEM across layers, which is
+what the reference's mega-kernel buys; attention rides the Pallas flash path.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.incubate.nn import functional  # noqa: F401
+
+
+class FusedMultiHeadAttention(Layer):
+    """qkv proj + flash attention + out proj (+pre/post LN) in one module."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=True, epsilon=1e-5):
+        super().__init__()
+        from paddle_tpu.nn.layers.norm import LayerNorm
+        from paddle_tpu.nn.layers.common import Linear
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = Linear(embed_dim, embed_dim)
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x, attn_mask=None, is_causal=False):
+        res = x
+        if self.normalize_before:
+            x = self.norm(x)
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=is_causal)
+        out = self.out_proj(out.reshape(b, s, h))
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = res + out
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, epsilon=1e-5):
+        super().__init__()
+        from paddle_tpu.nn.layers.norm import LayerNorm
+        from paddle_tpu.nn.layers.common import Linear
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.fc1 = Linear(d_model, dim_feedforward)
+        self.fc2 = Linear(dim_feedforward, d_model)
+        self.act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu}[activation]
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x):
+        res = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = self.fc2(self.act(self.fc1(x)))
+        x = F.dropout(x, self.dropout_rate, training=self.training)
+        x = res + x
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedMultiTransformer(Layer):
+    """Whole pre-norm decoder stack as layer-stacked weights + one lax.scan
+    (fused_multi_transformer parity — the inference hot path).
+
+    Weights carry a leading num_layers dim; forward supports full-sequence
+    and KV-cached single/multi-token decode. Cache layout:
+    {'k','v'}: (L, b, max_len, heads, head_dim).
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, num_layers,
+                 activation="gelu", epsilon=1e-5, initializer_range=0.02,
+                 dtype=None):
+        super().__init__()
+        L, h, f = num_layers, embed_dim, dim_feedforward
+        w = init.Normal(0.0, initializer_range)
+        zeros = init.Constant(0.0)
+        ones = init.Constant(1.0)
+        mk = lambda shape, ini: self.create_parameter(
+            shape, dtype=dtype, default_initializer=ini)
+        self.ln1_w = mk((L, h), ones)
+        self.ln1_b = mk((L, h), zeros)
+        self.qkv_w = mk((L, h, 3 * h), w)
+        self.qkv_b = mk((L, 3 * h), zeros)
+        self.out_w = mk((L, h, h), w)
+        self.out_b = mk((L, h), zeros)
+        self.ln2_w = mk((L, h), ones)
+        self.ln2_b = mk((L, h), zeros)
+        self.ffn1_w = mk((L, h, f), w)
+        self.ffn1_b = mk((L, f), zeros)
+        self.ffn2_w = mk((L, f, h), w)
+        self.ffn2_b = mk((L, h), zeros)
+        self.num_layers, self.num_heads = L, num_heads
+        self.head_dim = h // num_heads
+        self.epsilon = epsilon
+        self.act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu}[activation]
+
+    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
+        shape = (self.num_layers, batch_size, max_len, self.num_heads,
+                 self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _ln(self, x, w, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + self.epsilon)).astype(
+            x.dtype) * w + b
+
+    def forward(self, x, cache=None, start_pos=0, is_causal=True):
+        b, s, h = x.shape
+        params = (self.ln1_w, self.ln1_b, self.qkv_w, self.qkv_b, self.out_w,
+                  self.out_b, self.ln2_w, self.ln2_b, self.ffn1_w,
+                  self.ffn1_b, self.ffn2_w, self.ffn2_b)
+
+        def layer(x, per):
+            if cache is None:
+                (l1w, l1b, qkvw, qkvb, ow, ob, l2w, l2b, f1w, f1b, f2w,
+                 f2b) = per
+                ck = cv = None
+            else:
+                (l1w, l1b, qkvw, qkvb, ow, ob, l2w, l2b, f1w, f1b, f2w,
+                 f2b), (ck, cv) = per
+            y = self._ln(x, l1w, l1b)
+            qkv = jnp.matmul(y, qkvw) + qkvb
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, self.num_heads, self.head_dim)
+            k = k.reshape(b, s, self.num_heads, self.head_dim)
+            v = v.reshape(b, s, self.num_heads, self.head_dim)
+            if cache is not None:
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    ck, k.astype(ck.dtype), start_pos, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cv, v.astype(cv.dtype), start_pos, axis=1)
+                max_len = ck.shape[1]
+                q_pos = start_pos + jnp.arange(s)[:, None]
+                mask = (jnp.arange(max_len)[None, :] <= q_pos)[None, None]
+                attn = F.scaled_dot_product_attention(q, ck, cv,
+                                                      attn_mask=mask)
+            else:
+                attn = F.scaled_dot_product_attention(q, k, v,
+                                                      is_causal=is_causal)
+            x = x + jnp.matmul(attn.reshape(b, s, h), ow) + ob
+            y = self._ln(x, l2w, l2b)
+            x = x + jnp.matmul(self.act(jnp.matmul(y, f1w) + f1b), f2w) + f2b
+            return x, (ck, cv)
+
+        if cache is None:
+            def body(xc, per):
+                out, _ = layer(xc, per)
+                return out, None
+            x, _ = jax.lax.scan(body, x, params)
+            return x
+
+        def body(xc, per):
+            return layer(xc, per)
+        x, (new_k, new_v) = jax.lax.scan(body, x,
+                                         (params, (cache["k"], cache["v"])))
+        return x, {"k": new_k, "v": new_v}

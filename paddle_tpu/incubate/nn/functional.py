@@ -1,0 +1,31 @@
+"""paddle.incubate.nn.functional parity — thin veneers over ops/."""
+
+from paddle_tpu.ops.rope import fused_rotary_position_embedding  # noqa: F401
+from paddle_tpu.ops.rms_norm import rms_norm as fused_rms_norm  # noqa: F401
+from paddle_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention as fused_dot_product_attention,
+)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, epsilon=1e-5,
+                                           training=True):
+    """(x + bias) -> dropout -> + residual -> layernorm, XLA-fused."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn import functional as F
+    if bias is not None:
+        x = x + bias
+    x = F.dropout(x, dropout_rate, training=training)
+    y = (x + residual).astype(jnp.float32)
+    mu = jnp.mean(y, axis=-1, keepdims=True)
+    var = jnp.var(y, axis=-1, keepdims=True)
+    out = (y - mu) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(residual.dtype)
+    if ln_scale is not None:
+        out = out * ln_scale
+    if ln_bias is not None:
+        out = out + ln_bias
+    return out

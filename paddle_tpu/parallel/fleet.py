@@ -178,6 +178,21 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
     degree = hcg.get_sharding_parallel_world_size()
 
     state0 = model.trainable_state()
+
+    # ---- AMP (strategy.amp, O2): params in low precision, fp32 masters in
+    # the optimizer (multi_precision), dynamic loss scaling for fp16 ----
+    amp_dt = None
+    scaler = None
+    if strategy.amp and strategy.amp_configs.level.upper() == "O2":
+        from paddle_tpu.core.dtype import to_jax_dtype, is_floating
+        amp_dt = to_jax_dtype(strategy.amp_configs.dtype)
+        state0 = {k: (v.astype(amp_dt) if is_floating(v.dtype) else v)
+                  for k, v in state0.items()}
+        if amp_dt == jnp.float16 and strategy.amp_configs.use_dynamic_loss_scaling:
+            from paddle_tpu.amp import GradScaler
+            scaler = GradScaler(
+                init_loss_scaling=strategy.amp_configs.init_loss_scaling)
+
     base = {name: (getattr(p, "pspec", None) or P())
             for name, p in model.named_parameters() if p.trainable}
     pspecs = sharding_mod.shard_params_spec(state0, stage, degree,
@@ -220,12 +235,25 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
         return fwd(state, batch)
 
     def _step(state, opt_state, batch, rngs):
+        if scaler is not None:
+            sstate = opt_state["scaler"]
+            loss_s, grads = jax.value_and_grad(
+                lambda s: forward_loss(s, batch, rngs) * sstate["scale"])(state)
+            loss = loss_s / sstate["scale"]
+            grads, found_inf = scaler.unscale(grads, sstate)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda s: forward_loss(s, batch, rngs))(state)
         # constrain grads per stage-2 semantics; GSPMD propagates the rest
-        loss, grads = jax.value_and_grad(
-            lambda s: forward_loss(s, batch, rngs))(state)
         grads = {k: jax.lax.with_sharding_constraint(
             g, NamedSharding(mesh, gspecs[k])) for k, g in grads.items()}
         new_state, new_opt = optimizer.update(grads, opt_state, state)
+        if scaler is not None:
+            # overflow step: keep old params/moments, only the scale moves
+            pick = lambda n, o: jnp.where(found_inf, o, n)
+            new_state = jax.tree_util.tree_map(pick, new_state, state)
+            new_opt = jax.tree_util.tree_map(pick, new_opt, opt_state)
+            new_opt["scaler"] = scaler.update_state(sstate, found_inf)
         new_state = {k: jax.lax.with_sharding_constraint(
             v, NamedSharding(mesh, pspecs[k])) for k, v in new_state.items()}
         return new_state, new_opt, loss
@@ -233,6 +261,8 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
     def init_fn():
         placed = {k: jax.device_put(v, param_sh[k]) for k, v in state0.items()}
         opt_state = optimizer.init_state(placed)
+        if scaler is not None:
+            opt_state["scaler"] = scaler.init_state()
         opt_state = jax.device_put(opt_state, opt_state_shardings(opt_state))
         return placed, opt_state
 

@@ -1,0 +1,116 @@
+"""AMP: O2 bf16 training, fp16 dynamic loss scaling, GradScaler semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import amp
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.parallel import fleet
+from paddle_tpu.parallel.strategy import DistributedStrategy
+from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+
+def _strategy(dtype):
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                        "sharding_degree": 2}
+    s.amp = True
+    s.amp_configs.dtype = dtype
+    s.amp_configs.level = "O2"
+    return s
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_amp_o2_trains_with_masters(dtype):
+    s = _strategy(dtype)
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = LlamaConfig.tiny()
+        paddle_tpu.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = AdamW(learning_rate=2e-3)
+        step_fn, init_fn = fleet.make_train_step(
+            model, opt, lambda logits, b: model.loss(logits, b["labels"]),
+            strategy=s)
+        state, opt_state = init_fn()
+        # params in low precision, fp32 masters exist
+        want = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        assert state["model.embed_tokens.weight"].dtype == want
+        assert "master" in opt_state
+        assert opt_state["master"][
+            "model.embed_tokens.weight"].dtype == jnp.float32
+        if dtype == "float16":
+            assert "scaler" in opt_state
+
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 17)))
+        batch = {"input": ids[:, :-1], "labels": ids[:, 1:]}
+        losses = []
+        for _ in range(8):
+            state, opt_state, loss = step_fn(state, opt_state, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_grad_scaler_dynamics():
+    scaler = amp.GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=2)
+    st = scaler.init_state()
+    # overflow halves the scale and resets good_steps
+    g = {"w": jnp.asarray([jnp.inf, 1.0])}
+    _, found = scaler.unscale(g, st)
+    assert bool(found)
+    st2 = scaler.update_state(st, found)
+    assert float(st2["scale"]) == 512.0
+    # two good steps double it
+    g_ok = {"w": jnp.asarray([1.0, 2.0])}
+    un, found = scaler.unscale(g_ok, st2)
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(un["w"]),
+                               np.asarray(g_ok["w"]) / 512.0)
+    st3 = scaler.update_state(st2, found)
+    st4 = scaler.update_state(st3, jnp.zeros((), jnp.bool_))
+    assert float(st4["scale"]) == 1024.0
+
+
+def test_fp16_overflow_step_skips_update():
+    s = _strategy("float16")
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = LlamaConfig.tiny()
+        paddle_tpu.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = AdamW(learning_rate=2e-3)
+        step_fn, init_fn = fleet.make_train_step(
+            model, opt, lambda logits, b: model.loss(logits, b["labels"]),
+            strategy=s)
+        state, opt_state = init_fn()
+        # poison the scale so scaled loss overflows fp32 → grads inf
+        opt_state["scaler"]["scale"] = jnp.asarray(3.0e38, jnp.float32)
+        w_before = np.asarray(
+            opt_state["master"]["model.embed_tokens.weight"])
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 17)))
+        state, opt_state, loss = step_fn(
+            state, opt_state, {"input": ids[:, :-1], "labels": ids[:, 1:]})
+        # update skipped, scale halved
+        np.testing.assert_array_equal(
+            np.asarray(opt_state["master"]["model.embed_tokens.weight"]),
+            w_before)
+        assert float(opt_state["scaler"]["scale"]) < 3.0e38
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_auto_cast_policy():
+    with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        x = jnp.ones((4, 4), jnp.float32)
+        assert amp.amp_cast(x, "matmul").dtype == jnp.bfloat16
+        assert amp.amp_cast(x, "softmax").dtype == jnp.float32
+    assert amp.amp_cast(jnp.ones(2), "matmul").dtype == jnp.float32
