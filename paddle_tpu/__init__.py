@@ -156,6 +156,7 @@ _sys.modules[__name__ + ".distributed"] = distributed
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
+from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
 from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401

@@ -1,0 +1,8 @@
+"""paddle.vision parity: model zoo backbones + transforms.
+
+Reference (SURVEY.md §2.7): python/paddle/vision/ — datasets, transforms,
+pretrained backbones (`paddle.vision.models.resnet50`)."""
+
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision.models import resnet18, resnet34, resnet50, ResNet  # noqa: F401

@@ -1,0 +1,112 @@
+"""ResNet family (≈ python/paddle/vision/models/resnet.py).
+
+NCHW like the reference; convs hit the MXU conv path, BN buffers update
+through the functional bridge's mutable-buffer mechanism."""
+
+from typing import List, Optional, Type, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.conv3 = nn.Conv2D(ch, ch * 4, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(ch * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block, depth_cfg: List[int], num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.in_ch = 64
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0])
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, ch, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.in_ch != ch * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.in_ch, ch * block.expansion, 1, stride=stride,
+                          bias_attr=False),
+                nn.BatchNorm2D(ch * block.expansion))
+        layers = [block(self.in_ch, ch, stride, downsample)]
+        self.in_ch = ch * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.in_ch, ch))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = jnp.mean(x, axis=(2, 3))
+        if self.num_classes > 0:
+            x = self.fc(x)
+        return x
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes=num_classes, **kw)

@@ -1,0 +1,79 @@
+"""Transforms (≈ python/paddle/vision/transforms) — numpy/jnp host-side."""
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(2, 0, 1)
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        if self.data_format == "CHW":
+            return (x - self.mean[:, None, None]) / self.std[:, None, None]
+        return (x - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+        arr = jnp.asarray(x, jnp.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            out_shape = (arr.shape[0],) + self.size
+        else:
+            out_shape = self.size + arr.shape[2:]
+        method = {"bilinear": "linear", "nearest": "nearest"}.get(
+            self.interpolation, self.interpolation)
+        return np.asarray(jax.image.resize(arr, out_shape, method=method))
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
+        th, tw = self.size
+        i, j = max(0, (h - th) // 2), max(0, (w - tw) // 2)
+        return x[:, i:i + th, j:j + tw] if chw else x[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            x = np.asarray(x)
+            return x[..., ::-1].copy()
+        return x
