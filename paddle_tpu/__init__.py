@@ -141,7 +141,8 @@ from paddle_tpu.core.dtype import (  # noqa: F401
 from paddle_tpu.core import device  # noqa: F401
 from paddle_tpu.core.device import set_device, get_device, is_compiled_with_tpu  # noqa: F401
 from paddle_tpu.framework.io import save, load  # noqa: F401
-from paddle_tpu.framework.grad import no_grad, grad, jit  # noqa: F401
+from paddle_tpu.framework.grad import no_grad, grad  # noqa: F401
+from paddle_tpu import jit  # noqa: F401  (module: jit.to_static/save/load)
 
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401

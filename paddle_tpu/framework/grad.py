@@ -6,7 +6,7 @@ built by tracing, not taped at runtime. This module provides the user-facing
 helpers that make the functional style feel like the reference:
 
 * ``paddle_tpu.grad(fn)`` / ``value_and_grad`` — jax passthroughs.
-* ``paddle_tpu.jit(fn)`` — jax.jit with donate/static conveniences (the
+* ``paddle_tpu.jit.to_static(fn)`` — jax.jit with donate/static conveniences (the
   analog of @to_static: trace once, run compiled; dy2static's AST rewriting is
   unnecessary because jax traces Python directly, with lax.cond/scan for
   data-dependent control flow).

@@ -46,44 +46,51 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     model must expose forward(ids, cache=..., start_pos=...) and
     init_cache(batch, max_len) (LlamaForCausalLM-style). Returns
     (b, prompt+new) token ids including the prompt.
+
+    The whole decode loop runs as ONE jitted lax.scan (a single device
+    dispatch — the fused_multi_transformer-style decode path); after an eos
+    every subsequent token of that row is emitted as eos.
     """
     input_ids = jnp.asarray(input_ids)
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
     state = state if state is not None else model.trainable_state()
     cache = model.init_cache(b, total, dtype=cache_dtype)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
 
     @jax.jit
-    def prefill(state, cache, ids):
+    def run(state, cache, ids, key):
         out, cache = functional_call(model, state, ids, cache=cache,
                                      start_pos=0)
-        return out[:, -1, :], cache
+        key, k0 = jax.random.split(key)
+        tok = _sample_logits(out[:, -1, :], k0, temperature, top_k, top_p)
+        finished = jnp.zeros((b,), bool)
 
-    @jax.jit
-    def decode_step(state, cache, tok, pos, key):
-        out, cache = functional_call(model, state, tok[:, None], cache=cache,
-                                     start_pos=pos)
-        nxt = _sample_logits(out[:, -1, :], key, temperature, top_k, top_p)
-        return nxt, cache
+        def step(carry, i):
+            tok, cache, key, finished = carry
+            finished = finished | (tok == eos)
+            key, ki = jax.random.split(key)
+            out, cache = functional_call(model, state, tok[:, None],
+                                         cache=cache,
+                                         start_pos=prompt_len + i - 1)
+            nxt = _sample_logits(out[:, -1, :], ki, temperature, top_k,
+                                 top_p)
+            nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
+            return (nxt, cache, key, finished), nxt
 
-    logits, cache = prefill(state, cache, input_ids)
-    key = jax.random.PRNGKey(seed)
-    key, k0 = jax.random.split(key)
-    tok = _sample_logits(logits, k0, temperature, top_k, top_p)
+        (tok_last, cache, key, finished), toks = jax.lax.scan(
+            step, (tok, cache, key, finished),
+            jnp.arange(1, max_new_tokens))
+        return jnp.concatenate([tok[:, None], toks.T], axis=1)
 
-    out_tokens = [tok]
-    finished = np.zeros((b,), bool)
-    for i in range(1, max_new_tokens):
-        if eos_token_id is not None:
-            finished |= np.asarray(tok) == eos_token_id
-            if finished.all():
-                break
-        key, ki = jax.random.split(key)
-        tok, cache = decode_step(state, cache, tok, prompt_len + i - 1, ki)
-        out_tokens.append(tok)
-
-    return jnp.concatenate([input_ids] + [t[:, None] for t in out_tokens],
-                           axis=1)
+    new_tokens = run(state, cache, input_ids, jax.random.PRNGKey(seed))
+    if eos_token_id is not None:
+        # trim columns where every row is already past its eos
+        arr = np.asarray(new_tokens)
+        done = np.cumsum(arr == eos_token_id, axis=1) > 1
+        keep = int((~done.all(axis=0)).sum())
+        new_tokens = new_tokens[:, :max(keep, 1)]
+    return jnp.concatenate([input_ids, new_tokens], axis=1)
 
 
 class Predictor:

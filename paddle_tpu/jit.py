@@ -1,0 +1,60 @@
+"""paddle.jit parity: to_static / save / load.
+
+Reference (SURVEY.md §2.7-dy2static): @to_static rewrites Python AST into a
+static Program cached per input-spec; jit.save exports an inference model.
+TPU-native: jax traces Python directly, so to_static IS jax.jit (with
+lax.cond/scan for data-dependent control flow); save/load export a
+state_dict + a layer-config pickle that Predictor/load can rehydrate.
+"""
+
+import os
+import pickle
+
+from paddle_tpu.framework.grad import jit, no_grad, to_static  # noqa: F401
+from paddle_tpu.framework import io as _io
+
+
+class TranslatedLayer:
+    """Loaded inference bundle: state + jitted apply (≈ jit.load result)."""
+
+    def __init__(self, model, state):
+        import jax
+        from paddle_tpu.nn.layer import functional_call
+        self._model = model
+        self._state = state
+        self._fwd = jax.jit(lambda st, *a, **k: functional_call(
+            model, st, *a, **k))
+
+    def __call__(self, *args, **kwargs):
+        return self._fwd(self._state, *args, **kwargs)
+
+    @property
+    def model(self):
+        return self._model
+
+
+def save(layer, path, input_spec=None):
+    """Export `layer` for inference: {path}.pdparams + {path}.pdmodel
+    (a pickled (class, config) pair when the layer exposes `.cfg`)."""
+    _io.save(layer.state_dict(), path + ".pdparams")
+    meta = {"class": type(layer).__module__ + "." + type(layer).__qualname__}
+    cfg = getattr(layer, "cfg", None)
+    if cfg is not None:
+        meta["config"] = cfg
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, model=None):
+    """Rehydrate a saved layer; pass `model` to skip class lookup."""
+    state = _io.load(path + ".pdparams")
+    if model is None:
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        mod_name, _, cls_name = meta["class"].rpartition(".")
+        import importlib
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        model = cls(meta["config"]) if "config" in meta else cls()
+    model.set_state_dict(state)
+    model.eval()
+    return TranslatedLayer(model, model.state_dict())

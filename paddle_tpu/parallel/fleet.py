@@ -229,21 +229,64 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
         def fwd(s, b):
             out = functional_call(model, s, b["input"] if isinstance(b, dict)
                                   and "input" in b else b, rngs=rngs)
-            return loss_fn(out, batch)
+            return loss_fn(out, b)
         if remat_policy is not None:
             fwd = jax.checkpoint(fwd, policy=remat_policy)
         return fwd(state, batch)
 
+    merge_k = (int(strategy.gradient_merge_configs.get("k_steps", 1))
+               if strategy.gradient_merge else 1)
+
+    def _value_and_grad(state, batch, rngs, scale=None):
+        """Plain or gradient-merge (k-microbatch accumulated) grads."""
+        def scalar_loss(s, b, r):
+            l = forward_loss(s, b, r)
+            return l * scale if scale is not None else l
+
+        if merge_k <= 1:
+            return jax.value_and_grad(
+                lambda s: scalar_loss(s, batch, rngs))(state)
+
+        def split(x):
+            if not hasattr(x, "ndim") or x.ndim == 0:
+                # scalar leaves replicate so the scan can unstack them
+                return jnp.broadcast_to(jnp.asarray(x), (merge_k,))
+            if x.shape[0] % merge_k:
+                raise ValueError(
+                    f"gradient_merge k_steps={merge_k} does not divide "
+                    f"batch dim {x.shape[0]}")
+            return x.reshape((merge_k, x.shape[0] // merge_k) + x.shape[1:])
+        micro = jax.tree_util.tree_map(split, batch)
+
+        def body(acc, xs):
+            mb, i = xs
+            loss_acc, g_acc = acc
+            # independent randomness per microbatch (≈ k separate steps)
+            rngs_i = {name: jax.random.fold_in(k, i)
+                      for name, k in (rngs or {}).items()}
+            loss, g = jax.value_and_grad(
+                lambda s: scalar_loss(s, mb, rngs_i))(state)
+            return (loss_acc + loss,
+                    jax.tree_util.tree_map(jnp.add, g_acc, g)), None
+
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state)
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zero_g),
+            (micro, jnp.arange(merge_k)))
+        inv = 1.0 / merge_k
+        return (loss_sum * inv,
+                jax.tree_util.tree_map(lambda g: g * inv, g_sum))
+
     def _step(state, opt_state, batch, rngs):
         if scaler is not None:
             sstate = opt_state["scaler"]
-            loss_s, grads = jax.value_and_grad(
-                lambda s: forward_loss(s, batch, rngs) * sstate["scale"])(state)
+            loss_s, grads = _value_and_grad(state, batch, rngs,
+                                            scale=sstate["scale"])
             loss = loss_s / sstate["scale"]
             grads, found_inf = scaler.unscale(grads, sstate)
         else:
-            loss, grads = jax.value_and_grad(
-                lambda s: forward_loss(s, batch, rngs))(state)
+            loss, grads = _value_and_grad(state, batch, rngs)
         # constrain grads per stage-2 semantics; GSPMD propagates the rest
         grads = {k: jax.lax.with_sharding_constraint(
             g, NamedSharding(mesh, gspecs[k])) for k, g in grads.items()}
@@ -259,7 +302,10 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
         return new_state, new_opt, loss
 
     def init_fn():
-        placed = {k: jax.device_put(v, param_sh[k]) for k, v in state0.items()}
+        # copy so the jit step's donation can never free the Layer's own
+        # param buffers (device_put aliases when placement already matches)
+        placed = {k: jax.device_put(jnp.array(v, copy=True), param_sh[k])
+                  for k, v in state0.items()}
         opt_state = optimizer.init_state(placed)
         if scaler is not None:
             opt_state["scaler"] = scaler.init_state()

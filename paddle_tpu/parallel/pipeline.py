@@ -328,7 +328,9 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
     jit_step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
 
     def init_fn():
-        placed = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+        # copy so jit donation can never free the Layer's own param buffers
+        placed = {k: jax.device_put(_jnp.array(v, copy=True),
+                                    NamedSharding(mesh, pspecs[k]))
                   for k, v in state0.items()}
         opt_state = optimizer.init_state(placed)
 

@@ -5,4 +5,10 @@ pretrained backbones (`paddle.vision.models.resnet50`)."""
 
 from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
-from paddle_tpu.vision.models import resnet18, resnet34, resnet50, ResNet  # noqa: F401
+from paddle_tpu.vision.models import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+)

@@ -88,6 +88,8 @@ class ResNet(nn.Layer):
         if self.with_pool:
             x = jnp.mean(x, axis=(2, 3))
         if self.num_classes > 0:
+            if x.ndim > 2:           # with_pool=False: flatten like the ref
+                x = x.reshape(x.shape[0], -1)
             x = self.fc(x)
         return x
 
