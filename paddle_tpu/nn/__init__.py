@@ -43,6 +43,14 @@ from paddle_tpu.nn.layers.transformer import (  # noqa: F401
     TransformerEncoder,
     TransformerEncoderLayer,
 )
+from paddle_tpu.nn.layers.rnn import (  # noqa: F401
+    SimpleRNN,
+    LSTM,
+    GRU,
+    SimpleRNNCell,
+    LSTMCell,
+    GRUCell,
+)
 from paddle_tpu.nn.layers.moe import (  # noqa: F401
     MoELayer,
     GShardGate,

@@ -1,0 +1,157 @@
+"""Recurrent layers: SimpleRNN, LSTM, GRU (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-first: the time loop is lax.scan (XLA unrolls/pipelines it); gates are
+single fused matmuls per step. Batch-first (b, s, input) like the reference's
+time_major=False default; multi-layer and bidirectional supported.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+
+
+class _RNNCellBase(Layer):
+    n_gates = 1
+    act = staticmethod(jnp.tanh)
+
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        k = 1.0 / (hidden_size ** 0.5)
+        u = init.Uniform(-k, k)
+        g = self.n_gates
+        self.weight_ih = self.create_parameter((input_size, g * hidden_size),
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, g * hidden_size),
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((g * hidden_size,),
+                                             default_initializer=u, is_bias=True)
+        self.bias_hh = self.create_parameter((g * hidden_size,),
+                                             default_initializer=u, is_bias=True)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def forward(self, x, state):
+        h = state
+        z = x @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh
+        return type(self).act(z)
+
+
+class LSTMCell(_RNNCellBase):
+    n_gates = 4
+
+    def forward(self, x, state):
+        h, c = state
+        z = x @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return h, c
+
+
+class GRUCell(_RNNCellBase):
+    n_gates = 3
+
+    def forward(self, x, state):
+        h = state
+        zi = x @ self.weight_ih + self.bias_ih
+        zh = h @ self.weight_hh + self.bias_hh
+        ri, ui, ci = jnp.split(zi, 3, axis=-1)
+        rh, uh, ch = jnp.split(zh, 3, axis=-1)
+        r = F.sigmoid(ri + rh)
+        u = F.sigmoid(ui + uh)
+        cand = jnp.tanh(ci + r * ch)
+        return u * h + (1.0 - u) * cand
+
+
+class _RNNBase(Layer):
+    cell_cls = SimpleRNNCell
+    has_c = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False):
+        super().__init__()
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirect else 1
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * n_dir
+            cells.append(self.cell_cls(in_sz, hidden_size))
+            if self.bidirect:
+                cells.append(self.cell_cls(in_sz, hidden_size))
+        from paddle_tpu.nn.layers.common import LayerList
+        self.cells = LayerList(cells)
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+
+    def _zero_state(self, b):
+        h = jnp.zeros((b, self.hidden_size))
+        return (h, jnp.zeros_like(h)) if self.has_c else h
+
+    def _run_cell(self, cell, x, reverse=False):
+        """x: (b, s, in) → outputs (b, s, hidden), final state."""
+        xs = jnp.swapaxes(x, 0, 1)               # (s, b, in)
+        if reverse:
+            xs = xs[::-1]
+        # bind the cell's state once so scan traces a pure step
+        from paddle_tpu.nn.layer import functional_call
+        cell_state = cell.state_dict()
+
+        def step(carry, xt):
+            out = functional_call(cell, cell_state, xt, carry)
+            h = out[0] if self.has_c else out
+            return out, h
+
+        final, hs = jax.lax.scan(step, self._zero_state(x.shape[0]), xs)
+        if reverse:
+            hs = hs[::-1]
+        return jnp.swapaxes(hs, 0, 1), final
+
+    def forward(self, x, initial_states=None):
+        if self.time_major:
+            x = jnp.swapaxes(x, 0, 1)
+        finals = []
+        for layer in range(self.num_layers):
+            if self.bidirect:
+                fwd_cell = self.cells[2 * layer]
+                bwd_cell = self.cells[2 * layer + 1]
+                out_f, fin_f = self._run_cell(fwd_cell, x)
+                out_b, fin_b = self._run_cell(bwd_cell, x, reverse=True)
+                x = jnp.concatenate([out_f, out_b], axis=-1)
+                finals.extend([fin_f, fin_b])
+            else:
+                x, fin = self._run_cell(self.cells[layer], x)
+                finals.append(fin)
+            if self.dropout and layer < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        if self.has_c:
+            h = jnp.stack([f[0] for f in finals])
+            c = jnp.stack([f[1] for f in finals])
+            final_state = (h, c)
+        else:
+            final_state = jnp.stack(finals)
+        if self.time_major:
+            x = jnp.swapaxes(x, 0, 1)
+        return x, final_state
+
+
+class SimpleRNN(_RNNBase):
+    cell_cls = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    cell_cls = LSTMCell
+    has_c = True
+
+
+class GRU(_RNNBase):
+    cell_cls = GRUCell

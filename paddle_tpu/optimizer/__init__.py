@@ -275,4 +275,97 @@ class Lamb(Optimizer):
         return new, {"moment1": m1, "moment2": m2}
 
 
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _init_slots(self, params):
+        return {"moment": _tree_map(
+            lambda p: jnp.full(p.shape, self.initial_accumulator_value,
+                               jnp.float32), params)}
+
+    def _apply(self, grads, params, state, lr, step):
+        if self.weight_decay:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
+                              params)
+        mom = _tree_map(lambda m, g: m + jnp.square(g), state["moment"], grads)
+        new = _tree_map(lambda p, m, g: p - lr * g / (jnp.sqrt(m) + self.epsilon),
+                        params, mom, grads)
+        return new, {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        slots = {"mean_square": _tree_map(z, params),
+                 "velocity": _tree_map(z, params)}
+        if self.centered:
+            slots["mean_grad"] = _tree_map(z, params)
+        return slots
+
+    def _apply(self, grads, params, state, lr, step):
+        rho, eps = self.rho, self.epsilon
+        if self.weight_decay:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
+                              params)
+        ms = _tree_map(lambda m, g: rho * m + (1 - rho) * jnp.square(g),
+                       state["mean_square"], grads)
+        slots = {"mean_square": ms}
+        if self.centered:
+            mg = _tree_map(lambda m, g: rho * m + (1 - rho) * g,
+                           state["mean_grad"], grads)
+            slots["mean_grad"] = mg
+            denom = _tree_map(lambda m, a: jnp.sqrt(m - jnp.square(a)) + eps,
+                              ms, mg)
+        else:
+            denom = _tree_map(lambda m: jnp.sqrt(m) + eps, ms)
+        vel = _tree_map(lambda v, g, d: self.momentum * v + lr * g / d,
+                        state["velocity"], grads, denom)
+        slots["velocity"] = vel
+        new = _tree_map(lambda p, v: p - v, params, vel)
+        return new, slots
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"avg_sq_grad": _tree_map(z, params),
+                "avg_sq_update": _tree_map(z, params)}
+
+    def _apply(self, grads, params, state, lr, step):
+        rho, eps = self.rho, self.epsilon
+        if self.weight_decay:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
+                              params)
+        asg = _tree_map(lambda a, g: rho * a + (1 - rho) * jnp.square(g),
+                        state["avg_sq_grad"], grads)
+        upd = _tree_map(
+            lambda g, a, u: g * jnp.sqrt(u + eps) / jnp.sqrt(a + eps),
+            grads, asg, state["avg_sq_update"])
+        asu = _tree_map(lambda u, d: rho * u + (1 - rho) * jnp.square(d),
+                        state["avg_sq_update"], upd)
+        new = _tree_map(lambda p, d: p - lr * d, params, upd)
+        return new, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
 from paddle_tpu.optimizer import lr  # noqa: F401,E402

@@ -1,0 +1,97 @@
+"""RNN layers (LSTM/GRU/SimpleRNN) and the remaining optimizers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.optimizer import Adadelta, Adagrad, RMSProp
+
+
+@pytest.mark.parametrize("cls,has_c", [(nn.SimpleRNN, False),
+                                       (nn.LSTM, True), (nn.GRU, False)])
+def test_rnn_shapes_and_state(cls, has_c):
+    paddle_tpu.seed(0)
+    rnn = cls(8, 16, num_layers=2)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 5, 8), jnp.float32)
+    out, final = rnn(x)
+    assert out.shape == (3, 5, 16)
+    if has_c:
+        h, c = final
+        assert h.shape == (2, 3, 16) and c.shape == (2, 3, 16)
+    else:
+        assert final.shape == (2, 3, 16)
+
+
+def test_bidirectional_lstm():
+    paddle_tpu.seed(0)
+    rnn = nn.LSTM(4, 8, num_layers=1, direction="bidirect")
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 4), jnp.float32)
+    out, (h, c) = rnn(x)
+    assert out.shape == (2, 6, 16)      # fwd ⊕ bwd
+    assert h.shape == (2, 2, 8)
+
+
+def test_lstm_trains_on_sequence_task():
+    """Learn to output the mean of the input sequence."""
+    paddle_tpu.seed(0)
+    model = nn.Sequential(nn.LSTM(4, 16), )
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.LSTM(4, 16)
+            self.fc = nn.Linear(16, 1)
+
+        def forward(self, x):
+            out, _ = self.rnn(x)
+            return self.fc(out[:, -1])
+
+    m = Head()
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(32, 6, 4), jnp.float32)
+    Y = jnp.mean(X, axis=(1, 2), keepdims=False)[:, None]
+    from paddle_tpu.optimizer import Adam
+    opt = Adam(learning_rate=5e-3)
+    state = m.trainable_state()
+    opt_state = opt.init_state(state)
+
+    @jax.jit
+    def step(state, opt_state):
+        def loss_fn(s):
+            return jnp.mean((functional_call(m, s, X) - Y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(state)
+        state, opt_state = opt.update(g, opt_state, state)
+        return state, opt_state, loss
+
+    losses = []
+    for _ in range(30):
+        state, opt_state, loss = step(state, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (Adagrad, {"learning_rate": 0.5}),
+    (RMSProp, {"learning_rate": 0.01}),
+    (RMSProp, {"learning_rate": 0.01, "centered": True, "momentum": 0.9}),
+    (Adadelta, {"learning_rate": 1.0}),
+])
+def test_optimizers_minimize_quadratic(opt_cls, kw):
+    opt = opt_cls(**kw)
+    params = {"w": jnp.asarray([3.0, -2.0])}
+    state = opt.init_state(params)
+
+    @jax.jit
+    def step(params, state):
+        g = jax.grad(lambda p: jnp.sum(p["w"] ** 2))(params)
+        return opt.update(g, state, params)
+
+    init_obj = float(jnp.sum(params["w"] ** 2))
+    for _ in range(150):
+        params, state = step(params, state)
+    final_obj = float(jnp.sum(params["w"] ** 2))
+    assert final_obj < 0.7 * init_obj   # monotone optimizers; rates differ
