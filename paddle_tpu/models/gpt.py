@@ -142,19 +142,18 @@ class GPTPretrainModel(nn.Layer):
         return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
 
     def pipeline_parts(self):
-        """Factor for the SPMD pipeline (parallel.pipeline)."""
+        """Factor for the SPMD pipeline (parallel.pipeline). Tied embeddings
+        use the pipeline's tied_head path (SharedLayerDesc parity): the head
+        unembeds with the embed stage's wte weight."""
         from paddle_tpu.nn.layer import functional_call
         from paddle_tpu.parallel.pipeline import PipelineParts, part_specs
 
-        if self.cfg.tie_word_embeddings:
-            raise ValueError(
-                "pipeline_parts requires tie_word_embeddings=False (tied "
-                "embed/head across stages needs SharedLayerDesc-style grad "
-                "sync; set GPTConfig.tie_word_embeddings=False)")
+        tied = self.cfg.tie_word_embeddings
         embed = _GPTEmbed(self.gpt.wte, self.gpt.wpe, self.gpt.drop)
         blocks = list(self.gpt.h)
         template = blocks[0]
-        head = _GPTHead(self.gpt.ln_f, self.lm_head, self.loss)
+        ln_f = self.gpt.ln_f
+        model_loss = self.loss
 
         def embed_apply(st, ids):
             return functional_call(embed, st, ids)
@@ -162,19 +161,34 @@ class GPTPretrainModel(nn.Layer):
         def block_apply(st, h):
             return functional_call(template, st, h)
 
-        def head_apply(st, h, labels):
-            return functional_call(head, st, h, labels)
+        if tied:
+            def head_apply(head_st, embed_st, h, labels):
+                x = functional_call(ln_f, head_st, h)
+                logits = jnp.matmul(x, embed_st["wte.weight"].T)
+                return model_loss(logits, labels)
+
+            head_state = ln_f.trainable_state()
+            head_pspecs = part_specs(ln_f)
+        else:
+            head = _GPTHead(ln_f, self.lm_head, model_loss)
+
+            def head_apply(st, h, labels):
+                return functional_call(head, st, h, labels)
+
+            head_state = head.trainable_state()
+            head_pspecs = part_specs(head)
 
         return PipelineParts(
             embed_state=embed.trainable_state(),
             embed_apply=embed_apply,
             block_states=[b.trainable_state() for b in blocks],
             block_apply=block_apply,
-            head_state=head.trainable_state(),
+            head_state=head_state,
             head_apply=head_apply,
             embed_pspecs=part_specs(embed),
             block_pspecs=part_specs(template),
-            head_pspecs=part_specs(head),
+            head_pspecs=head_pspecs,
+            tied_head=tied,
         )
 
 

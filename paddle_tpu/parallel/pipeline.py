@@ -157,6 +157,11 @@ class PipelineParts:
     embed_pspecs: Dict[str, Any]
     block_pspecs: Dict[str, Any]     # specs for ONE block (unstacked)
     head_pspecs: Dict[str, Any]
+    # SharedLayerDesc parity: tied unembedding. When True, head_apply is
+    # (head_state, embed_state, h, labels) — the embed weights are
+    # pp-replicated, so the head reads them directly and autodiff sums the
+    # two grad paths (no explicit cross-stage grad sync needed).
+    tied_head: bool = False
 
 
 def _norm_pspec(p, ndim):
@@ -282,7 +287,10 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
                 lbl = jax.lax.dynamic_index_in_dim(
                     labels_mb, _jnp.clip(out_idx, 0, n_micro - 1), 0,
                     keepdims=False)
-                mb_loss = parts.head_apply(head_st, h_out, lbl)
+                if parts.tied_head:
+                    mb_loss = parts.head_apply(head_st, embed_st, h_out, lbl)
+                else:
+                    mb_loss = parts.head_apply(head_st, h_out, lbl)
                 emit = (stage == n_stages - 1) & (out_idx >= 0)
                 # stage s holds microbatch (t - s); its extra losses count
                 # only while that microbatch is real (not a bubble tick)

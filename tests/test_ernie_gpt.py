@@ -92,6 +92,43 @@ def test_ernie_semi_auto_engine():
         set_hybrid_communicate_group(None)
 
 
+def test_gpt_pipeline_tied_embeddings_matches_single_device():
+    """SharedLayerDesc parity: tied wte unembedding through the pipeline."""
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 2,
+                        "sharding_degree": 2}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 2
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = GPTConfig.tiny()
+        assert cfg.tie_word_embeddings
+        paddle_tpu.seed(0)
+        model = GPTPretrainModel(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 17)))
+        x, y = ids[:, :-1], ids[:, 1:]
+        ref_loss = float(model.loss(model(x), y))
+
+        opt = AdamW(learning_rate=1e-3)
+        step_fn, init_fn = fleet.make_train_step(model, opt, None, strategy=s)
+        state, opt_state = init_fn()
+        state, opt_state, loss0 = step_fn(state, opt_state,
+                                          {"input": x, "labels": y})
+        np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
+        # the tied weight exists ONCE (under embed.), not duplicated in head
+        assert "embed.wte.weight" in state
+        assert not any(k.startswith("head.") and "wte" in k for k in state)
+        # grads flowed into the tied weight from both uses: train further
+        for _ in range(3):
+            state, opt_state, loss = step_fn(state, opt_state,
+                                             {"input": x, "labels": y})
+        assert float(loss) < float(loss0)
+    finally:
+        set_hybrid_communicate_group(None)
+
+
 def test_gpt_pipeline_matches_single_device():
     s = DistributedStrategy()
     s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 2,
