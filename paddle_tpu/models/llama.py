@@ -245,37 +245,52 @@ class CausalLMBase(nn.Layer):
 
     def pipeline_parts(self):
         """Factor the model for the SPMD pipeline schedule
-        (parallel.pipeline.make_pipeline_train_step)."""
+        (parallel.pipeline.make_pipeline_train_step). Tied embeddings ride
+        the pipeline's tied_head path (SharedLayerDesc parity)."""
         from paddle_tpu.nn.layer import functional_call
         from paddle_tpu.parallel.pipeline import PipelineParts, part_specs
 
-        if self.cfg.tie_word_embeddings:
-            raise ValueError(
-                "pipeline_parts requires tie_word_embeddings=False (tied "
-                "embed/head across pipeline stages needs a SharedLayerDesc-"
-                "style grad sync; untie for pp training)")
+        tied = self.cfg.tie_word_embeddings
         embed = self.model.embed_tokens
         blocks = list(self.model.layers)
         template = blocks[0]
-        head = _LMHead(self.model.norm, self.lm_head, self.loss_fn)
         block_apply = self._pipeline_block_apply(template)
 
         def embed_apply(st, ids):
             return functional_call(embed, st, ids)
 
-        def head_apply(st, h, labels):
-            return functional_call(head, st, h, labels)
+        if tied:
+            norm = self.model.norm
+            loss_fn = self.loss_fn
+
+            def head_apply(head_st, embed_st, h, labels):
+                x = functional_call(norm, head_st, h)
+                logits = jnp.matmul(x, embed_st["weight"].T)
+                logits = mp.constrain(logits, mp._last_dim_spec(mp.MP_AXIS))
+                return loss_fn(logits, labels, reduction="mean")
+
+            head_state = norm.trainable_state()
+            head_pspecs = part_specs(norm)
+        else:
+            head = _LMHead(self.model.norm, self.lm_head, self.loss_fn)
+
+            def head_apply(st, h, labels):
+                return functional_call(head, st, h, labels)
+
+            head_state = head.trainable_state()
+            head_pspecs = part_specs(head)
 
         return PipelineParts(
             embed_state=embed.trainable_state(),
             embed_apply=embed_apply,
             block_states=[b.trainable_state() for b in blocks],
             block_apply=block_apply,
-            head_state=head.trainable_state(),
+            head_state=head_state,
             head_apply=head_apply,
             embed_pspecs=part_specs(embed),
             block_pspecs=part_specs(template),
-            head_pspecs=part_specs(head),
+            head_pspecs=head_pspecs,
+            tied_head=tied,
         )
 
 

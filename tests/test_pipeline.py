@@ -77,9 +77,18 @@ def test_pipeline_with_recompute_matches(pp_fleet):
     np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
 
 
-def test_pipeline_requires_untied_embeddings(pp_fleet):
+def test_pipeline_tied_embeddings_matches(pp_fleet):
+    f, s = pp_fleet
     cfg = LlamaConfig.tiny()
     cfg.tie_word_embeddings = True
+    paddle_tpu.seed(0)
     model = LlamaForCausalLM(cfg)
-    with pytest.raises(ValueError, match="tie_word_embeddings"):
-        model.pipeline_parts()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 17)))
+    x, y = ids[:, :-1], ids[:, 1:]
+    ref_loss = float(model.loss(model(x), y))
+    opt = AdamW(learning_rate=1e-3)
+    step_fn, init_fn = make_pipeline_train_step(model, opt, strategy=s)
+    state, opt_state = init_fn()
+    _, _, loss0 = step_fn(state, opt_state, {"input": x, "labels": y})
+    np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
