@@ -1,14 +1,16 @@
-"""Flash attention — XLA path + Pallas TPU kernel.
+"""Flash attention — XLA path + Pallas TPU kernels (forward AND backward).
 
 Reference: phi flash_attn kernel wrapping the vendored flash-attention-2 CUDA
 library (paddle/phi/kernels/gpu/flash_attn_kernel.cu, cmake/external/
 flashattn.cmake; python veneer paddle.nn.functional.flash_attention).
 
 Layouts follow the reference: q/k/v are (batch, seq, num_heads, head_dim).
-GQA/MQA supported via num_kv_heads < num_heads. The Pallas kernel (blockwise
-online-softmax, fp32 accumulators, causal block skipping) is used on TPU for
-long sequences; the XLA einsum path covers everything else (XLA already fuses
-the softmax chain and runs the matmuls on the MXU).
+GQA/MQA supported via num_kv_heads < num_heads. The Pallas path (blockwise
+online-softmax, fp32 accumulators, causal block skipping, LSE saved for the
+backward; dq and dk/dv backward kernels recompute probabilities per block so
+the (s, s) matrix is never materialized) is used on TPU for long sequences;
+the XLA einsum path covers everything else. Kernels compute internally in
+(b, h, s, d) so the trailing block dims meet TPU tiling (8, 128).
 """
 
 import functools
@@ -20,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+LANES = 128
 
 
 def _repeat_kv(k, n_rep):
@@ -83,53 +86,21 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                           scale=scale, dropout_p=dropout_p, training=training)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_vjp(q, k, v, is_causal, scale):
-    """Pallas forward; backward recomputes through the XLA composition (a
-    dedicated Pallas backward kernel is a later optimization — the forward
-    is where inference/prefill time goes)."""
-    return _flash_attention_pallas(q, k, v, is_causal, scale)
+# ---- Pallas kernels (internal layout (b, h, s, d)) -------------------------
+
+_BLK = 512
 
 
-def _flash_vjp_fwd(q, k, v, is_causal, scale):
-    return _flash_attention_pallas(q, k, v, is_causal, scale), (q, k, v)
-
-
-def _flash_vjp_bwd(is_causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_attention(q_, k_, v_, is_causal=is_causal,
-                                          scale=scale, dropout_p=0.0),
-        q, k, v)
-    return vjp(g)
-
-
-_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-# ---- Pallas blockwise flash kernel ----------------------------------------
-
-@functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
-def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
+def _fwd_kernels(qt, kt, vt, is_causal: bool, sc: float):
+    """qt/kt/vt: (b, h, s, d) → (out (b,h,s,d), lse (b,h,s)) fp32 lse."""
     from jax.experimental import pallas as pl
 
-    b, s, h, d = q.shape
-    n_rep = h // k.shape[2]
-    if n_rep != 1:
-        k = _repeat_kv(k, n_rep)
-        v = _repeat_kv(v, n_rep)
-    sc = scale if scale is not None else 1.0 / math.sqrt(d)
-
-    # TPU tiling wants the trailing block dims to be (seq, head_dim)
-    qt = jnp.transpose(q, (0, 2, 1, 3))     # (b, h, s, d)
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-
-    blk_q = min(512, s)
-    blk_k = min(512, s)
+    b, h, s, d = qt.shape
+    blk_q = min(_BLK, s)
+    blk_k = min(_BLK, s)
     grid = (b, h, s // blk_q)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32) * sc  # (blk_q, d)
 
@@ -139,8 +110,10 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
             vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = qv @ kv.T  # (blk_q, blk_k)
             if is_causal:
-                q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-                k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+                q_pos = qi * blk_q + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                k_pos = ki * blk_k + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
                 s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
             m_cur = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
             alpha = jnp.exp(m_prev - m_cur)
@@ -153,23 +126,218 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
         m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
         l0 = jnp.zeros((blk_q,), jnp.float32)
         if is_causal:
-            # only blocks at or below the diagonal contribute
-            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k else (qi * blk_q) // blk_k + 1
+            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k \
+                else (qi * blk_q) // blk_k + 1
         else:
             n_k = s // blk_k
         acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
         o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+        # TPU tiling wants 2-D trailing blocks: replicate lse across lanes
+        lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                        (qv.shape[0], LANES))
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, None, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, blk_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, blk_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, blk_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, blk_q, LANES),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
+        ],
     )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return out, lse
+
+
+def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
+    """dq: loop over k-blocks for each q-block. All (b,h,s,·)."""
+    from jax.experimental import pallas as pl
+
+    b, h, s, d = qt.shape
+    blk_q = min(_BLK, s)
+    blk_k = min(_BLK, s)
+    grid = (b, h, s // blk_q)
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref):
+        qi = pl.program_id(2)
+        qv = q_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)          # (blk_q, d)
+        lse_q = lse_ref[...][:, 0]                    # (blk_q,)
+        delta_q = dl_ref[...][:, 0]                   # (blk_q,)
+
+        def body(ki, dq_acc):
+            kv = k_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
+            vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
+            s_blk = (qv @ kv.T) * sc
+            if is_causal:
+                q_pos = qi * blk_q + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                k_pos = ki * blk_k + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+            p = jnp.exp(s_blk - lse_q[:, None])       # (blk_q, blk_k)
+            dp = do @ vv.T                            # (blk_q, blk_k)
+            ds = p * (dp - delta_q[:, None])
+            return dq_acc + (ds @ kv) * sc
+
+        if is_causal:
+            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k \
+                else (qi * blk_q) // blk_k + 1
+        else:
+            n_k = s // blk_k
+        dq = lax.fori_loop(0, n_k, body, jnp.zeros((blk_q, d), jnp.float32))
+        dq_ref[...] = dq.astype(dq_ref.dtype)
+
+    full = lambda: pl.BlockSpec((None, None, s, d),
+                                lambda bi, hi, qi: (bi, hi, 0, 0))
+    qblk = lambda: pl.BlockSpec((None, None, blk_q, d),
+                                lambda bi, hi, qi: (bi, hi, qi, 0))
+    row = lambda: pl.BlockSpec((None, None, blk_q, LANES),
+                               lambda bi, hi, qi: (bi, hi, qi, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qblk(), full(), full(), qblk(), row(), row()],
+        out_specs=qblk(),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+    )(qt, kt, vt, dot, lse, delta)
+
+
+def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
+    """dk, dv: loop over q-blocks for each k-block."""
+    from jax.experimental import pallas as pl
+
+    b, h, s, d = qt.shape
+    blk_q = min(_BLK, s)
+    blk_k = min(_BLK, s)
+    grid = (b, h, s // blk_k)
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref):
+        ki = pl.program_id(2)
+        kv = k_ref[...].astype(jnp.float32)           # (blk_k, d)
+        vv = v_ref[...].astype(jnp.float32)
+
+        def body(qi, carry):
+            dk_acc, dv_acc = carry
+            qv = q_ref[pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+            do = do_ref[pl.ds(qi * blk_q, blk_q), :].astype(jnp.float32)
+            lse_q = lse_ref[pl.ds(qi * blk_q, blk_q), 0]
+            delta_q = dl_ref[pl.ds(qi * blk_q, blk_q), 0]
+            s_blk = (qv @ kv.T) * sc                  # (blk_q, blk_k)
+            if is_causal:
+                q_pos = qi * blk_q + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                k_pos = ki * blk_k + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+            p = jnp.exp(s_blk - lse_q[:, None])
+            dv_acc = dv_acc + p.T @ do
+            dp = do @ vv.T
+            ds = p * (dp - delta_q[:, None])
+            dk_acc = dk_acc + (ds.T @ qv) * sc
+            return dk_acc, dv_acc
+
+        n_q = s // blk_q
+        if is_causal:
+            # only q-blocks at or below the diagonal see this k-block
+            q0 = (ki * blk_k) // blk_q
+        else:
+            q0 = 0
+        dk, dv = lax.fori_loop(q0, n_q, body,
+                               (jnp.zeros((blk_k, d), jnp.float32),
+                                jnp.zeros((blk_k, d), jnp.float32)))
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    full = lambda: pl.BlockSpec((None, None, s, d),
+                                lambda bi, hi, ki: (bi, hi, 0, 0))
+    kblk = lambda: pl.BlockSpec((None, None, blk_k, d),
+                                lambda bi, hi, ki: (bi, hi, ki, 0))
+    frow = lambda: pl.BlockSpec((None, None, s, LANES),
+                                lambda bi, hi, ki: (bi, hi, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[full(), kblk(), kblk(), full(), frow(), frow()],
+        out_specs=[kblk(), kblk()],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, d), qt.dtype)],
+    )(qt, kt, vt, dot, lse, delta)
+
+
+@functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
+def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
+    """Forward-only entry (bench/eval); (b, s, h, d) in and out."""
+    out, _ = _flash_fwd(q, k, v, is_causal, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, is_causal, scale):
+    b, s, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out_t, lse = _fwd_kernels(qt, kt, vt, is_causal, sc)
+    return jnp.transpose(out_t, (0, 2, 1, 3)), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_vjp(q, k, v, is_causal, scale):
+    """Pallas forward + Pallas backward (dq / dk+dv block kernels)."""
+    out, _ = _flash_fwd(q, k, v, is_causal, scale)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, is_causal, scale):
+    out, lse = _flash_fwd(q, k, v, is_causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(is_causal, scale, res, g):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    n_kv = k.shape[2]
+    n_rep = h // n_kv
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    kr = _repeat_kv(k, n_rep)
+    vr = _repeat_kv(v, n_rep)
+    to_t = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    qt, kt, vt = to_t(q), to_t(kr), to_t(vr)
+    dot = to_t(g)
+    out_t = to_t(out)
+    # delta = rowsum(dout * out) (fp32) — the softmax-grad correction term
+    delta = jnp.sum(dot.astype(jnp.float32) * out_t.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+
+    dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc)
+    dk_t, dv_t = _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc)
+
+    from_t = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    dq = from_t(dq_t).astype(q.dtype)
+    dk = from_t(dk_t)
+    dv = from_t(dv_t)
+    if n_rep != 1:    # GQA: sum grads over the repeated head groups
+        dk = dk.reshape(b, s, n_kv, n_rep, d).sum(axis=3)
+        dv = dv.reshape(b, s, n_kv, n_rep, d).sum(axis=3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
