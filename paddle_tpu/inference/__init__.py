@@ -5,9 +5,9 @@ saved program and runs IR-optimized inference; generation-time decode rides
 the fused_multi_transformer / masked_multihead_attention CUDA kernels.
 
 TPU-native: the whole decode step (all layers, cache update, sampling) is
-ONE jitted program with donated cache buffers — XLA fuses what
-fused_multi_transformer hand-fuses; there is no separate "optimized
-program" artifact because jit compilation IS the optimization pass.
+ONE jitted program — XLA fuses what fused_multi_transformer hand-fuses;
+there is no separate "optimized program" artifact because jit compilation
+IS the optimization pass.
 """
 
 from typing import Callable, Dict, Optional
@@ -58,30 +58,44 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     cache = model.init_cache(b, total, dtype=cache_dtype)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
-    @jax.jit
-    def run(state, cache, ids, key):
-        out, cache = functional_call(model, state, ids, cache=cache,
-                                     start_pos=0)
-        key, k0 = jax.random.split(key)
-        tok = _sample_logits(out[:, -1, :], k0, temperature, top_k, top_p)
-        finished = jnp.zeros((b,), bool)
-
-        def step(carry, i):
-            tok, cache, key, finished = carry
-            finished = finished | (tok == eos)
-            key, ki = jax.random.split(key)
-            out, cache = functional_call(model, state, tok[:, None],
-                                         cache=cache,
-                                         start_pos=prompt_len + i - 1)
-            nxt = _sample_logits(out[:, -1, :], ki, temperature, top_k,
+    # One decode program per static configuration, cached on the model so
+    # repeated generate() calls with the same shapes don't retrace. The KV
+    # cache is not donated: the program returns only tokens, so there is no
+    # output buffer to alias — XLA frees the cache after its last in-scan
+    # use regardless.
+    jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    jit_key = (b, prompt_len, max_new_tokens, float(temperature),
+               int(top_k), float(top_p), eos, jnp.dtype(cache_dtype).name,
+               model.training)
+    run = jit_cache.get(jit_key)
+    if run is None:
+        def run_impl(state, cache, ids, key):
+            out, cache = functional_call(model, state, ids, cache=cache,
+                                         start_pos=0)
+            key, k0 = jax.random.split(key)
+            tok = _sample_logits(out[:, -1, :], k0, temperature, top_k,
                                  top_p)
-            nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
-            return (nxt, cache, key, finished), nxt
+            finished = jnp.zeros((b,), bool)
 
-        (tok_last, cache, key, finished), toks = jax.lax.scan(
-            step, (tok, cache, key, finished),
-            jnp.arange(1, max_new_tokens))
-        return jnp.concatenate([tok[:, None], toks.T], axis=1)
+            def step(carry, i):
+                tok, cache, key, finished = carry
+                finished = finished | (tok == eos)
+                key, ki = jax.random.split(key)
+                out, cache = functional_call(model, state, tok[:, None],
+                                             cache=cache,
+                                             start_pos=prompt_len + i - 1)
+                nxt = _sample_logits(out[:, -1, :], ki, temperature, top_k,
+                                     top_p)
+                nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
+                return (nxt, cache, key, finished), nxt
+
+            (tok_last, cache, key, finished), toks = jax.lax.scan(
+                step, (tok, cache, key, finished),
+                jnp.arange(1, max_new_tokens))
+            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+        run = jax.jit(run_impl)
+        jit_cache[jit_key] = run
 
     new_tokens = run(state, cache, input_ids, jax.random.PRNGKey(seed))
     if eos_token_id is not None:

@@ -99,10 +99,9 @@ def pack_documents(tokens: np.ndarray, doc_offsets: np.ndarray, row_len: int,
     out = np.full((rows, row_len), eos_id, dtype=np.int32)
     lib = get_lib()
     if lib is not None:
-        order_p = (_i64p(np.ascontiguousarray(doc_order, dtype=np.int64))
-                   if doc_order is not None else
-                   ctypes.POINTER(ctypes.c_int64)())
+        order_p = ctypes.POINTER(ctypes.c_int64)()
         if doc_order is not None:
+            # keep the contiguous array alive while the pointer is in use
             doc_order = np.ascontiguousarray(doc_order, dtype=np.int64)
             order_p = _i64p(doc_order)
         written = lib.pack_documents(_i32p(tokens), _i64p(doc_offsets),

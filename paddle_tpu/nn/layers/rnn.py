@@ -97,7 +97,7 @@ class _RNNBase(Layer):
         h = jnp.zeros((b, self.hidden_size))
         return (h, jnp.zeros_like(h)) if self.has_c else h
 
-    def _run_cell(self, cell, x, reverse=False):
+    def _run_cell(self, cell, x, init_state=None, reverse=False):
         """x: (b, s, in) → outputs (b, s, hidden), final state."""
         xs = jnp.swapaxes(x, 0, 1)               # (s, b, in)
         if reverse:
@@ -111,10 +111,22 @@ class _RNNBase(Layer):
             h = out[0] if self.has_c else out
             return out, h
 
-        final, hs = jax.lax.scan(step, self._zero_state(x.shape[0]), xs)
+        carry0 = (self._zero_state(x.shape[0]) if init_state is None
+                  else init_state)
+        final, hs = jax.lax.scan(step, carry0, xs)
         if reverse:
             hs = hs[::-1]
         return jnp.swapaxes(hs, 0, 1), final
+
+    def _initial_state(self, initial_states, idx):
+        """State for (layer, direction) slot `idx` from the stacked
+        (num_layers * n_dir, b, hidden) initial_states (h or (h, c))."""
+        if initial_states is None:
+            return None
+        if self.has_c:
+            h0, c0 = initial_states
+            return (h0[idx], c0[idx])
+        return initial_states[idx]
 
     def forward(self, x, initial_states=None):
         if self.time_major:
@@ -124,12 +136,19 @@ class _RNNBase(Layer):
             if self.bidirect:
                 fwd_cell = self.cells[2 * layer]
                 bwd_cell = self.cells[2 * layer + 1]
-                out_f, fin_f = self._run_cell(fwd_cell, x)
-                out_b, fin_b = self._run_cell(bwd_cell, x, reverse=True)
+                out_f, fin_f = self._run_cell(
+                    fwd_cell, x,
+                    init_state=self._initial_state(initial_states, 2 * layer))
+                out_b, fin_b = self._run_cell(
+                    bwd_cell, x, reverse=True,
+                    init_state=self._initial_state(initial_states,
+                                                   2 * layer + 1))
                 x = jnp.concatenate([out_f, out_b], axis=-1)
                 finals.extend([fin_f, fin_b])
             else:
-                x, fin = self._run_cell(self.cells[layer], x)
+                x, fin = self._run_cell(
+                    self.cells[layer], x,
+                    init_state=self._initial_state(initial_states, layer))
                 finals.append(fin)
             if self.dropout and layer < self.num_layers - 1:
                 x = F.dropout(x, self.dropout, training=self.training)

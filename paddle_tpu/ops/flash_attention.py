@@ -14,6 +14,7 @@ the XLA einsum path covers everything else. Kernels compute internally in
 """
 
 import functools
+import logging
 import math
 from typing import Optional
 
@@ -23,6 +24,9 @@ from jax import lax
 
 NEG_INF = -1e30
 LANES = 128
+
+logger = logging.getLogger("paddle_tpu.ops.flash_attention")
+_fallback_logged = False
 
 
 def _repeat_kv(k, n_rep):
@@ -80,8 +84,17 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             and q.shape[1] % 512 == 0 and q.shape[-1] in (64, 128, 256)):
         try:
             return _flash_attention_vjp(q, k, v, is_causal, scale)
-        except Exception:
-            pass
+        except Exception as e:
+            from paddle_tpu.core.flags import flag
+            if flag("FLAGS_pallas_strict"):
+                raise
+            global _fallback_logged
+            if not _fallback_logged:
+                _fallback_logged = True
+                logger.warning(
+                    "Pallas flash attention failed (%s: %s); falling back to "
+                    "the XLA path. Set FLAGS_pallas_strict=1 to raise "
+                    "instead.", type(e).__name__, e)
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           scale=scale, dropout_p=dropout_p, training=training)
 

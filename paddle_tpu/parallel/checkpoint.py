@@ -24,37 +24,56 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _lookup(table, full_key):
+    """Look a leaf up by full dotted path, falling back to the bare leaf
+    name (the pre-path-keyed convention) when the path key is absent."""
+    if full_key in table:
+        return table[full_key]
+    return table.get(full_key.rsplit(".", 1)[-1])
+
+
 def _abstract_target(tree, shardings=None, mesh: Optional[Mesh] = None,
-                     specs=None):
+                     specs=None, _prefix=""):
     """Abstract pytree with target shardings for resharding-on-load.
 
     `tree` may hold real arrays OR jax.ShapeDtypeStruct. Shardings come from
-    `shardings` (pytree of Sharding), or (mesh, specs {key: PartitionSpec})
-    for flat dicts, or the arrays' current shardings.
+    `shardings` ({dotted.path: Sharding}, bare leaf names accepted), or
+    (mesh, specs {dotted.path: PartitionSpec}), or the arrays' current
+    shardings. Nested dicts are keyed by full dotted path so repeated leaf
+    names (e.g. every layer's 'weight') don't collide.
     """
-    def one(path_key, leaf):
-        shape = leaf.shape
-        dtype = leaf.dtype
+    def one(full_key, leaf):
         sh = None
-        if shardings is not None:
-            sh = shardings[path_key] if isinstance(shardings, dict) else None
+        if isinstance(shardings, dict):
+            sh = _lookup(shardings, full_key)
+            if sh is None:
+                raise KeyError(
+                    f"shardings has no entry for {full_key!r} (neither the "
+                    "dotted path nor the bare leaf name)")
         elif mesh is not None:
-            spec = (specs or {}).get(path_key, P())
-            sh = NamedSharding(mesh, spec)
+            spec = _lookup(specs or {}, full_key)
+            sh = NamedSharding(mesh, spec if spec is not None else P())
         elif isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
             sh = leaf.sharding
-        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
 
     if isinstance(tree, dict):
         out = {}
         for k, v in tree.items():
+            full = f"{_prefix}{k}"
             if isinstance(v, dict):
-                out[k] = _abstract_target(v, shardings, mesh, specs)
+                out[k] = _abstract_target(v, shardings, mesh, specs,
+                                          _prefix=full + ".")
             else:
-                out[k] = one(k, v)
+                out[k] = one(full, v)
         return out
-    return jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+
+    def generic(leaf):
+        sh = leaf.sharding if isinstance(leaf, jax.Array) else getattr(
+            leaf, "sharding", None)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+
+    return jax.tree_util.tree_map(generic, tree)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str):

@@ -79,3 +79,18 @@ def test_elastic_loop_resumes_after_crash(tmp_path):
     assert loop.restarts == 1
     assert float(final["step_sum"]) == sum(range(8))
     m.close()
+
+
+def test_nested_specs_keyed_by_full_path(tmp_path):
+    """Repeated leaf names ('w') in nested dicts reshard independently."""
+    mesh = build_mesh({"mp": 4, "dp": 2})
+    state = {"layer0": {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8)},
+             "layer1": {"w": jnp.ones((8, 8)) * 2}}
+    save_state_dict(state, str(tmp_path / "ckpt"))
+    restored = load_state_dict(
+        str(tmp_path / "ckpt"), target=state, mesh=mesh,
+        specs={"layer0.w": P("mp", None), "layer1.w": P(None, "mp")})
+    assert restored["layer0"]["w"].sharding.spec == P("mp", None)
+    assert restored["layer1"]["w"].sharding.spec == P(None, "mp")
+    np.testing.assert_array_equal(np.asarray(restored["layer1"]["w"]),
+                                  np.asarray(state["layer1"]["w"]))

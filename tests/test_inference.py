@@ -89,3 +89,18 @@ def test_predictor_roundtrip(tmp_path):
     x = jnp.asarray([[1, 2, 3]])
     np.testing.assert_allclose(np.asarray(pred(x)), np.asarray(model(x)),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_generate_caches_jitted_program():
+    cfg, model = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]])
+    out1 = generate(model, prompt, max_new_tokens=5, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    assert len(model._generate_jit_cache) == 1
+    out2 = generate(model, prompt, max_new_tokens=5, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    assert len(model._generate_jit_cache) == 1   # no retrace, same program
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    generate(model, prompt, max_new_tokens=6, temperature=0.0,
+             cache_dtype=jnp.float32)
+    assert len(model._generate_jit_cache) == 2   # new static shape, new entry

@@ -95,3 +95,32 @@ def test_optimizers_minimize_quadratic(opt_cls, kw):
         params, state = step(params, state)
     final_obj = float(jnp.sum(params["w"] ** 2))
     assert final_obj < 0.7 * init_obj   # monotone optimizers; rates differ
+
+
+@pytest.mark.parametrize("cls", [nn.SimpleRNN, nn.LSTM, nn.GRU])
+def test_rnn_initial_states_chunked_equals_full(cls):
+    """Running two chunks threaded via initial_states == one full run."""
+    paddle_tpu.seed(0)
+    rnn = cls(4, 8, num_layers=2)
+    x = jnp.asarray(np.random.RandomState(2).randn(3, 10, 4), jnp.float32)
+    out_full, final_full = rnn(x)
+    out1, mid = rnn(x[:, :6])
+    out2, final2 = rnn(x[:, 6:], initial_states=mid)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(out_full[:, 6:]),
+                               rtol=1e-5, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-5),
+        final2, final_full)
+
+
+def test_bidirectional_initial_states_change_output():
+    paddle_tpu.seed(0)
+    rnn = nn.LSTM(4, 8, num_layers=1, direction="bidirect")
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 5, 4), jnp.float32)
+    out0, _ = rnn(x)
+    h0 = jnp.ones((2, 2, 8), jnp.float32)
+    c0 = jnp.ones((2, 2, 8), jnp.float32)
+    out1, _ = rnn(x, initial_states=(h0, c0))
+    assert not np.allclose(np.asarray(out0), np.asarray(out1))
