@@ -29,6 +29,16 @@ logger = logging.getLogger("paddle_tpu.ops.flash_attention")
 _fallback_logged = False
 
 
+def _log_fallback(which, e):
+    global _fallback_logged
+    if not _fallback_logged:
+        _fallback_logged = True
+        logger.warning(
+            "Pallas flash attention %s failed (%s: %s); falling back to the "
+            "XLA path. Set FLAGS_pallas_strict=1 to raise instead.",
+            which, type(e).__name__, e)
+
+
 def _repeat_kv(k, n_rep):
     if n_rep == 1:
         return k
@@ -88,13 +98,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             from paddle_tpu.core.flags import flag
             if flag("FLAGS_pallas_strict"):
                 raise
-            global _fallback_logged
-            if not _fallback_logged:
-                _fallback_logged = True
-                logger.warning(
-                    "Pallas flash attention failed (%s: %s); falling back to "
-                    "the XLA path. Set FLAGS_pallas_strict=1 to raise "
-                    "instead.", type(e).__name__, e)
+            _log_fallback("forward", e)
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           scale=scale, dropout_p=dropout_p, training=training)
 
@@ -322,8 +326,11 @@ def _flash_vjp_fwd(q, k, v, is_causal, scale):
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(is_causal, scale, res, g):
-    q, k, v, out, lse = res
+def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None):
+    """Shared Pallas backward. `lse` is (b, h, s, LANES). When `g_lse`
+    (b, h, s) is given (cotangent on the returned LSE, e.g. from a ring
+    merge), it folds into the softmax-grad correction: dS = P·(dP − Δ)
+    with Δ_eff = rowsum(dout·out) − g_lse, since ∂lse/∂S = P."""
     b, s, h, d = q.shape
     n_kv = k.shape[2]
     n_rep = h // n_kv
@@ -338,6 +345,8 @@ def _flash_vjp_bwd(is_causal, scale, res, g):
     # delta = rowsum(dout * out) (fp32) — the softmax-grad correction term
     delta = jnp.sum(dot.astype(jnp.float32) * out_t.astype(jnp.float32),
                     axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
     dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc)
@@ -353,4 +362,100 @@ def _flash_vjp_bwd(is_causal, scale, res, g):
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_vjp_bwd(is_causal, scale, res, g):
+    q, k, v, out, lse = res
+    try:
+        return _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale)
+    except Exception as e:
+        from paddle_tpu.core.flags import flag
+        if flag("FLAGS_pallas_strict"):
+            raise
+        _log_fallback("backward", e)
+        _, pull = jax.vjp(
+            lambda q_, k_, v_: _xla_attention(q_, k_, v_,
+                                              is_causal=is_causal,
+                                              scale=scale, dropout_p=0.0),
+            q, k, v)
+        return pull(g)
+
+
 _flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---- forward + LSE (ring-attention building block) ------------------------
+
+def _pallas_lse_ok(q, k):
+    from paddle_tpu.ops import use_pallas
+    s = q.shape[1]
+    return (use_pallas() and s == k.shape[1] and s >= 1024
+            and s % _BLK == 0 and q.shape[-1] in (64, 128, 256))
+
+
+def _xla_fwd_lse(q, k, v, is_causal, scale):
+    """XLA fallback: (out (b,s,h,d), lse (b,h,s) fp32)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n_rep = h // k.shape[2]
+    kr = _repeat_kv(k, n_rep)
+    vr = _repeat_kv(v, n_rep)
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                        preferred_element_type=jnp.float32) * sc
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / l[..., None]).astype(q.dtype),
+                     vr)
+    return out.astype(q.dtype), m + jnp.log(l)
+
+
+def _fwd_lse_dispatch(q, k, v, is_causal, scale):
+    if _pallas_lse_ok(q, k):
+        out, lse = _flash_fwd(q, k, v, is_causal, scale)
+        return out, lse[..., 0]
+    return _xla_fwd_lse(q, k, v, is_causal, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_fwd_lse(q, k, v, is_causal=False, scale=None):
+    """Attention forward returning (out, lse) for blockwise/ring merging.
+
+    out (b, s, h, d) is the normalized chunk attention; lse (b, h, s) fp32
+    is the log-sum-exp of the (scaled, masked) scores — together they let a
+    caller merge several KV chunks exactly (ring attention, SURVEY.md
+    §5-long-context). Pallas blockwise kernels on TPU when shapes allow
+    (memory bounded by the 512-block tiles, never s²); XLA otherwise.
+    Differentiable, including the lse output (the cotangent folds into the
+    softmax-grad delta)."""
+    return _fwd_lse_dispatch(q, k, v, is_causal, scale)
+
+
+def _fwd_lse_vjp_fwd(q, k, v, is_causal, scale):
+    out, lse = _fwd_lse_dispatch(q, k, v, is_causal, scale)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fwd_lse_vjp_bwd(is_causal, scale, res, cts):
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    if _pallas_lse_ok(q, k):
+        try:
+            lse_lanes = jnp.broadcast_to(lse[..., None],
+                                         lse.shape + (LANES,))
+            return _pallas_bwd_impl(q, k, v, out, lse_lanes, g_out,
+                                    is_causal, scale, g_lse=g_lse)
+        except Exception as e:
+            from paddle_tpu.core.flags import flag
+            if flag("FLAGS_pallas_strict"):
+                raise
+            _log_fallback("lse-backward", e)
+    _, pull = jax.vjp(
+        lambda q_, k_, v_: _xla_fwd_lse(q_, k_, v_, is_causal, scale),
+        q, k, v)
+    return pull((g_out, g_lse))
+
+
+flash_fwd_lse.defvjp(_fwd_lse_vjp_fwd, _fwd_lse_vjp_bwd)

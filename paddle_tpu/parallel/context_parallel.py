@@ -19,7 +19,6 @@ with the other mesh axes (dp/mp/...) handled by GSPMD, and both are
 differentiable (scan + ppermute/all_to_all transpose cleanly).
 """
 
-import math
 from functools import partial
 from typing import Optional
 
@@ -30,61 +29,66 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _repeat_kv(k, n_rep):
-    if n_rep == 1:
-        return k
-    b, s, h, d = k.shape
-    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
-        b, s, h * n_rep, d)
-
-
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                          scale: Optional[float] = None):
-    """Blockwise ring attention. MUST run inside shard_map manual over
-    `axis_name`; q/k/v are the local seq shards (b, s_loc, h, d)."""
+    """Flash-grade ring attention. MUST run inside shard_map manual over
+    `axis_name`; q/k/v are the local seq shards (b, s_loc, h, d).
+
+    Each ring step runs the flash kernel (`flash_fwd_lse`: Pallas blockwise
+    on TPU — memory bounded by the 512-block tiles, never s_loc²) of local
+    Q against the KV chunk in hand, then merges the chunk's normalized
+    output into the running result with the standard LSE merge and rotates
+    KV with ppermute. Fully-masked chunks (a causal ring where the chunk
+    comes from later positions) skip compute via lax.switch."""
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    n_rep = h // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
-    sc = scale if scale is not None else 1.0 / math.sqrt(d)
-
-    qf = q.astype(jnp.float32) * sc
-    # positions of my queries within the global sequence
-    q_pos = me * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    from paddle_tpu.ops.flash_attention import flash_fwd_lse
+
+    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+
+    def chunk_masked(q, k_i, v_i):
+        # constants, but pcast so all switch branches agree on vma
+        return (vary(jnp.zeros((b, s_loc, h, d), q.dtype)),
+                vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32)))
+
+    def chunk_diag(q, k_i, v_i):
+        return flash_fwd_lse(q, k_i, v_i, True, scale)
+
+    def chunk_full(q, k_i, v_i):
+        return flash_fwd_lse(q, k_i, v_i, False, scale)
 
     def ring_step(carry, i):
-        acc, m_prev, l_prev, kv = carry
+        acc, lse_run, kv = carry
         k_i, v_i = kv
         # the KV chunk in hand at step i originated on shard (me - i) mod n
         src = (me - i) % n
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_i.astype(jnp.float32))
         if causal:
-            k_pos = src * s_loc + jax.lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1)
-            mask = q_pos >= k_pos
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
-        m_cur = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(scores - m_cur[..., None])
-        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_i.astype(jnp.float32))
+            branch = jnp.where(src == me, 1, jnp.where(src < me, 2, 0))
+            out_i, lse_i = jax.lax.switch(
+                branch, (chunk_masked, chunk_diag, chunk_full), q, k_i, v_i)
+        else:
+            out_i, lse_i = chunk_full(q, k_i, v_i)
+        out_t = jnp.transpose(out_i, (0, 2, 1, 3)).astype(jnp.float32)
+        # LSE merge of normalized partials: lse_new = logaddexp(run, chunk),
+        # acc = Σ out_i · exp(lse_i − lse_new)
+        m_new = jnp.maximum(lse_run, lse_i)
+        e_run = jnp.exp(lse_run - m_new)
+        e_i = jnp.exp(lse_i - m_new)
+        denom = e_run + e_i
+        acc = (acc * e_run[..., None] + out_t * e_i[..., None]) \
+            / denom[..., None]
+        lse_new = m_new + jnp.log(denom)
         kv = jax.lax.ppermute(kv, axis_name, perm)
-        return (acc, m_cur, l_cur, kv), None
+        return (acc, lse_new, kv), None
 
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
     acc0 = vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
-    m0 = vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
-    l0 = vary(jnp.zeros((b, h, s_loc), jnp.float32))
-    (acc, m, l, _), _ = jax.lax.scan(
-        ring_step, (acc0, m0, l0, (k, v)), jnp.arange(n))
-    # fully-masked rows (can't happen for causal self-attn, but keep safe)
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    lse0 = vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+    (acc, _, _), _ = jax.lax.scan(
+        ring_step, (acc0, lse0, (k, v)), jnp.arange(n))
+    return jnp.transpose(acc, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True,

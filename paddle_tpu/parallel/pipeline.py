@@ -106,10 +106,10 @@ class _FnLayer(Layer):
 class PipelineParallel(Layer):
     """Runtime wrapper chosen by fleet.distributed_model when pp_degree>1.
 
-    `train_batch(data, optimizer)` runs the microbatched schedule. The
-    underlying schedule is GPipe-style accumulation compiled into one jit
-    (`pipeline_spmd_fn`); host-driven 1F1B over per-stage jits is available
-    as `schedule='host1f1b'` for DCN-spanning topologies.
+    `train_batch(data, optimizer)` runs the microbatched schedule selected
+    by `strategy.pipeline_configs.schedule_mode`: the lockstep 1F1B engine
+    (default; interleaved when virtual_pp_degree > 1) or GPipe-style
+    accumulate-then-backward ('FThenB'). Both compile into one jit.
     """
 
     def __init__(self, layers, hcg, strategy=None):
@@ -182,16 +182,25 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
                              donate: bool = True):
     """Compiled pp×mp×dp×sharding train step via collective-permute pipelining.
 
-    One jit: embed + a scan over (n_micro + n_stages - 1) ticks, each tick
-    running this stage's block stack and rotating activations to the next
-    stage with ppermute (reference 1F1B/NCCL-p2p analog — SURVEY.md §3.3);
-    TP/DP/ZeRO ride the mesh's Auto axes via GSPMD inside the same program.
-    Schedule is GPipe-style accumulation (activations for in-flight
-    microbatches are rematerialized when strategy.recompute is on).
+    One jit for the whole schedule; TP/DP/ZeRO ride the mesh's Auto axes via
+    GSPMD inside the same program. `strategy.pipeline_configs.schedule_mode`
+    selects the schedule (reference: `PipelineParallel.
+    forward_backward_pipeline` 1F1B + interleaved, SURVEY.md §2.6-PP):
+
+    - '1F1B' (default): lockstep table-driven 1F1B — each scan tick runs one
+      forward unit and one backward unit per stage, activations ppermute
+      forward, gradients ppermute backward, backward recomputes from an
+      O(pp)-deep stash (activation liveness independent of n_micro). With
+      `virtual_pp_degree > 1` the same engine runs the interleaved
+      (virtual-chunk) schedule.
+    - 'FThenB' / 'gpipe': GPipe-style accumulation in one differentiated
+      scan over (n_micro + n_stages - 1) ticks; activation liveness grows
+      with n_micro (remat when strategy.recompute is on).
 
     Returns (step_fn, init_fn); state is a flat dict with ``embed.``/
     ``blocks.``/``head.`` key prefixes, block params stacked
-    (n_stages, per_stage, ...) and sharded over the "pp" axis.
+    (n_stages, per_stage, ...) — (n_stages, v, per_chunk, ...) when
+    interleaved — and sharded over the "pp" axis.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -209,17 +218,45 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
     if n_micro < n_stages:
         n_micro = n_stages  # keep the bubble bounded; reference asserts too
 
+    schedule = (strategy.pipeline_configs.schedule_mode or "1F1B").lower()
+    v_chunks = max(1, strategy.pipeline_configs.virtual_pp_degree)
+    if schedule in ("fthenb", "gpipe"):
+        schedule = "gpipe"
+        if v_chunks > 1:
+            raise ValueError("virtual_pp_degree > 1 requires the 1F1B "
+                             "schedule (interleaved)")
+    elif schedule == "1f1b":
+        if v_chunks > 1 and n_micro % n_stages:
+            raise ValueError(
+                f"interleaved schedule needs accumulate_steps "
+                f"({n_micro}) divisible by pp ({n_stages})")
+    else:
+        raise ValueError(f"unknown schedule_mode "
+                         f"{strategy.pipeline_configs.schedule_mode!r}")
+
     parts: PipelineParts = model.pipeline_parts()
     n_layers = len(parts.block_states)
-    if n_layers % n_stages:
-        raise ValueError(f"{n_layers} layers not divisible by pp={n_stages}")
+    if n_layers % (n_stages * v_chunks):
+        raise ValueError(f"{n_layers} layers not divisible by "
+                         f"pp×virtual_pp={n_stages}×{v_chunks}")
     per_stage = n_layers // n_stages
+    per_chunk = n_layers // (n_stages * v_chunks)
 
     # ---- flat state: embed. / blocks.(stacked) / head. ----
-    stacked = {
-        k: _jnp.stack([st[k] for st in parts.block_states]).reshape(
-            (n_stages, per_stage) + parts.block_states[0][k].shape)
-        for k in parts.block_states[0]}
+    # Layer ownership: virtual stage V = c*n_stages + s holds layers
+    # [V*per_chunk, (V+1)*per_chunk) — for v_chunks == 1 this is the plain
+    # contiguous split, stacked (n_stages, per_stage, ...); interleaved
+    # stacks (n_stages, v, per_chunk, ...) with [s, c, j] = layer
+    # (c*n_stages + s)*per_chunk + j.
+    def stack_blocks(leaves):
+        arr = _jnp.stack(leaves)                    # (L, ...)
+        if v_chunks == 1:
+            return arr.reshape((n_stages, per_stage) + leaves[0].shape)
+        arr = arr.reshape((v_chunks, n_stages, per_chunk) + leaves[0].shape)
+        return _jnp.swapaxes(arr, 0, 1)             # (S, v, per_chunk, ...)
+
+    stacked = {k: stack_blocks([st[k] for st in parts.block_states])
+               for k in parts.block_states[0]}
     state0 = {}
     state0.update({f"embed.{k}": v for k, v in parts.embed_state.items()})
     state0.update({f"blocks.{k}": v for k, v in stacked.items()})
@@ -229,11 +266,12 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
     zstage = strategy.sharding_configs.stage if strategy.sharding else 0
     zdeg = hcg.get_sharding_parallel_world_size()
 
+    blk_lead = ("pp", None) if v_chunks == 1 else ("pp", None, None)
     pspecs = {}
     for k, spec in parts.embed_pspecs.items():
         pspecs[f"embed.{k}"] = spec
     for k, spec in parts.block_pspecs.items():
-        pspecs[f"blocks.{k}"] = P("pp", None, *tuple(spec))
+        pspecs[f"blocks.{k}"] = P(*blk_lead, *tuple(spec))
     for k, spec in parts.head_pspecs.items():
         pspecs[f"head.{k}"] = spec
     if zstage >= 3 and zdeg > 1:
@@ -323,9 +361,228 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
             out_specs=P())
         return f(blocks_st, embed_st, head_st, ids_mb, labels_mb)
 
+    # ---- 1F1B / interleaved lockstep engine ------------------------------
+    #
+    # Manual backward: the schedule tables (pipeline_schedules.py) fix, per
+    # tick and stage, one forward unit and one backward unit. Backward
+    # recomputes the unit forward from a stashed input (jax.vjp), so
+    # activation liveness is the stash ring (O(pp·v)), not O(n_micro) as in
+    # the differentiated-scan GPipe path.
+    def loss_and_grads_1f1b(flat_state, ids_mb, labels_mb):
+        from paddle_tpu.parallel.pipeline_schedules import (
+            build_schedule_tables)
+
+        tb = build_schedule_tables(n_stages, v_chunks, n_micro)
+        # tick-major int32 tables → scan xs (rows shaped (n_stages,))
+        xs = {name: _jnp.asarray(getattr(tb, name)) for name in
+              ("f_c", "f_m", "f_active", "f_is_last", "f_src", "f_wr",
+               "f_stash", "b_c", "b_m", "b_active", "b_is_v0", "b_gsrc",
+               "b_gwr", "b_stash")}
+
+        embed_st, blocks_st, head_st = split_state(flat_state)
+
+        def inner(blocks_local, embed_st, head_st, ids_mb, labels_mb):
+            stage = jax.lax.axis_index("pp")
+            # local blocks: (1, per_stage, ...) or (1, v, per_chunk, ...)
+            #   → uniform (v, per_chunk, ...)
+            blocks_me = jax.tree_util.tree_map(
+                lambda a: a[0].reshape((v_chunks, per_chunk) + a.shape[2:])
+                if v_chunks == 1 else a[0], blocks_local)
+
+            f32 = _jnp.float32
+
+            def unit_fwd(e_st, w_unit, h_st, x_in, ids_m, labels_m, is_v0):
+                """One virtual-stage unit: (embed-if-V0) → per_chunk blocks
+                → head loss. Head/embed run on every unit; cotangent seeds
+                select which gradients are real."""
+                emb = parts.embed_apply(e_st, ids_m)
+                a = _jnp.where(is_v0, emb, x_in)
+
+                def body(h, one_layer):
+                    out = parts.block_apply(one_layer, h)
+                    if isinstance(out, tuple):
+                        return out[0], out[1].astype(f32)
+                    return out, _jnp.zeros((), f32)
+
+                h, extras = jax.lax.scan(body, a, w_unit)
+                if parts.tied_head:
+                    mb_loss = parts.head_apply(h_st, e_st, h, labels_m)
+                else:
+                    mb_loss = parts.head_apply(h_st, h, labels_m)
+                return h, mb_loss.astype(f32), _jnp.sum(extras)
+
+            mb = ids_mb.shape[1]
+            seq = ids_mb.shape[2]
+            h_probe = jax.eval_shape(
+                lambda s, i: parts.embed_apply(s, i), embed_st,
+                jax.ShapeDtypeStruct((mb, seq), ids_mb.dtype))
+            h_shape, h_dtype = h_probe.shape, h_probe.dtype
+
+            def zeros_h(lead=()):
+                return _jnp.zeros(tuple(lead) + h_shape, h_dtype)
+
+            def _vary_one(a):
+                if "pp" in getattr(jax.typeof(a), "vma", ()):
+                    return a   # already varying over pp
+                return jax.lax.pcast(a, ("pp",), to="varying")
+
+            vary = lambda t: jax.tree_util.tree_map(_vary_one, t)
+
+            carry0 = dict(
+                h_wire=zeros_h(), g_wire=zeros_h(),
+                f_buf=zeros_h((tb.fwd_ring,)),
+                g_buf=zeros_h((tb.grad_ring,)),
+                stash=zeros_h((tb.stash_ring,)),
+                dembed=jax.tree_util.tree_map(_jnp.zeros_like, embed_st),
+                dblocks=jax.tree_util.tree_map(_jnp.zeros_like, blocks_me),
+                dhead=jax.tree_util.tree_map(_jnp.zeros_like, head_st),
+                loss=_jnp.zeros((), f32), extra=_jnp.zeros((), f32))
+            carry0 = vary(carry0)
+
+            def pick(row):
+                return _jnp.take(row, stage, axis=0)
+
+            inv_m = 1.0 / n_micro
+
+            def tick(carry, row):
+                c = carry
+                # ---- store wire arrivals (writes land before any read) ----
+                f_wr = pick(row["f_wr"])
+                f_buf = c["f_buf"].at[_jnp.clip(f_wr, 0, tb.fwd_ring - 1)
+                                      ].set(_jnp.where(f_wr >= 0,
+                                                       c["h_wire"],
+                                                       c["f_buf"][_jnp.clip(
+                                                           f_wr, 0,
+                                                           tb.fwd_ring - 1)]))
+                b_gwr = pick(row["b_gwr"])
+                g_buf = c["g_buf"].at[_jnp.clip(b_gwr, 0, tb.grad_ring - 1)
+                                      ].set(_jnp.where(b_gwr >= 0,
+                                                       c["g_wire"],
+                                                       c["g_buf"][_jnp.clip(
+                                                           b_gwr, 0,
+                                                           tb.grad_ring - 1)]))
+
+                # ---- F slot ----
+                f_act = pick(row["f_active"]).astype(bool)
+                f_src = pick(row["f_src"])
+                f_is_v0 = f_src == -2
+                c_f = pick(row["f_c"])
+                m_f = pick(row["f_m"])
+                ids_f = jax.lax.dynamic_index_in_dim(
+                    ids_mb, m_f, 0, keepdims=False)
+                lbl_f = jax.lax.dynamic_index_in_dim(
+                    labels_mb, m_f, 0, keepdims=False)
+                x_f = jax.lax.dynamic_index_in_dim(
+                    f_buf, _jnp.clip(f_src, 0, tb.fwd_ring - 1), 0,
+                    keepdims=False)
+                w_f = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, c_f, 0, keepdims=False), blocks_me)
+                h_f, loss_f, extra_f = unit_fwd(
+                    embed_st, w_f, head_st, x_f, ids_f, lbl_f, f_is_v0)
+                f_stash = pick(row["f_stash"])
+                stash = c["stash"].at[f_stash].set(
+                    _jnp.where(f_act, x_f, c["stash"][f_stash]))
+                f_is_last = pick(row["f_is_last"]).astype(bool)
+                loss_acc = c["loss"] + _jnp.where(f_act & f_is_last,
+                                                  loss_f, 0.0)
+                extra_acc = c["extra"] + _jnp.where(f_act, extra_f, 0.0)
+                h_wire = _jnp.where(f_act, h_f, _jnp.zeros_like(h_f))
+
+                # ---- B slot (vjp recompute from the stash) ----
+                b_act = pick(row["b_active"]).astype(bool)
+                b_gsrc = pick(row["b_gsrc"])
+                b_is_last = b_gsrc == -2
+                b_is_v0 = pick(row["b_is_v0"]).astype(bool)
+                c_b = pick(row["b_c"])
+                m_b = pick(row["b_m"])
+                ids_b = jax.lax.dynamic_index_in_dim(
+                    ids_mb, m_b, 0, keepdims=False)
+                lbl_b = jax.lax.dynamic_index_in_dim(
+                    labels_mb, m_b, 0, keepdims=False)
+                x_b = jax.lax.dynamic_index_in_dim(
+                    stash, pick(row["b_stash"]), 0, keepdims=False)
+                w_b = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, c_b, 0, keepdims=False), blocks_me)
+
+                def b_fwd(e_st, w_unit, h_st, x_in):
+                    return unit_fwd(e_st, w_unit, h_st, x_in, ids_b, lbl_b,
+                                    b_is_v0)
+
+                (h_b, loss_b, extra_b), vjp_fn = jax.vjp(
+                    b_fwd, embed_st, w_b, head_st, x_b)
+                g_read = jax.lax.dynamic_index_in_dim(
+                    g_buf, _jnp.clip(b_gsrc, 0, tb.grad_ring - 1), 0,
+                    keepdims=False)
+                b_act_f = b_act.astype(f32)
+                g_h = _jnp.where(b_is_last, _jnp.zeros_like(g_read),
+                                 g_read) * b_act_f.astype(g_read.dtype)
+                g_loss = _jnp.where(b_is_last & b_act, inv_m, 0.0)
+                g_extra = b_act_f * inv_m
+
+                def match_cot(g, primal):
+                    """Cotangent vma must equal the primal's. An invariant
+                    primal (e.g. the constant-zero aux loss of a non-MoE
+                    block) contributes no gradient, so a zero cotangent is
+                    exact there."""
+                    if "pp" in getattr(jax.typeof(primal), "vma", ()):
+                        return _vary_one(g)
+                    return _jnp.zeros_like(primal)
+
+                de, dw, dh, dx = vjp_fn((match_cot(g_h, h_b),
+                                         match_cot(g_loss, loss_b),
+                                         match_cot(g_extra, extra_b)))
+                dembed = jax.tree_util.tree_map(
+                    lambda acc, d: acc + d, c["dembed"], de)
+                dhead = jax.tree_util.tree_map(
+                    lambda acc, d: acc + d, c["dhead"], dh)
+                dblocks = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[c_b].add(d), c["dblocks"], dw)
+                g_wire = _jnp.where(b_act, dx, _jnp.zeros_like(dx))
+
+                # ---- rotate wires ----
+                h_wire = jax.lax.ppermute(h_wire, "pp", perm)
+                g_wire = jax.lax.ppermute(
+                    g_wire, "pp", [(d, s_) for (s_, d) in perm])
+
+                new_c = dict(h_wire=h_wire, g_wire=g_wire, f_buf=f_buf,
+                             g_buf=g_buf, stash=stash, dembed=dembed,
+                             dblocks=dblocks, dhead=dhead, loss=loss_acc,
+                             extra=extra_acc)
+                return new_c, None
+
+            final, _ = jax.lax.scan(tick, carry0, xs)
+
+            loss_total = jax.lax.psum(final["loss"] + final["extra"],
+                                      "pp") * inv_m
+            dembed = jax.lax.psum(final["dembed"], "pp")
+            dhead = jax.lax.psum(final["dhead"], "pp")
+            # back to the state layout, with the local leading stage dim
+            dblocks = jax.tree_util.tree_map(
+                lambda a: (a.reshape((1, per_stage) + a.shape[2:])
+                           if v_chunks == 1 else a[None]),
+                final["dblocks"])
+            return loss_total, dembed, dblocks, dhead
+
+        f = jax.shard_map(
+            inner, mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(), P(), P(), P()),
+            out_specs=(P(), P(), P("pp"), P()))
+        loss, dembed, dblocks, dhead = f(blocks_st, embed_st, head_st,
+                                         ids_mb, labels_mb)
+        grads = {}
+        grads.update({f"embed.{k}": g for k, g in dembed.items()})
+        grads.update({f"blocks.{k}": g for k, g in dblocks.items()})
+        grads.update({f"head.{k}": g for k, g in dhead.items()})
+        return loss, grads
+
     def _step(flat_state, opt_state, ids_mb, labels_mb):
-        loss, grads = jax.value_and_grad(pipeline_loss)(
-            flat_state, ids_mb, labels_mb)
+        if schedule == "gpipe":
+            loss, grads = jax.value_and_grad(pipeline_loss)(
+                flat_state, ids_mb, labels_mb)
+        else:
+            loss, grads = loss_and_grads_1f1b(flat_state, ids_mb, labels_mb)
         grads = {k: jax.lax.with_sharding_constraint(
             g, NamedSharding(mesh, pspecs[k])) for k, g in grads.items()}
         new_state, new_opt = optimizer.update(grads, opt_state, flat_state)
@@ -380,6 +637,42 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
         with jax.set_mesh(mesh):
             return jit_step(state, opt_state, ids_mb, labels_mb)
 
+    def lower(batch_shape, seq_len, ids_dtype=_jnp.int32):
+        """AOT-lower the compiled step from abstract shapes (no real
+        buffers): returns jax.stages.Lowered — .compile().memory_analysis()
+        gives the per-device memory accounting used by feasibility reports
+        (SCALE.md) without allocating a single parameter."""
+        if batch_shape % n_micro:
+            raise ValueError(
+                f"batch {batch_shape} not divisible by n_micro={n_micro}")
+        mb = batch_shape // n_micro
+        abstract_state = {k: jax.ShapeDtypeStruct(
+            v.shape, v.dtype, sharding=NamedSharding(mesh, pspecs[k]))
+            for k, v in state0.items()}
+        abstract_opt = jax.eval_shape(optimizer.init_state, abstract_state)
+
+        def shard_slot(tree):
+            if isinstance(tree, dict):
+                return {k: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=NamedSharding(mesh, ospecs.get(k, P())))
+                    for k, v in tree.items()}
+            return tree
+        abstract_opt = {slot: shard_slot(t) for slot, t in
+                        abstract_opt.items()}
+        dp_total = 1
+        for a in dp_axes:
+            dp_total *= mesh.shape[a]
+        mb_spec = bspec if (dp_total > 1 and mb % dp_total == 0) else P(None)
+        mbatch = jax.ShapeDtypeStruct(
+            (n_micro, mb, seq_len), ids_dtype,
+            sharding=NamedSharding(mesh, P(None, *tuple(mb_spec))))
+        with jax.set_mesh(mesh):
+            return jit_step.lower(abstract_state, abstract_opt, mbatch,
+                                  mbatch)
+
+    step_fn.lower = lower
+    step_fn.n_micro = n_micro
     return step_fn, init_fn
 
 

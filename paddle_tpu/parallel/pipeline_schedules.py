@@ -1,0 +1,272 @@
+"""Static lockstep schedule tables for SPMD pipeline parallelism.
+
+Reference: the 1F1B loop and its interleaved (virtual-chunk) variant in
+`python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py`
+(`forward_backward_pipeline`, SURVEY.md §2.6-PP). The reference runs the
+schedule as host Python issuing NCCL p2p per microbatch; on TPU the whole
+schedule compiles into ONE jitted scan where every tick each stage runs at
+most one forward unit and one backward unit, activations rotate forward with
+ppermute, gradients rotate backward, and ring buffers absorb schedule slack.
+
+Because the program is SPMD (one program, all stages), the schedule must be
+*static*: this module precomputes, per (pp_degree, virtual chunks, n_micro),
+per-stage tick tables — which (chunk, microbatch) each stage processes at
+each tick, which ring-buffer slot each wire arrival lands in, and which slot
+each consumer reads — via greedy list scheduling over the Megatron-style
+per-rank unit orders. The tables become small constant int32 arrays baked
+into the jit; all control flow is data-independent, which is exactly what
+XLA wants.
+
+Scheduling model (one tick = one F slot + one B slot per stage):
+- F of virtual stage V = c*S + s for microbatch m needs F of (V-1, m) at a
+  strictly earlier tick (the activation travels one ppermute hop per tick).
+- B of (V, m) needs the stage's own F of (V, m) at the same tick or earlier
+  (the stashed input is local) and, for V < VS-1, B of (V+1, m) strictly
+  earlier (the gradient hop). The last virtual stage seeds its own loss
+  cotangent, so its B can follow its F immediately.
+- Backward recomputes the unit forward from the stashed input (activation
+  rematerialization) — stash liveness is O(pp), not O(n_micro): the 1F1B
+  memory profile that GPipe-style accumulation lacks.
+"""
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+
+def _unit_sequences(S: int, v: int, M: int):
+    """Shared (chunk, microbatch) orders for F and B.
+
+    All stages enumerate the same (c, m) sequence (Megatron's
+    get_model_chunk_id convention: microbatch groups of S cycle through the
+    v chunks; B mirrors the chunk order). A shared order is what makes every
+    wire FIFO: producer stage and consumer stage emit/absorb units in the
+    same sequence, so ring-buffer slots can be assigned by arrival index.
+    """
+    fseq: List[Tuple[int, int]] = []
+    bseq: List[Tuple[int, int]] = []
+    for g0 in range(0, M, S):
+        ms = list(range(g0, min(g0 + S, M)))
+        for c in range(v):
+            fseq += [(c, m) for m in ms]
+        for c in reversed(range(v)):
+            bseq += [(c, m) for m in ms]
+    return fseq, bseq
+
+
+def _simulate(S: int, v: int, M: int):
+    """Greedy lockstep list-scheduling → per-stage (tick, c, m) exec lists."""
+    fseq, bseq = _unit_sequences(S, v, M)
+    VS = v * S
+    fi = [0] * S
+    bi = [0] * S
+    done_f = {}
+    done_b = {}
+    f_exec: List[List[Tuple[int, int, int]]] = [[] for _ in range(S)]
+    b_exec: List[List[Tuple[int, int, int]]] = [[] for _ in range(S)]
+    t = 0
+    while any(fi[s] < len(fseq) or bi[s] < len(bseq) for s in range(S)):
+        new_f = []
+        new_b = []
+        for s in range(S):
+            if fi[s] < len(fseq):
+                c, m = fseq[fi[s]]
+                V = c * S + s
+                if V == 0 or done_f.get((V - 1, m), t) < t:
+                    f_exec[s].append((t, c, m))
+                    new_f.append(((V, m), t))
+                    fi[s] += 1
+            if bi[s] < len(bseq):
+                c, m = bseq[bi[s]]
+                V = c * S + s
+                own_f = ((V, m) in done_f
+                         or any(u == (V, m) for u, _ in new_f))
+                grad_ok = (V == VS - 1) or done_b.get((V + 1, m), t) < t
+                if own_f and grad_ok:
+                    b_exec[s].append((t, c, m))
+                    new_b.append(((V, m), t))
+                    bi[s] += 1
+        done_f.update(dict(new_f))
+        done_b.update(dict(new_b))
+        t += 1
+        if t > 8 * (M * v + S) + 64:
+            raise RuntimeError(
+                f"pipeline schedule did not converge (S={S}, v={v}, M={M})")
+    return f_exec, b_exec, done_f, done_b, t
+
+
+def _fifo_ring(events_write, events_read):
+    """Assign FIFO ring slots. events_* are tick lists in unit order (i-th
+    write is consumed by i-th read). Returns (slots, ring_size)."""
+    assert len(events_write) == len(events_read)
+    n = len(events_write)
+    if n == 0:
+        return [], 1
+    # max in flight at any moment
+    depth = 0
+    for i in range(n):
+        inflight = sum(1 for j in range(n)
+                       if events_write[j] <= events_write[i] < events_read[j])
+        depth = max(depth, inflight)
+    size = max(depth, 1)
+    # modular reuse safety: within a tick, writes land before reads, so the
+    # read of slot k must be STRICTLY before the write of unit k+size
+    while any(events_read[i] >= events_write[i + size]
+              for i in range(n - size)):
+        size += 1
+    return [i % size for i in range(n)], size
+
+
+def _out_of_order_ring(write_ticks, read_by_index):
+    """Ring slots for the stash, where reads may be out of write order.
+    write_ticks[i] is the tick unit i was written; read_by_index[i] the tick
+    it is read. Find the smallest size where every reuse is safe."""
+    n = len(write_ticks)
+    if n == 0:
+        return [], 1
+    size = 1
+    while True:
+        ok = True
+        for i in range(n):
+            j = i + size
+            # F slots write the stash before B slots read it in the same
+            # tick, so reuse needs read strictly before the next write
+            if j < n and read_by_index[i] >= write_ticks[j]:
+                ok = False
+                break
+        if ok:
+            return [i % size for i in range(n)], size
+        size += 1
+
+
+@dataclasses.dataclass
+class ScheduleTables:
+    """Per-tick int32 tables, each shaped (T, S) (tick-major for lax.scan).
+
+    Sentinels: slot -1 = inactive / no event. `f_src`: -1 inactive,
+    -2 embed injection (V == 0), >= 0 ring slot. `b_gsrc`: -1 inactive,
+    -2 loss seed (V == VS-1), >= 0 ring slot.
+    """
+    n_ticks: int
+    n_stages: int
+    n_chunks: int
+    n_micro: int
+    f_c: np.ndarray          # chunk of the F unit (0 when inactive)
+    f_m: np.ndarray          # microbatch of the F unit
+    f_active: np.ndarray     # 0/1
+    f_is_last: np.ndarray    # F unit is the last virtual stage (emits loss)
+    f_src: np.ndarray        # input source (see sentinels)
+    f_wr: np.ndarray         # ring slot an arriving activation stores to
+    f_stash: np.ndarray      # stash slot the F input writes to
+    b_c: np.ndarray
+    b_m: np.ndarray
+    b_active: np.ndarray
+    b_is_v0: np.ndarray      # B unit is virtual stage 0 (emits embed grads)
+    b_gsrc: np.ndarray       # gradient source (see sentinels)
+    b_gwr: np.ndarray        # ring slot an arriving gradient stores to
+    b_stash: np.ndarray      # stash slot the B unit reads its input from
+    fwd_ring: int
+    grad_ring: int
+    stash_ring: int
+    bubble_fraction: float   # fraction of idle (F or B) slots — the bubble
+
+
+def build_schedule_tables(S: int, v: int, M: int) -> ScheduleTables:
+    """Build lockstep tables for pp=S stages, v virtual chunks, M microbatches.
+
+    v == 1 reproduces the classic non-interleaved 1F1B schedule; v > 1 is the
+    interleaved (virtual pipeline) variant and requires M % S == 0, as the
+    reference does for its interleaved scheduler.
+    """
+    if v > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps % pp == 0 "
+            f"(got M={M}, pp={S})")
+    VS = v * S
+    f_exec, b_exec, done_f, done_b, T = _simulate(S, v, M)
+    fseq, bseq = _unit_sequences(S, v, M)
+
+    shape = (T, S)
+    tbl = {k: np.zeros(shape, np.int32) for k in
+           ("f_c", "f_m", "f_active", "f_is_last", "f_stash",
+            "b_c", "b_m", "b_active", "b_is_v0", "b_stash")}
+    f_src = np.full(shape, -1, np.int32)
+    f_wr = np.full(shape, -1, np.int32)
+    b_gsrc = np.full(shape, -1, np.int32)
+    b_gwr = np.full(shape, -1, np.int32)
+
+    fwd_ring = 1
+    grad_ring = 1
+    stash_ring = 1
+    for s in range(S):
+        # ---- forward wire: units with V > 0, consumed in shared order ----
+        cons = [(t, c, m) for (t, c, m) in f_exec[s] if c * S + s > 0]
+        writes = [done_f[(c * S + s - 1, m)] + 1 for (_, c, m) in cons]
+        reads = [t for (t, _, _) in cons]
+        assert writes == sorted(writes), "forward wire lost FIFO order"
+        assert all(w <= r for w, r in zip(writes, reads))
+        slots, size = _fifo_ring(writes, reads)
+        fwd_ring = max(fwd_ring, size)
+        for (tick, _, _), w, sl in zip(cons, writes, slots):
+            assert f_wr[w, s] == -1, "two arrivals in one tick"
+            f_wr[w, s] = sl
+            f_src[tick, s] = sl
+
+        # ---- F table + stash writes ----
+        stash_write_tick = {}
+        for i, (t, c, m) in enumerate(f_exec[s]):
+            tbl["f_c"][t, s] = c
+            tbl["f_m"][t, s] = m
+            tbl["f_active"][t, s] = 1
+            tbl["f_is_last"][t, s] = int(c * S + s == VS - 1)
+            if c * S + s == 0:
+                f_src[t, s] = -2
+            stash_write_tick[(c, m)] = (i, t)
+
+        # ---- stash ring (reads may be out of order for v > 1) ----
+        w_ticks = [t for (t, _, _) in f_exec[s]]
+        read_by_index = [0] * len(w_ticks)
+        for (t, c, m) in b_exec[s]:
+            i, _ = stash_write_tick[(c, m)]
+            read_by_index[i] = t
+        sslots, ssize = _out_of_order_ring(w_ticks, read_by_index)
+        stash_ring = max(stash_ring, ssize)
+        for i, (t, _, _) in enumerate(f_exec[s]):
+            tbl["f_stash"][t, s] = sslots[i]
+
+        # ---- gradient wire: B units with V < VS-1, shared order ----
+        bcons = [(t, c, m) for (t, c, m) in b_exec[s] if c * S + s < VS - 1]
+        bwrites = [done_b[(c * S + s + 1, m)] + 1 for (_, c, m) in bcons]
+        breads = [t for (t, _, _) in bcons]
+        assert bwrites == sorted(bwrites), "gradient wire lost FIFO order"
+        assert all(w <= r for w, r in zip(bwrites, breads))
+        gslots, gsize = _fifo_ring(bwrites, breads)
+        grad_ring = max(grad_ring, gsize)
+        for (tick, _, _), w, sl in zip(bcons, bwrites, gslots):
+            assert b_gwr[w, s] == -1, "two gradient arrivals in one tick"
+            b_gwr[w, s] = sl
+            b_gsrc[tick, s] = sl
+
+        # ---- B table ----
+        for (t, c, m) in b_exec[s]:
+            tbl["b_c"][t, s] = c
+            tbl["b_m"][t, s] = m
+            tbl["b_active"][t, s] = 1
+            tbl["b_is_v0"][t, s] = int(c * S + s == 0)
+            tbl["b_stash"][t, s] = sslots[stash_write_tick[(c, m)][0]]
+            if c * S + s == VS - 1:
+                b_gsrc[t, s] = -2
+
+    idle = (2 * T * S - int(tbl["f_active"].sum())
+            - int(tbl["b_active"].sum()))
+    return ScheduleTables(
+        n_ticks=T, n_stages=S, n_chunks=v, n_micro=M,
+        f_c=tbl["f_c"], f_m=tbl["f_m"], f_active=tbl["f_active"],
+        f_is_last=tbl["f_is_last"], f_src=f_src, f_wr=f_wr,
+        f_stash=tbl["f_stash"],
+        b_c=tbl["b_c"], b_m=tbl["b_m"], b_active=tbl["b_active"],
+        b_is_v0=tbl["b_is_v0"], b_gsrc=b_gsrc, b_gwr=b_gwr,
+        b_stash=tbl["b_stash"],
+        fwd_ring=fwd_ring, grad_ring=grad_ring, stash_ring=stash_ring,
+        bubble_fraction=idle / float(2 * T * S))

@@ -50,7 +50,11 @@ class RecomputeConfig:
 class PipelineConfig:
     micro_batch_size: int = 1
     accumulate_steps: int = 1
-    schedule_mode: str = "1F1B"   # or 'gpipe'
+    # '1F1B' (lockstep 1F1B engine; with virtual_pp_degree > 1 it becomes
+    # the interleaved/virtual-chunk schedule) or 'FThenB'/'gpipe'
+    # (accumulate-then-backward in one differentiated scan)
+    schedule_mode: str = "1F1B"
+    virtual_pp_degree: int = 1
 
 
 @dataclass

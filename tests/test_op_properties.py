@@ -11,9 +11,12 @@ from paddle_tpu.nn import functional as F
 
 _settings = settings(max_examples=25, deadline=None)
 
+# exclude subnormals: XLA flushes them to zero (FTZ), NumPy keeps them —
+# a backend semantics difference, not an op bug
 floats = hnp.arrays(np.float32, hnp.array_shapes(min_dims=1, max_dims=3,
                                                  min_side=1, max_side=6),
-                    elements=st.floats(-10, 10, width=32))
+                    elements=st.floats(-10, 10, width=32,
+                                       allow_subnormal=False))
 
 
 @_settings
