@@ -66,6 +66,12 @@ class LlamaConfig:
         return cls()
 
     @classmethod
+    def llama_65b(cls):
+        """Llama-65B shape (BASELINE config #2 north-star scale)."""
+        return cls(hidden_size=8192, intermediate_size=22016, num_layers=80,
+                   num_heads=64, max_position_embeddings=2048)
+
+    @classmethod
     def llama2_13b(cls):
         return cls(hidden_size=5120, intermediate_size=13824, num_layers=40,
                    num_heads=40)

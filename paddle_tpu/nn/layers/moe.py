@@ -42,12 +42,15 @@ def _ep_spec(ep_axes, ndim, extra=None):
     return P(*dims)
 
 
-def topk_gating(logits, k: int, capacity: int, normalize_topk: bool = True):
-    """GShard-style top-k gating with static capacity.
+def topk_routing(logits, k: int, capacity: int, normalize_topk: bool = True):
+    """GShard-style top-k routing with static capacity — compact form.
 
-    logits: (tokens, E) fp32. Returns (combine (T, E, C), dispatch bool
-    (T, E, C), aux_loss scalar). Choice 0 for all tokens claims capacity
-    before choice 1 (reference GShardGate priority semantics).
+    logits: (tokens, E) fp32. Returns (gate_idx (T, k) int, gate_vals
+    (T, k) fp32, pos (T, k) int — the token's slot in its expert's queue,
+    keep (T, k) bool, aux_loss scalar, stats dict). Choice 0 for all tokens
+    claims capacity before choice 1 (reference GShardGate priority
+    semantics). The compact form is O(T·k); the (T, E, C) one-hot tensors
+    of `topk_gating` are derived views for callers that want them.
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -69,13 +72,33 @@ def topk_gating(logits, k: int, capacity: int, normalize_topk: bool = True):
     pos_kt = jnp.cumsum(mask_kt, axis=0) - mask_kt          # claimed before me
     pos = jnp.swapaxes(pos_kt.reshape(k, t, e), 0, 1)       # (T, k, E)
     pos = jnp.sum(pos * mask, axis=-1)                      # (T, k)
-    keep = (pos < capacity) & (gate_vals > 0.0)             # (T, k)
+    routed = gate_vals > 0.0
+    keep = (pos < capacity) & routed                        # (T, k)
 
-    # combine/dispatch (T, E, C)
+    load = jnp.sum(mask, axis=(0, 1)).astype(jnp.float32)   # (E,) tokens/exp
+    n_routed = jnp.maximum(jnp.sum(routed.astype(jnp.float32)), 1.0)
+    stats = {
+        "moe_dropped_fraction":
+            jnp.sum((routed & ~keep).astype(jnp.float32)) / n_routed,
+        "moe_expert_load": load / jnp.maximum(jnp.sum(load), 1.0),
+        "moe_capacity": jnp.asarray(float(capacity)),
+        "moe_max_load_over_capacity": jnp.max(load) / float(capacity),
+    }
+    return gate_idx, gate_vals, pos, keep, aux, stats
+
+
+def topk_gating(logits, k: int, capacity: int, normalize_topk: bool = True):
+    """(T, E, C) one-hot view of `topk_routing` (legacy/einsum dispatch).
+
+    Returns (combine (T, E, C), dispatch bool (T, E, C), aux_loss).
+    """
+    t, e = logits.shape
+    gate_idx, gate_vals, pos, keep, aux, _ = topk_routing(
+        logits, k, capacity, normalize_topk)
+    mask = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)       # (T, k, E)
     pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)   # (T, k, C)
     contrib = (gate_vals * keep)[..., None] * pos_oh            # (T, k, C)
-    combine = jnp.einsum("tkc,tke->tec", contrib,
-                         mask.astype(jnp.float32))
+    combine = jnp.einsum("tkc,tke->tec", contrib, mask)
     dispatch = combine > 0.0
     return combine, dispatch, aux
 
@@ -91,12 +114,20 @@ class GShardGate(Layer):
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
 
+    def capacity(self, n_tokens):
+        return max(4, int(math.ceil(
+            self.capacity_factor * self.top_k * n_tokens / self.num_experts)))
+
     def forward(self, x_tokens):
         logits = self.proj(x_tokens)
-        t = x_tokens.shape[0]
-        cap = max(4, int(math.ceil(
-            self.capacity_factor * self.top_k * t / self.num_experts)))
-        return topk_gating(logits, self.top_k, cap)
+        return topk_gating(logits, self.top_k,
+                           self.capacity(x_tokens.shape[0]))
+
+    def route(self, x_tokens):
+        """Compact routing: (idx, vals, pos, keep, aux, stats, capacity)."""
+        logits = self.proj(x_tokens)
+        cap = self.capacity(x_tokens.shape[0])
+        return topk_routing(logits, self.top_k, cap) + (cap,)
 
 
 class SwitchGate(GShardGate):
@@ -153,6 +184,18 @@ class GroupedSwiGLUExperts(Layer):
             y = constrain(y, spec, a)
         return y
 
+    def forward_ragged(self, xs, group_sizes):
+        """Dropless path: xs (N, h) tokens sorted by expert, group_sizes
+        (E,) int32 — per-expert contiguous segment lengths. Ragged grouped
+        matmuls (jax.lax.ragged_dot) instead of capacity padding; no token
+        is ever dropped. Experts must be replicated across devices here
+        (the capacity path is the EP-sharded one)."""
+        dt = xs.dtype
+        h1 = jax.lax.ragged_dot(xs, self.w_gate.astype(dt), group_sizes)
+        h2 = jax.lax.ragged_dot(xs, self.w_up.astype(dt), group_sizes)
+        return jax.lax.ragged_dot(F.silu(h1) * h2, self.w_down.astype(dt),
+                                  group_sizes)
+
 
 class MoELayer(Layer):
     """Token-choice MoE block: gate → all_to_all dispatch → grouped experts
@@ -165,27 +208,91 @@ class MoELayer(Layer):
     def __init__(self, hidden_size, ffn_size, num_experts, top_k=None,
                  capacity_factor=1.25, gate: str = "gshard",
                  initializer_range=0.02, ep_axes: Sequence[str] = EP_AXES,
-                 mp_axis: str = "mp", dtype=None):
+                 mp_axis: str = "mp", dtype=None, dropless: bool = False,
+                 dispatch_mode: str = "scatter"):
         super().__init__()
         gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate]
         if gate == "switch" and top_k not in (None, 1):
             raise ValueError(f"gate='switch' is top-1 routing; got top_k={top_k}")
+        if dispatch_mode not in ("scatter", "einsum"):
+            raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
         self.gate = gate_cls(hidden_size, num_experts,
                              capacity_factor=capacity_factor)
         if top_k is not None:
             self.gate.top_k = top_k
+        # dropless replicates experts (ragged segments don't EP-shard)
         self.experts = GroupedSwiGLUExperts(
             num_experts, hidden_size, ffn_size,
-            initializer_range=initializer_range, ep_axes=ep_axes,
+            initializer_range=initializer_range,
+            ep_axes=() if dropless else ep_axes,
             mp_axis=mp_axis, dtype=dtype)
         self.num_experts = num_experts
         self.hidden_size = hidden_size
+        self.dropless = dropless
+        self.dispatch_mode = dispatch_mode
 
-    def forward(self, x) -> Tuple[jax.Array, jax.Array]:
+    def _forward_capacity(self, xt, dtype):
+        """Scatter dispatch: O(T·k) index ops instead of the O(T·E·C)
+        one-hot einsums (the global_scatter/gather mechanism cost parity —
+        SURVEY.md §2.6-EP)."""
+        e = self.num_experts
+        idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)
+        t, k = idx.shape
+        h = xt.shape[-1]
+        # destination slot in the (E·C) expert buffer; dropped → OOB, which
+        # scatter/gather treat as no-op / zero-fill
+        slot = jnp.where(keep, idx * cap + pos, e * cap).reshape(-1)
+        xt_k = jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
+        xe = jnp.zeros((e * cap, h), dtype).at[slot].set(
+            xt_k, mode="drop", unique_indices=True).reshape(e, cap, h)
+        ye = self.experts(xe).reshape(e * cap, h)
+        gathered = jnp.take(ye, slot, axis=0, mode="fill",
+                            fill_value=0).reshape(t, k, h)
+        w = (vals * keep).astype(dtype)
+        yt = jnp.einsum("tk,tkh->th", w, gathered)
+        return yt, aux, stats
+
+    def _forward_einsum(self, xt, dtype):
+        """Legacy (T, E, C) one-hot dispatch — kept for A/B comparison."""
+        combine, dispatch, aux = self.gate(xt)            # (T, E, C)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), xt)
+        ye = self.experts(xe)                             # (E, C, h)
+        yt = jnp.einsum("tec,ech->th", combine.astype(dtype), ye)
+        return yt, aux, None
+
+    def _forward_dropless(self, xt, dtype):
+        """Sort + ragged grouped matmul: every routed token is computed
+        (MegaBlocks-style dropless, the expert-choice/dropless gap noted in
+        STATUS.md)."""
+        e = self.num_experts
+        logits = self.gate.proj(xt)
+        idx, vals, pos, keep, aux, stats, _ = self.gate.route(xt)
+        t, k = idx.shape
+        h = xt.shape[-1]
+        e_flat = idx.reshape(-1)                          # (T·k,)
+        order = jnp.argsort(e_flat, stable=True)
+        xt_k = jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
+        xs = jnp.take(xt_k, order, axis=0)
+        group_sizes = jnp.bincount(e_flat, length=e).astype(jnp.int32)
+        ys = self.experts.forward_ragged(xs, group_sizes)
+        inv = jnp.argsort(order, stable=True)
+        ys = jnp.take(ys, inv, axis=0).reshape(t, k, h)
+        w = vals.astype(dtype)                            # no capacity drop
+        yt = jnp.einsum("tk,tkh->th", w, ys)
+        stats = dict(stats)
+        stats["moe_dropped_fraction"] = jnp.zeros(())
+        return yt, aux, stats
+
+    def forward(self, x, return_stats: bool = False):
         b, s, h = x.shape
         xt = x.reshape(b * s, h)
-        combine, dispatch, aux = self.gate(xt)            # (T, E, C)
-        xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
-        ye = self.experts(xe)                             # (E, C, h)
-        yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
-        return yt.reshape(b, s, h), aux
+        if self.dropless:
+            yt, aux, stats = self._forward_dropless(xt, x.dtype)
+        elif self.dispatch_mode == "scatter":
+            yt, aux, stats = self._forward_capacity(xt, x.dtype)
+        else:
+            yt, aux, stats = self._forward_einsum(xt, x.dtype)
+        out = yt.reshape(b, s, h)
+        if return_stats:
+            return out, aux, stats
+        return out, aux
