@@ -1,0 +1,159 @@
+"""paddle.linalg parity — decompositions/solvers over jnp.linalg.
+
+Reference surface: python/paddle/tensor/linalg.py + paddle.linalg namespace
+(phi kernels backed by cuSOLVER/MAGMA). On TPU these lower to XLA's
+factorization ops; on CPU to LAPACK. Exposed as `paddle_tpu.linalg` and
+re-exported through `paddle_tpu.tensor`.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A X = B given the Cholesky factor `y` of A.
+
+    upper=False: A = L Lᴴ with y=L; upper=True: A = Uᴴ U with y=U. Either
+    way the first solve is against the lower-triangular factor."""
+    lo = y if not upper else jnp.swapaxes(y, -1, -2).conj()
+    up = jnp.swapaxes(y, -1, -2).conj() if not upper else y
+    z = jax.scipy.linalg.solve_triangular(lo, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(up, z, lower=False)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+inverse = inv  # paddle.inverse name at tensor level
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    return jnp.linalg.slogdet(x)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    return jnp.linalg.lstsq(x, y, rcond=rcond)
+
+
+def lu(x):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv
+
+
+def lu_unpack(lu_mat, piv):
+    """Unpack a 2-D lu_factor result into (P, L, U) with P @ L @ U == A."""
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_mat[..., :k], k=-1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat[..., :k, :])
+    perm = jnp.arange(m)
+
+    def body(i, perm):  # LAPACK ipiv: row i was swapped with row piv[i]
+        j = piv[i]
+        pi, pj = perm[i], perm[j]
+        return perm.at[i].set(pj).at[j].set(pi)
+
+    perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+    # rows were permuted as P_swaps @ A = L U  →  A = P_swapsᵀ L U
+    P = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+    return P, L, U
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    if tol is None:
+        return jnp.linalg.matrix_rank(x)
+    # paddle's tol is an ABSOLUTE threshold on singular values
+    s = jnp.abs(jnp.linalg.eigvalsh(x)) if hermitian else \
+        jnp.linalg.svd(x, compute_uv=False)
+    return jnp.sum((s > tol).astype(jnp.int64), axis=-1)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def householder_product(x, tau):
+    """Accumulate Householder reflectors (geqrf convention) into Q."""
+    m, n = x.shape[-2], x.shape[-1]
+    Q = jnp.eye(m, dtype=x.dtype)
+    for i in range(tau.shape[-1]):
+        v = jnp.where(jnp.arange(m) < i, 0.0,
+                      jnp.where(jnp.arange(m) == i, 1.0, x[..., i]))
+        Q = Q - tau[..., i] * (Q @ v)[..., None] * v[None, :].conj()
+    return Q[..., :n]

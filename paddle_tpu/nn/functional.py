@@ -120,9 +120,10 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_name="dropout"
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     axes = tuple(range(x.ndim - len(tuple(normalized_shape)
                  if not isinstance(normalized_shape, int) else (normalized_shape,)), x.ndim))
-    mean = jnp.mean(x.astype(jnp.float32), axis=axes, keepdims=True)
-    var = jnp.var(x.astype(jnp.float32), axis=axes, keepdims=True)
-    y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + epsilon)
+    cdt = jnp.promote_types(x.dtype, jnp.float32)  # bf16→f32, f64 stays f64
+    mean = jnp.mean(x.astype(cdt), axis=axes, keepdims=True)
+    var = jnp.var(x.astype(cdt), axis=axes, keepdims=True)
+    y = (x.astype(cdt) - mean) * lax.rsqrt(var + epsilon)
     y = y.astype(x.dtype)
     if weight is not None:
         y = y * weight
@@ -324,13 +325,13 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
 
 def cross_entropy(logits, label, reduction="mean", soft_label=False,
                   ignore_index=-100, axis=-1, label_smoothing=0.0):
-    logits_f32 = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits_f32, axis=axis)
+    cdt = jnp.promote_types(logits.dtype, jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(cdt), axis=axis)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis)
     else:
         label = label.astype(jnp.int32)
-        oh = jax.nn.one_hot(label, logits.shape[axis], axis=axis, dtype=jnp.float32)
+        oh = jax.nn.one_hot(label, logits.shape[axis], axis=axis, dtype=cdt)
         if label_smoothing > 0.0:
             n = logits.shape[axis]
             oh = oh * (1.0 - label_smoothing) + label_smoothing / n
@@ -435,3 +436,471 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     n1 = jnp.linalg.norm(x1, axis=axis)
     n2 = jnp.linalg.norm(x2, axis=axis)
     return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+# ---- activation breadth (reference: python/paddle/nn/functional/activation.py)
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jnp.maximum(x, 0.0) + jnp.minimum(
+        0.0, alpha * jnp.expm1(x / alpha))
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold,
+                               jnp.zeros_like(x)))
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def prelu(x, weight):
+    """weight: scalar or per-channel (dim 1) negative-slope parameter."""
+    w = weight
+    if w.ndim == 1 and x.ndim > 1 and w.shape[0] > 1:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False):
+    """Randomized leaky ReLU; eval uses the mean slope (reference parity)."""
+    if training:
+        from paddle_tpu.core import rng as _rng_mod
+        key = _rng_mod.next_rng_key("rrelu")
+        slope = jax.random.uniform(key, x.shape, minval=lower, maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
+    new_shape = (x.shape[:axis] + (c // groups, groups) +
+                 x.shape[axis + 1:])
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from paddle_tpu.core import rng as _rng_mod
+    key = _rng_mod.next_rng_key("gumbel")
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:  # straight-through: one-hot forward, soft gradient
+        idx = jnp.argmax(y, axis=axis)
+        hard_y = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = jax.lax.stop_gradient(hard_y - y) + y
+    return y
+
+
+# ---- loss breadth (reference: python/paddle/nn/functional/loss.py) ---------
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def huber_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce_loss(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    sim = cosine_similarity(input1, input2, axis=-1)
+    loss = jnp.where(label > 0, 1.0 - sim, jnp.maximum(0.0, sim - margin))
+    return _reduce_loss(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label > 0, input, jnp.maximum(0.0, margin - input))
+    return _reduce_loss(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (label > 1)
+        stirling = (label * jnp.log(label) - label +
+                    0.5 * jnp.log(2.0 * jnp.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, jnp.zeros_like(label))
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         epsilon=1e-12):
+    p = jnp.clip(input, epsilon, 1.0 - epsilon)
+    loss = -(label * jnp.log(p) + (1.0 - label) * jnp.log1p(-p))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification (reference: warpctc kernel,
+    paddle.nn.functional.ctc_loss).
+
+    log_probs: (T, B, C) log-softmax outputs; labels: (B, L) int32 padded;
+    input_lengths (B,), label_lengths (B,). Forward DP in the log semiring
+    runs as one lax.scan over time — static shapes, TPU-friendly.
+    """
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    pos = jnp.arange(S)[None, :]
+
+    # transitions: from s, s-1 always; from s-2 iff ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(t_logp, a):       # a: (B, S) alphas
+        return jnp.take_along_axis(t_logp, ext, axis=-1) + a
+
+    a0 = jnp.full((B, S), neg_inf)
+    a0 = a0.at[:, 0].set(log_probs[0, jnp.arange(B), ext[:, 0]])
+    valid1 = (label_lengths > 0)
+    a0 = a0.at[:, 1].set(jnp.where(
+        valid1, log_probs[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+    def step(a, t_logp):
+        a_m1 = jnp.pad(a, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :S]
+        a_m2 = jnp.pad(a, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :S]
+        a_m2 = jnp.where(can_skip, a_m2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(a, a_m1), a_m2)
+        return emit(t_logp, merged), merged
+
+    def scan_step(carry, xs):
+        t_idx, t_logp = xs
+        a = carry
+        new_a, _ = step(a, t_logp)
+        # freeze alphas past each sequence's input length
+        new_a = jnp.where((t_idx < input_lengths)[:, None], new_a, a)
+        return new_a, None
+
+    alphas, _ = jax.lax.scan(
+        scan_step, a0, (jnp.arange(1, T), log_probs[1:]))
+
+    end = 2 * label_lengths          # blank after last label
+    end_m1 = jnp.maximum(end - 1, 0)  # last label
+    ll_blank = jnp.take_along_axis(alphas, end[:, None], axis=1)[:, 0]
+    ll_label = jnp.take_along_axis(alphas, end_m1[:, None], axis=1)[:, 0]
+    # empty-label rows have only the all-blank path — don't count it twice
+    ll_label = jnp.where(label_lengths > 0, ll_label, neg_inf)
+    ll = jnp.logaddexp(ll_blank, ll_label)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    return _reduce_loss(loss, reduction)
+
+
+# ---- misc breadth -----------------------------------------------------------
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, oc, r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    x = x.reshape(n, oc, h * r, w * r)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    x = x.reshape(n, c * r * r, h // r, w // r)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference unfold): (N, C, H, W) → (N, C·kh·kw, L)."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im: inverse of unfold by scatter-add."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + nh * sh:sh,
+                         wj:wj + nw * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5,
+                  data_format="NCHW"):
+    ch_axis = 1 if data_format == "NCHW" else -1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        y = y * weight.reshape(shape)
+        if bias is not None:
+            y = y + bias.reshape(shape)
+    return y
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[ch_axis] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pad_cfg)
+    win = sum(jax.lax.slice_in_dim(sq, i, i + x.shape[ch_axis], axis=ch_axis)
+              for i in range(size))
+    return x / jnp.power(k + alpha * win / size, beta)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.abs(x - y) + epsilon
+    return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p) if not \
+        keepdim else jnp.power(jnp.sum(jnp.power(d, p), axis=-1,
+                                       keepdims=True), 1.0 / p)
+
+
+# ---- 3-D / 1-D conv & pooling breadth --------------------------------------
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    """weight layout: (out_ch, in_ch/groups, kd, kh, kw)."""
+    stride = _ntuple(stride, 3)
+    dilation = _ntuple(dilation, 3)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _ntuple(padding, 3)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.promote_types(x.dtype, jnp.float32)
+        if x.dtype != jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        y = y + bias.reshape(shape)
+    return y
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, data_format="NCDHW"):
+    """weight layout: (in_ch, out_ch, kd, kh, kw)."""
+    stride = _ntuple(stride, 3)
+    p = _ntuple(padding, 3)
+    op = _ntuple(output_padding, 3)
+    k = weight.shape[2:]
+    pad = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + op[i]) for i in range(3)]
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    w = jnp.swapaxes(w, 0, 1)       # (out, in, ...)
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad, lhs_dilation=stride,
+        dimension_numbers=dn)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1, 1)
+    return y
+
+
+def _pool(x, kernel, stride, padding, nd, reducer, init_val, avg=False,
+          ceil_mode=False):
+    kernel = _ntuple(kernel, nd)
+    stride = _ntuple(stride if stride is not None else kernel, nd)
+    p = _ntuple(padding, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)]
+    for i, (ki, si, pi) in enumerate(zip(kernel, stride, p)):
+        hi = pi
+        if ceil_mode:  # extend high padding so the last partial window counts
+            rem = (x.shape[2 + i] + 2 * pi - ki) % si
+            if rem:
+                hi = pi + (si - rem)
+        pads.append((pi, hi))
+    y = lax.reduce_window(x, init_val, reducer, window, strides, pads)
+    if avg:
+        # divide by the REAL element count per window (padding excluded —
+        # reference exclusive=True semantics)
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        y = y / counts
+    return y
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf,
+                 ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 1, lax.add, 0.0, avg=True,
+                 ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf,
+                 ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 3, lax.add, 0.0, avg=True,
+                 ceil_mode=ceil_mode)
+
+
+def adaptive_max_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    assert h % oh == 0 and w % ow == 0, \
+        f"adaptive pool needs divisible sizes, got {(h, w)} -> {(oh, ow)}"
+    return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+
+
+def adaptive_avg_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    assert l % o == 0
+    return jnp.mean(x.reshape(n, c, o, l // o), axis=-1)
+
+
+def adaptive_avg_pool3d(x, output_size):
+    od, oh, ow = _ntuple(output_size, 3)
+    n, c, d, h, w = x.shape
+    assert d % od == 0 and h % oh == 0 and w % ow == 0
+    return jnp.mean(
+        x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow),
+        axis=(3, 5, 7))

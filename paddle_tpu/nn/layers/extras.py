@@ -1,0 +1,406 @@
+"""Layer breadth: padding, pixel ops, dropout variants, distance, vision
+pooling/conv variants, instance norms (reference: python/paddle/nn/layer/
+{common,pooling,conv,norm,distance,vision}.py)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers.norm import BatchNorm2D
+
+
+# ---- padding ----------------------------------------------------------------
+
+class _PadNd(Layer):
+    nd = 2
+    _channels_last = ("NLC", "NHWC", "NDHWC")
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None):
+        super().__init__()
+        self.padding = ([padding] * (2 * self.nd)
+                        if isinstance(padding, int) else list(padding))
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        # paddle pad order: (left, right[, top, bottom[, front, back]]) —
+        # pairs apply from the LAST spatial dim backwards. Spatial dims are
+        # trailing for channels-first, but 1..nd for channels-last.
+        pairs = [(self.padding[2 * i], self.padding[2 * i + 1])
+                 for i in range(len(self.padding) // 2)]
+        cl = self.data_format in self._channels_last
+        cfg = [(0, 0)] * x.ndim
+        for i, pr in enumerate(pairs):
+            axis = (self.nd - i) if cl else (x.ndim - 1 - i)
+            cfg[axis] = pr
+        flat = [v for pr in cfg for v in pr]
+        return F.pad(x, flat, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadNd):
+    nd = 1
+
+
+class Pad2D(_PadNd):
+    nd = 2
+
+
+class Pad3D(_PadNd):
+    nd = 3
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW"):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+# ---- pixel / channel rearrangement -----------------------------------------
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.r = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.r, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.r = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.r, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW"):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, *self.args)
+
+
+# ---- dropout variants -------------------------------------------------------
+
+class Dropout2D(Layer):
+    """Drops whole channels (reference: spatial dropout)."""
+
+    def __init__(self, p=0.5, data_format="NCHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import jax
+        from paddle_tpu.core import rng as _rng
+        n, c = (x.shape[0], x.shape[1]) if self.data_format == "NCHW" \
+            else (x.shape[0], x.shape[-1])
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(_rng.next_rng_key("dropout2d"), keep,
+                                    (n, c))
+        shape = (n, c, 1, 1) if self.data_format == "NCHW" else (n, 1, 1, c)
+        return jnp.where(mask.reshape(shape), x / keep, 0.0).astype(x.dtype)
+
+
+class Dropout3D(Dropout2D):
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__(p)
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import jax
+        from paddle_tpu.core import rng as _rng
+        ch_last = self.data_format == "NDHWC"
+        n = x.shape[0]
+        c = x.shape[-1] if ch_last else x.shape[1]
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(_rng.next_rng_key("dropout3d"), keep,
+                                    (n, c))
+        shape = (n, 1, 1, 1, c) if ch_last else (n, c, 1, 1, 1)
+        return jnp.where(mask.reshape(shape), x / keep, 0.0).astype(x.dtype)
+
+
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (reference alpha_dropout)."""
+
+    _alpha_p = -1.7580993408473766  # -scale * alpha of SELU
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import jax
+        from paddle_tpu.core import rng as _rng
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(_rng.next_rng_key("alpha_dropout"), keep,
+                                    x.shape)
+        a = (keep + self.p * self._alpha_p ** 2 * keep) ** -0.5
+        b = -a * self._alpha_p * self.p
+        y = jnp.where(mask, x, jnp.asarray(self._alpha_p, x.dtype))
+        return (a * y + b).astype(x.dtype)
+
+
+# ---- distance ---------------------------------------------------------------
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+# ---- bilinear ---------------------------------------------------------------
+
+class Bilinear(Layer):
+    """out_k = x1ᵀ W_k x2 + b_k (reference paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features, dtype=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), dtype=dtype,
+            default_initializer=init.XavierUniform())
+        self.bias = self.create_parameter(
+            (out_features,), dtype=dtype,
+            default_initializer=init.Constant(0.0), is_bias=True)
+
+    def forward(self, x1, x2):
+        return jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2) + self.bias
+
+
+# ---- conv / pool variants ---------------------------------------------------
+
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None, dtype=None,
+                 data_format="NCDHW"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * 3
+        fan_in = in_channels // groups * k[0] * k[1] * k[2]
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(k), dtype=dtype,
+            default_initializer=init.KaimingUniform(fan_in=fan_in))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), dtype=dtype,
+            default_initializer=init.Constant(0.0), is_bias=True)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, bias_attr=None, dtype=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * 3
+        self.weight = self.create_parameter(
+            (in_channels, out_channels) + tuple(k), dtype=dtype,
+            default_initializer=init.KaimingUniform(
+                fan_in=in_channels * k[0] * k[1] * k[2]))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), dtype=dtype,
+            default_initializer=init.Constant(0.0), is_bias=True)
+        self.stride, self.padding = stride, padding
+        self.output_padding = output_padding
+
+    def forward(self, x):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        return F.max_pool1d(x, *self.args)
+
+
+class AvgPool1D(MaxPool1D):
+    def forward(self, x):
+        return F.avg_pool1d(x, *self.args)
+
+
+class MaxPool3D(MaxPool1D):
+    def forward(self, x):
+        return F.max_pool3d(x, *self.args)
+
+
+class AvgPool3D(MaxPool1D):
+    def forward(self, x):
+        return F.avg_pool3d(x, *self.args)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(AdaptiveMaxPool2D):
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(AdaptiveMaxPool2D):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="nearest", data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(UpsamplingNearest2D):
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="bilinear", data_format=self.data_format)
+
+
+# ---- norm variants ----------------------------------------------------------
+
+class BatchNorm1D(BatchNorm2D):
+    """(N, C) or (N, C, L) inputs — same running-stat machinery."""
+
+
+class BatchNorm3D(BatchNorm2D):
+    """(N, C, D, H, W) inputs."""
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica BN. Under GSPMD the batch axis is sharded and XLA
+    computes global reductions automatically when stats are replicated —
+    the veneer exists for API parity (reference: nn.SyncBatchNorm over
+    NCCL all_reduce of partial sums).
+
+    convert_sync_batchnorm upgrades BatchNorm* layers in-place."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, BatchNorm2D) and not isinstance(
+                    sub, SyncBatchNorm):
+                new = SyncBatchNorm(sub.num_features, sub.momentum,
+                                    sub.epsilon,
+                                    data_format=sub.data_format)
+                new._parameters = sub._parameters
+                new._buffers = sub._buffers
+                layer._sub_layers[name] = new
+            else:
+                cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class _InstanceNormNd(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), default_initializer=init.Constant(1.0))
+            self.bias = self.create_parameter(
+                (num_features,), default_initializer=init.Constant(0.0),
+                is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormNd):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from paddle_tpu.tensor import unflatten
+        return unflatten(x, self.axis, self.shape)

@@ -265,7 +265,6 @@ class MoELayer(Layer):
         (MegaBlocks-style dropless, the expert-choice/dropless gap noted in
         STATUS.md)."""
         e = self.num_experts
-        logits = self.gate.proj(xt)
         idx, vals, pos, keep, aux, stats, _ = self.gate.route(xt)
         t, k = idx.shape
         h = xt.shape[-1]

@@ -55,9 +55,11 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # (b, h, sq, sk) scores in fp32
+    # (b, h, sq, sk) scores in fp32 (f64 under x64 — keeps numeric-grad
+    # checks meaningful)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.promote_types(
+                            q.dtype, jnp.float32)) * scale
     if is_causal:
         causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         scores = jnp.where(causal[None, None], scores, NEG_INF)

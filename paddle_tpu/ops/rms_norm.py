@@ -16,7 +16,7 @@ from jax import lax
 
 
 def _rms_norm_ref(x, weight, epsilon):
-    xf = x.astype(jnp.float32)
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
     if weight is not None:

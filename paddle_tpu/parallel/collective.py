@@ -16,6 +16,15 @@ streams). This module provides:
 API-design note: the reference returns waitable Tasks (`sync_op=False`); XLA's
 async dispatch makes every call non-blocking already, so ops return arrays and
 `.wait()` parity is a no-op wrapper.
+
+Multi-process semantics: when `jax.process_count() > 1` (after
+`init_parallel_env` / `jax.distributed.initialize`), the eager functions
+switch from the single-process stacked-per-rank convention to true
+cross-process collectives over `multihost_utils` — each process passes its
+LOCAL value and receives the collective result, matching the reference's
+ProcessGroup semantics. Point-to-point `send`/`recv` have no eager
+multi-process implementation (use in-jit `ppermute`); they raise rather
+than silently compute garbage.
 """
 
 from typing import List, Optional, Sequence
@@ -37,6 +46,10 @@ class ReduceOp:
     AVG = "avg"
 
 
+def _multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
 class Group:
     """A communicator: an ordered set of devices with a private 1-D mesh."""
 
@@ -45,7 +58,9 @@ class Group:
         self.nranks = len(self.devices)
         self.name = name
         self.mesh = Mesh(np.asarray(self.devices), axis_names=("g",))
-        self.rank = 0  # single-process SPMD: all group members live here
+        # single-process SPMD: all group members live here (rank 0);
+        # multi-process: this process's rank in the world
+        self.rank = jax.process_index() if _multiprocess() else 0
 
     @property
     def world_size(self):
@@ -87,12 +102,42 @@ def _reduce_fn(op):
 
 
 # ---- eager veneers ---------------------------------------------------------
-# Each operates on an array whose leading axis is the group dimension
-# (one slice per rank — the single-process analog of per-rank tensors).
+# Single-process: each operates on an array whose leading axis is the group
+# dimension (one slice per rank — the single-process analog of per-rank
+# tensors). Multi-process: each process passes its LOCAL value; the op is a
+# true cross-process collective (multihost_utils over the distributed
+# runtime — ProcessGroup semantics, SURVEY.md §2.5).
+
+def _mp_utils():
+    from jax.experimental import multihost_utils
+    return multihost_utils
+
+
+def _mp_world_only(g: Group, opname: str):
+    enforce(g.nranks == jax.device_count(),
+            f"{opname}: eager multi-process collectives support only the "
+            f"world group (got nranks={g.nranks}, world={jax.device_count()});"
+            " use in-jit shard_map collectives for subgroups")
+
+
+_MP_REDUCERS = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.PROD: jnp.prod,
+    ReduceOp.AVG: jnp.mean,
+}
+
 
 def all_reduce(x, op=ReduceOp.SUM, group=None, sync_op=True):
-    """x: (nranks, ...) stacked per-rank values → same shape, reduced copies."""
+    """Single-process: x is (nranks, ...) stacked per-rank values → same
+    shape, reduced copies. Multi-process: x is this process's value →
+    the cross-process reduction."""
     g = _get_group(group)
+    if _multiprocess():
+        _mp_world_only(g, "all_reduce")
+        gathered = _mp_utils().process_allgather(x)     # (nprocs, ...)
+        return _MP_REDUCERS[op](gathered, axis=0).astype(x.dtype)
     if g.nranks == 1:
         return x
     enforce(x.shape[0] == g.nranks, f"leading dim {x.shape[0]} != nranks {g.nranks}")
@@ -111,15 +156,18 @@ def all_reduce(x, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list_or_x, x=None, group=None, sync_op=True, axis=0):
-    """Gather per-rank slices: input (nranks, ...) → (nranks, nranks, ...)
-    conceptually; returns the concatenated value (reference returns a list)."""
+    """Single-process: per-rank slices are already globally visible.
+    Multi-process: gathers each process's local value into a (nranks, ...)
+    stack (the reference returns a list; pass a list as the first arg to get
+    that form)."""
     if isinstance(tensor_list_or_x, list):
         out_list, x = tensor_list_or_x, x
     else:
         out_list, x = None, tensor_list_or_x
     g = _get_group(group)
-    if g.nranks == 1:
-        res = x
+    if _multiprocess():
+        _mp_world_only(g, "all_gather")
+        res = _mp_utils().process_allgather(x)          # (nprocs, ...)
     else:
         res = x  # already globally visible in single-process SPMD
     if out_list is not None:
@@ -135,6 +183,10 @@ def reduce(x, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def broadcast(x, src=0, group=None, sync_op=True):
     g = _get_group(group)
+    if _multiprocess():
+        _mp_world_only(g, "broadcast")
+        return _mp_utils().broadcast_one_to_all(
+            x, is_source=jax.process_index() == src)
     if g.nranks == 1:
         return x
     src_slice = x[src]
@@ -143,14 +195,32 @@ def broadcast(x, src=0, group=None, sync_op=True):
 
 def scatter(x, tensor_list=None, src=0, group=None, sync_op=True):
     g = _get_group(group)
+    if _multiprocess():
+        _mp_world_only(g, "scatter")
+        if tensor_list is not None and jax.process_index() == src:
+            stacked = jnp.stack(tensor_list)
+        else:
+            # non-src ranks contribute only the output shape
+            stacked = jnp.broadcast_to(x[None], (g.nranks,) + tuple(x.shape))
+        data = _mp_utils().broadcast_one_to_all(
+            stacked, is_source=jax.process_index() == src)
+        return data[g.rank]
     if tensor_list is not None:
         return jnp.stack(tensor_list)[g.rank] if g.nranks > 1 else tensor_list[0]
     return x
 
 
 def reduce_scatter(x, op=ReduceOp.SUM, group=None, sync_op=True):
-    """x: (nranks, nranks*chunk, ...) per-rank values → (nranks, chunk, ...)."""
+    """Single-process: x (nranks, nranks*chunk, ...) per-rank values →
+    (nranks, chunk, ...). Multi-process: x (nranks*chunk, ...) local value →
+    this rank's reduced (chunk, ...) slice."""
     g = _get_group(group)
+    if _multiprocess():
+        _mp_world_only(g, "reduce_scatter")
+        gathered = _mp_utils().process_allgather(x)
+        reduced = _MP_REDUCERS[op](gathered, axis=0).astype(x.dtype)
+        chunk = reduced.shape[0] // g.nranks
+        return reduced[g.rank * chunk:(g.rank + 1) * chunk]
     if g.nranks == 1:
         return x
     x = _sharded_over_group(x, g)
@@ -170,9 +240,15 @@ def reduce_scatter(x, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def alltoall(x, group=None, sync_op=True):
-    """x: (nranks, nranks, ...) — rank i holds row i of per-dest chunks →
-    output rank i holds column i (transpose over the first two dims)."""
+    """Single-process: x (nranks, nranks, ...) — rank i holds row i of
+    per-dest chunks → output rank i holds column i. Multi-process: x
+    (nranks, ...) — row j is this rank's chunk for rank j → output
+    (nranks, ...) — row j is rank j's chunk for this rank."""
     g = _get_group(group)
+    if _multiprocess():
+        _mp_world_only(g, "alltoall")
+        gathered = _mp_utils().process_allgather(x)     # (nprocs, nranks, ...)
+        return gathered[:, g.rank]
     if g.nranks == 1:
         return x
     return jnp.swapaxes(x, 0, 1)
@@ -182,17 +258,30 @@ all_to_all = alltoall
 
 
 def send(x, dst=0, group=None, sync_op=True):
-    # Point-to-point outside jit is a device_put in single-process SPMD.
     g = _get_group(group)
+    if _multiprocess():
+        raise NotImplementedError(
+            "eager send() has no multi-process implementation on TPU — "
+            "point-to-point transfers belong inside jit (lax.ppermute / "
+            "pipeline schedules); refusing to silently no-op")
+    # Point-to-point outside jit is a device_put in single-process SPMD.
     return jax.device_put(x, g.devices[dst])
 
 
 def recv(x, src=0, group=None, sync_op=True):
+    if _multiprocess():
+        raise NotImplementedError(
+            "eager recv() has no multi-process implementation on TPU — "
+            "point-to-point transfers belong inside jit (lax.ppermute / "
+            "pipeline schedules); refusing to silently no-op")
     return x
 
 
 def barrier(group=None):
     g = _get_group(group)
+    if _multiprocess():
+        _mp_utils().sync_global_devices("paddle_tpu.barrier")
+        return
     jax.block_until_ready(jnp.zeros((), jnp.int32))
 
 

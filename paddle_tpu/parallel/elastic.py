@@ -13,11 +13,160 @@ loop in-process: periodic (async) checkpoints via CheckpointManager, crash →
 restore latest → resume, bounded restarts — the same recovery contract,
 testable single-host by injecting faults (SURVEY.md §5: tests kill procs)."""
 
+import json
 import logging
+import os
+import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional, Set
 
 logger = logging.getLogger("paddle_tpu.elastic")
+
+
+# ---- membership / heartbeat (reference: fleet/elastic/manager.py) ----------
+#
+# The reference registers each rank in etcd and heartbeats; a missed TTL
+# triggers relaunch. TPU pods have no etcd; the equivalent substrate is any
+# shared KV the hosts can all reach. `HeartbeatStore` is that interface;
+# `FileHeartbeatStore` implements it over a shared directory (NFS/GCS-fuse
+# on real pods, tmpdir in tests). `ElasticManager` owns register/heartbeat/
+# watch semantics on top.
+
+class HeartbeatStore:
+    """KV with per-member freshness — the etcd-analog interface."""
+
+    def put(self, member: str, payload: dict):
+        raise NotImplementedError
+
+    def members(self) -> Dict[str, dict]:
+        """All registered members → their last payload (incl. 'ts')."""
+        raise NotImplementedError
+
+    def remove(self, member: str):
+        raise NotImplementedError
+
+
+class FileHeartbeatStore(HeartbeatStore):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, member):
+        return os.path.join(self.root, f"{member}.hb")
+
+    def put(self, member, payload):
+        tmp = self._path(member) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(member))  # atomic on POSIX
+
+    def members(self):
+        out = {}
+        for fn in os.listdir(self.root):
+            if not fn.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    out[fn[:-3]] = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn write / concurrent removal
+        return out
+
+    def remove(self, member):
+        try:
+            os.unlink(self._path(member))
+        except FileNotFoundError:
+            pass
+
+
+class ElasticManager:
+    """Register + heartbeat this host; watch for lost/joined peers.
+
+    Reference semantics (fleet/elastic/manager.py): every worker heartbeats
+    a TTL'd key; the manager watches membership and signals the launcher to
+    relaunch on change. `watch()` here invokes `on_change(alive, dead)` from
+    a daemon thread; the launcher reacts by restarting the training script,
+    whose recovery is restore-from-checkpoint (ElasticTrainLoop)."""
+
+    def __init__(self, store: HeartbeatStore, rank: int, world_size: int,
+                 heartbeat_interval: float = 2.0,
+                 timeout: Optional[float] = None):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.interval = heartbeat_interval
+        self.timeout = timeout if timeout is not None else 3 * heartbeat_interval
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- registration / heartbeat --
+
+    def register(self):
+        self.store.put(str(self.rank), {"rank": self.rank, "ts": time.time()})
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            self.register()
+
+    def start(self):
+        self.register()
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self, deregister: bool = True):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.interval + 1)
+        self._threads.clear()
+        if deregister:
+            self.store.remove(str(self.rank))
+
+    # -- membership --
+
+    def alive(self, now: Optional[float] = None) -> Set[int]:
+        now = now if now is not None else time.time()
+        out = set()
+        for m, payload in self.store.members().items():
+            if now - payload.get("ts", 0) <= self.timeout:
+                out.add(int(m))
+        return out
+
+    def dead(self) -> Set[int]:
+        return set(range(self.world_size)) - self.alive()
+
+    def all_alive(self) -> bool:
+        return len(self.alive()) == self.world_size
+
+    def wait_for_world(self, timeout: float = 60.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.all_alive():
+                return True
+            time.sleep(self.interval / 4)
+        return False
+
+    def watch(self, on_change: Callable[[Set[int], Set[int]], None],
+              poll_interval: Optional[float] = None):
+        """Daemon thread: calls on_change(alive, dead) whenever membership
+        differs from the last poll (a lost heartbeat past TTL or a join)."""
+        poll = poll_interval if poll_interval is not None else self.interval
+
+        def loop():
+            last = self.alive()
+            while not self._stop.wait(poll):
+                cur = self.alive()
+                if cur != last:
+                    logger.warning("membership change: alive=%s dead=%s",
+                                   sorted(cur), sorted(self.dead()))
+                    on_change(cur, set(range(self.world_size)) - cur)
+                    last = cur
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
 
 
 class ElasticTrainLoop:

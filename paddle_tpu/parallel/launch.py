@@ -53,29 +53,69 @@ def _spawn_target(func, rank, nprocs, args):
 
 
 def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
-           max_restarts=0, log_dir=None):
+           max_restarts=0, log_dir=None, elastic_dir=None,
+           heartbeat_interval=2.0, elastic_world_timeout=300.0):
     """Run the training script once per host with restart-on-failure
-    (elastic_level ≈ max_restarts; recovery is resume-from-checkpoint)."""
+    (elastic_level ≈ max_restarts; recovery is resume-from-checkpoint).
+
+    With `elastic_dir` (a directory all hosts share), this node heartbeats
+    an ElasticManager registry and a watch thread kills the child when a
+    peer host's heartbeat lapses — the relaunch then resumes from the last
+    checkpoint, the reference ElasticManager's recovery contract
+    (SURVEY.md §5-failure, fleet/elastic/manager.py)."""
+    mgr = None
+    membership_changed = [False]
+    proc_holder = [None]
+    if elastic_dir:
+        from paddle_tpu.parallel.elastic import (ElasticManager,
+                                                 FileHeartbeatStore)
+        mgr = ElasticManager(FileHeartbeatStore(elastic_dir), rank=node_rank,
+                             world_size=nnodes,
+                             heartbeat_interval=heartbeat_interval).start()
+
+        def on_change(alive, dead):
+            if dead and proc_holder[0] is not None:
+                membership_changed[0] = True
+                proc_holder[0].terminate()
+
+        mgr.watch(on_change)
     restarts = 0
-    while True:
-        env = _worker_env(node_rank, nnodes, master)
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            logfile = open(os.path.join(log_dir, f"workerlog.{node_rank}"), "ab")
-        else:
-            logfile = None
-        proc = subprocess.Popen([sys.executable] + script_args, env=env,
-                                stdout=logfile or None, stderr=subprocess.STDOUT
-                                if logfile else None)
-        code = proc.wait()
-        if logfile:
-            logfile.close()
-        if code == 0:
-            return 0
-        restarts += 1
-        if restarts > max_restarts:
-            return code
-        time.sleep(min(2 ** restarts, 30))
+    try:
+        while True:
+            env = _worker_env(node_rank, nnodes, master)
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                logfile = open(os.path.join(log_dir, f"workerlog.{node_rank}"), "ab")
+            else:
+                logfile = None
+            membership_changed[0] = False
+            proc = subprocess.Popen([sys.executable] + script_args, env=env,
+                                    stdout=logfile or None, stderr=subprocess.STDOUT
+                                    if logfile else None)
+            proc_holder[0] = proc
+            code = proc.wait()
+            proc_holder[0] = None
+            if logfile:
+                logfile.close()
+            if code == 0:
+                return 0
+            if mgr is not None and membership_changed[0]:
+                # elastic termination is not a training failure: it does
+                # not consume the restart budget (reference ElasticManager
+                # relaunches on membership change regardless of
+                # elastic_level). Wait for the lost peer before relaunch —
+                # a restarted world needs every host present for rendezvous.
+                if not mgr.wait_for_world(timeout=elastic_world_timeout):
+                    return code  # peer never came back; give up
+                time.sleep(1.0)
+                continue
+            restarts += 1
+            if restarts > max_restarts:
+                return code
+            time.sleep(min(2 ** restarts, 30))
+    finally:
+        if mgr is not None:
+            mgr.stop()
 
 
 def main(argv=None):
@@ -86,10 +126,14 @@ def main(argv=None):
     ap.add_argument("--master", default=os.environ.get("PADDLE_MASTER", "127.0.0.1:49175"))
     ap.add_argument("--max_restarts", type=int, default=0)
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--elastic_dir", default=None,
+                    help="shared dir for membership heartbeats (etcd analog)")
+    ap.add_argument("--heartbeat_interval", type=float, default=2.0)
     ap.add_argument("script", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
     sys.exit(launch(ns.script, ns.nnodes, ns.node_rank, ns.master,
-                    ns.max_restarts, ns.log_dir))
+                    ns.max_restarts, ns.log_dir, elastic_dir=ns.elastic_dir,
+                    heartbeat_interval=ns.heartbeat_interval))
 
 
 if __name__ == "__main__":

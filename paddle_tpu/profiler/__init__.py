@@ -30,6 +30,7 @@ class Profiler:
         self._active = False
         self._step = 0
         self.scheduler = scheduler  # (start_batch, end_batch) window
+        self.on_trace_ready = on_trace_ready
 
     def start(self):
         if not self.timer_only:
@@ -41,6 +42,8 @@ class Profiler:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
 
     def step(self):
         self._step += 1
@@ -59,8 +62,23 @@ class Profiler:
         self.stop()
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        return f"profiler traces in {self.log_dir} (TensorBoard/Perfetto xplane)"
+                time_unit="ms", device_only=True, limit=30):
+        """Per-op time table parsed from the captured xplane trace
+        (reference: paddle.profiler summary tables)."""
+        from paddle_tpu.profiler import xplane
+
+        planes = xplane.load_latest(self.log_dir)
+        if not planes:
+            return f"no traces captured in {self.log_dir}"
+        rows = xplane.op_summary(planes, device_only=device_only)
+        if not rows:  # e.g. CPU-only run: fall back to host planes
+            rows = xplane.op_summary(planes, device_only=False)
+        return xplane.format_summary(rows, time_unit=time_unit, limit=limit)
+
+    def export_chrome_trace(self, out_path=None):
+        from paddle_tpu.profiler import xplane
+
+        return xplane.export_chrome_trace(self.log_dir, out_path)
 
 
 @contextlib.contextmanager
@@ -71,8 +89,14 @@ def RecordEvent(name: str, event_type=None):
 
 
 def export_chrome_tracing(dir_name: str):
+    """on_trace_ready handler: write catapult trace.json next to the xplane
+    dump (reference: paddle.profiler.export_chrome_tracing)."""
     def handler(prof):
-        pass
+        from paddle_tpu.profiler import xplane
+
+        os.makedirs(dir_name, exist_ok=True)
+        return xplane.export_chrome_trace(
+            prof.log_dir, os.path.join(dir_name, "trace.json"))
     return handler
 
 

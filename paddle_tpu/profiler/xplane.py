@@ -1,0 +1,240 @@
+"""XPlane (.xplane.pb) parsing without a tensorflow dependency.
+
+jax.profiler writes XSpace protobufs (tsl/profiler/protobuf/xplane.proto)
+under ``<log_dir>/plugins/profile/<run>/*.xplane.pb``. The reference's
+profiler (SURVEY.md §5: python/paddle/profiler, CUPTI tracer) exposes
+per-op summaries and chrome-trace export from its own event records; the
+TPU-native equivalents come from these traces. This module decodes the
+protobuf wire format directly (generic tag/varint/length-delimited
+reader + the xplane field numbers) so summaries work on the bare image.
+
+Wire schema (field numbers from xplane.proto):
+  XSpace:   planes=1
+  XPlane:   id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+  XLine:    id=1 name=2 timestamp_ns=3 events=4 display_name=11
+  XEvent:   metadata_id=1 offset_ps=2 duration_ps=3 num_occurrences=5
+  XEventMetadata: id=1 name=2 display_name=4
+  map entry: key=1 value=2
+"""
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer.
+
+    wire 0 → varint int; wire 1 → 8 raw bytes; wire 2 → bytes;
+    wire 5 → 4 raw bytes. Groups (3/4) don't occur in xplane.
+    """
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+        elif wire == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+class XEvent:
+    __slots__ = ("name", "offset_ps", "duration_ps", "occurrences")
+
+    def __init__(self, name, offset_ps, duration_ps, occurrences):
+        self.name = name
+        self.offset_ps = offset_ps
+        self.duration_ps = duration_ps
+        self.occurrences = occurrences
+
+
+class XLine:
+    __slots__ = ("name", "timestamp_ns", "events")
+
+    def __init__(self, name, timestamp_ns, events):
+        self.name = name
+        self.timestamp_ns = timestamp_ns
+        self.events = events
+
+
+class XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    mid, name, display = 0, "", ""
+    for f, w, v in _fields(buf):
+        if f == 1 and w == 0:
+            mid = v
+        elif f == 2 and w == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4 and w == 2:
+            display = v.decode("utf-8", "replace")
+    return mid, (display or name)
+
+
+def _parse_plane(buf: bytes) -> XPlane:
+    name = ""
+    raw_lines: List[bytes] = []
+    meta: Dict[int, str] = {}
+    for f, w, v in _fields(buf):
+        if f == 2 and w == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 3 and w == 2:
+            raw_lines.append(v)
+        elif f == 4 and w == 2:  # map<int64, XEventMetadata>
+            for mf, mw, mv in _fields(v):
+                if mf == 2 and mw == 2:
+                    mid, mname = _parse_event_metadata(mv)
+                    meta[mid] = mname
+    lines = []
+    for lb in raw_lines:
+        lname, ts_ns = "", 0
+        events = []
+        for f, w, v in _fields(lb):
+            if f == 2 and w == 2:
+                lname = v.decode("utf-8", "replace")
+            elif f == 11 and w == 2:
+                lname = v.decode("utf-8", "replace") or lname
+            elif f == 3 and w == 0:
+                ts_ns = v
+            elif f == 4 and w == 2:
+                mid, off, dur, occ = 0, 0, 0, 1
+                for ef, ew, ev in _fields(v):
+                    if ef == 1 and ew == 0:
+                        mid = ev
+                    elif ef == 2 and ew == 0:
+                        off = ev
+                    elif ef == 3 and ew == 0:
+                        dur = ev
+                    elif ef == 5 and ew == 0:
+                        occ = ev
+                events.append(XEvent(meta.get(mid, f"op#{mid}"), off, dur, occ))
+        lines.append(XLine(lname, ts_ns, events))
+    return XPlane(name, lines)
+
+
+def parse_xspace(path: str) -> List[XPlane]:
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes = []
+    for f_, w, v in _fields(buf):
+        if f_ == 1 and w == 2:
+            planes.append(_parse_plane(v))
+    return planes
+
+
+def find_xplane_files(log_dir: str) -> List[str]:
+    return sorted(glob.glob(
+        os.path.join(log_dir, "plugins", "profile", "*", "*.xplane.pb")))
+
+
+def load_latest(log_dir: str) -> List[XPlane]:
+    files = find_xplane_files(log_dir)
+    if not files:
+        return []
+    planes: List[XPlane] = []
+    run_dir = os.path.dirname(files[-1])
+    for p in files:
+        if os.path.dirname(p) == run_dir:
+            planes.extend(parse_xspace(p))
+    return planes
+
+
+# ---- aggregation ----------------------------------------------------------
+
+def op_summary(planes: List[XPlane],
+               device_only: bool = True) -> List[dict]:
+    """Aggregate per-op (event name) totals across device planes.
+
+    Returns rows sorted by total time: {name, calls, total_ms, avg_ms, pct}.
+    """
+    rows: Dict[str, List[float]] = {}
+    for plane in planes:
+        if device_only and not any(
+                k in plane.name for k in ("TPU", "GPU", "/device:")):
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                r = rows.setdefault(ev.name, [0, 0.0])
+                r[0] += max(ev.occurrences, 1)
+                r[1] += ev.duration_ps / 1e9  # ps → ms
+    total = sum(r[1] for r in rows.values()) or 1.0
+    out = [{"name": k, "calls": int(v[0]), "total_ms": v[1],
+            "avg_ms": v[1] / max(v[0], 1), "pct": 100.0 * v[1] / total}
+           for k, v in rows.items()]
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def format_summary(rows: List[dict], time_unit: str = "ms",
+                   limit: int = 30) -> str:
+    unit_div = {"s": 1e3, "ms": 1.0, "us": 1e-3}[time_unit]
+    hdr = (f"{'Name':<52} {'Calls':>7} {'Total(' + time_unit + ')':>12} "
+           f"{'Avg(' + time_unit + ')':>12} {'Ratio(%)':>9}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows[:limit]:
+        nm = r["name"] if len(r["name"]) <= 52 else r["name"][:49] + "..."
+        lines.append(f"{nm:<52} {r['calls']:>7} "
+                     f"{r['total_ms'] / unit_div:>12.3f} "
+                     f"{r['avg_ms'] / unit_div:>12.3f} {r['pct']:>9.2f}")
+    if len(rows) > limit:
+        lines.append(f"... ({len(rows) - limit} more ops)")
+    return "\n".join(lines)
+
+
+def to_chrome_trace(planes: List[XPlane]) -> dict:
+    """Chrome trace-event JSON (catapult format) from xplane events."""
+    events = []
+    pid = 0
+    for plane in planes:
+        pid += 1
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": plane.name}})
+        tid = 0
+        for line in plane.lines:
+            tid += 1
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": line.name}})
+            base_us = line.timestamp_ns / 1e3
+            for ev in line.events:
+                events.append({
+                    "ph": "X", "pid": pid, "tid": tid, "name": ev.name,
+                    "ts": base_us + ev.offset_ps / 1e6,
+                    "dur": ev.duration_ps / 1e6,
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(log_dir: str, out_path: Optional[str] = None) -> str:
+    planes = load_latest(log_dir)
+    out_path = out_path or os.path.join(log_dir, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(to_chrome_trace(planes), f)
+    return out_path

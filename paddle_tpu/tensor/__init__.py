@@ -497,3 +497,30 @@ def numel(x):
 
 def shape(x):
     return list(x.shape)
+
+
+# ---- breadth modules (math / manipulation extras, linalg re-export) --------
+# Imported wholesale: every public name becomes paddle_tpu.tensor.<name>
+# (and paddle_tpu.<name> via the package-level tensor import).
+
+from paddle_tpu.tensor.math_ops import *        # noqa: F401,F403,E402
+from paddle_tpu.tensor.manipulation_ops import *  # noqa: F401,F403,E402
+from paddle_tpu.linalg import (  # noqa: F401,E402
+    cholesky,
+    cholesky_solve,
+    eig,
+    eigvals,
+    eigvalsh,
+    inverse,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_power,
+    matrix_rank,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)

@@ -1,0 +1,261 @@
+"""Tensor manipulation breadth: indexing / reshaping / search extras.
+
+Reference surface: python/paddle/tensor/{manipulation,search}.py. Thin
+paddle-shaped veneers over jnp; imported into `paddle_tpu.tensor`.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+# ---- reshaping / axes -------------------------------------------------------
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new_shape)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    return jnp.array_split(x, num_or_indices, axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    return jnp.hsplit(x, num_or_indices)
+
+
+def vsplit(x, num_or_indices):
+    return jnp.vsplit(x, num_or_indices)
+
+
+def dsplit(x, num_or_indices):
+    return jnp.dsplit(x, num_or_indices)
+
+
+def hstack(xs):
+    return jnp.hstack(xs)
+
+
+def vstack(xs):
+    return jnp.vstack(xs)
+
+
+def dstack(xs):
+    return jnp.dstack(xs)
+
+
+def column_stack(xs):
+    return jnp.column_stack(xs)
+
+
+def row_stack(xs):
+    return jnp.vstack(xs)
+
+
+def atleast_1d(*xs):
+    r = jnp.atleast_1d(*xs)
+    return r
+
+
+def atleast_2d(*xs):
+    return jnp.atleast_2d(*xs)
+
+
+def atleast_3d(*xs):
+    return jnp.atleast_3d(*xs)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_tensors(*xs):
+    return jnp.broadcast_arrays(*xs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+# ---- diag family ------------------------------------------------------------
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal embed: (..., n) → (..., n, n) with x on `offset`."""
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    base = base.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        base = jnp.moveaxis(base, (-2, -1), (dim1, dim2))
+    return base
+
+
+def tril_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    return jnp.stack(jnp.tril_indices(row, k=offset, m=col))
+
+
+def triu_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    return jnp.stack(jnp.triu_indices(row, k=offset, m=col))
+
+
+def meshgrid(*xs, indexing="ij"):
+    xs = xs[0] if len(xs) == 1 and isinstance(xs[0], (list, tuple)) else xs
+    return jnp.meshgrid(*xs, indexing=indexing)
+
+
+# ---- indexing / scatter -----------------------------------------------------
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def index_add(x, index, axis, value):
+    return _index_op(x, index, axis, value, "add")
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def index_fill(x, index, axis, value):
+    return _index_op(x, index, axis,
+                     jnp.asarray(value, x.dtype), "set")
+
+
+def _index_op(x, index, axis, value, mode):
+    ix = [slice(None)] * x.ndim
+    ix[axis] = index
+    ref = x.at[tuple(ix)]
+    return ref.add(value) if mode == "add" else ref.set(value)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if hasattr(jnp, "put_along_axis"):
+        if reduce == "assign":
+            return jnp.put_along_axis(x, indices, values, axis=axis,
+                                      inplace=False)
+    # scatter via explicit coordinate grid
+    values = jnp.broadcast_to(values, indices.shape)
+    coords = list(jnp.indices(indices.shape))
+    coords[axis] = indices
+    ref = x.at[tuple(coords)]
+    return {"assign": ref.set, "add": ref.add, "multiply": ref.multiply,
+            "mul": ref.multiply, "amax": ref.max, "amin": ref.min}[reduce](values)
+
+
+def take(x, index, mode="raise"):
+    """Reference take: index into the FLATTENED tensor."""
+    jmode = {"raise": None, "wrap": "wrap", "clip": "clip"}[mode]
+    return jnp.take(x.reshape(-1), index, mode=jmode)
+
+
+def select_scatter(x, values, axis, index):
+    ix = [slice(None)] * x.ndim
+    ix[axis] = index
+    return x.at[tuple(ix)].set(values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    ix = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        ix[ax] = slice(st, en, sr)
+    return x.at[tuple(ix)].set(value)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    return jnp.zeros(shape, updates.dtype).at[
+        tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+# ---- search -----------------------------------------------------------------
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def argwhere(x):
+    return jnp.argwhere(x)
+
+
+def msort(x):
+    return jnp.sort(x, axis=0)
+
+
+def nanargmax(x, axis=None, keepdim=False):
+    return jnp.nanargmax(x, axis=axis, keepdims=keepdim)
+
+
+def nanargmin(x, axis=None, keepdim=False):
+    return jnp.nanargmin(x, axis=axis, keepdims=keepdim)

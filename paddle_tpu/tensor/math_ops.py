@@ -1,0 +1,458 @@
+"""Tensor math breadth: elementwise / reduction / cumulative ops.
+
+Reference surface: python/paddle/tensor/math.py (~200 functions over phi
+kernels). Each op here is a jnp call XLA fuses; signatures keep paddle's
+argument orders and axis= keywords. Imported wholesale into
+`paddle_tpu.tensor` (the paddle.* namespace veneer).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---- elementwise: exp/log family -------------------------------------------
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+# ---- elementwise: trig / hyperbolic ----------------------------------------
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+# ---- elementwise: special ---------------------------------------------------
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+# ---- elementwise: rounding / parts -----------------------------------------
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def fmod(x, y):
+    return jnp.fmod(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def divide_no_nan(x, y):
+    return jnp.where(y == 0, jnp.zeros_like(x * y), x / y)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def frexp(x):
+    return jnp.frexp(x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiplex(inputs, index):
+    """Row-wise select: out[i] = inputs[index[i]][i] (reference multiplex)."""
+    stacked = jnp.stack(inputs)                        # (n, b, ...)
+    idx = index.reshape((1, -1) + (1,) * (stacked.ndim - 2)).astype(jnp.int32)
+    return jnp.take_along_axis(stacked, idx, axis=0)[0]
+
+
+# ---- logical / bitwise ------------------------------------------------------
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+# ---- reductions -------------------------------------------------------------
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    """Returns (values, indices) of the k-th smallest along axis (1-based)."""
+    idx = jnp.argsort(x, axis=axis)
+    kth_idx = jnp.take(idx, k - 1, axis=axis)
+    vals = jnp.take_along_axis(
+        x, jnp.expand_dims(kth_idx, axis), axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis)
+    return vals, kth_idx
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    """Reference semantics: min==max==0 → use data range."""
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi), weights=weight,
+                            density=density)
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+# ---- cumulative -------------------------------------------------------------
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def _cum_with_indices(x, axis, is_max):
+    """(values, indices) running max/min via an associative pair-scan."""
+    n = x.shape[axis]
+    idx = jnp.arange(n)
+    idx = jnp.reshape(idx, [-1 if i == (axis % x.ndim) else 1
+                            for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        if is_max:
+            take_b = bv >= av
+        else:
+            take_b = bv <= av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, inds = lax.associative_scan(combine, (x, idx), axis=axis)
+    return vals, inds
+
+
+def cummax(x, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _cum_with_indices(x, axis, is_max=True)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _cum_with_indices(x, axis, is_max=False)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+# ---- matrix-ish one-liners kept in paddle.* root ----------------------------
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def cdist(x, y, p=2.0):
+    """Pairwise p-norm distances: x (..., m, d), y (..., n, d) → (..., m, n)."""
+    diffs = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+    return jnp.sum(jnp.abs(diffs) ** p, axis=-1) ** (1.0 / p)
+
+
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)

@@ -9,7 +9,11 @@ jax.config before any backend use.
 import os
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                           " --xla_force_host_platform_device_count=8")
+                           " --xla_force_host_platform_device_count=8"
+                           # XLA:CPU bug workaround (see examples/
+                           # scale_report.py): AllReducePromotion check-fails
+                           # on shardy's copy-rooted bf16 psum combiners
+                           " --xla_disable_hlo_passes=all-reduce-promotion")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,91 @@
+"""2-process CPU driver for the multi-process collective leg.
+
+Run by tests/test_multiprocess.py in a subprocess. Exercises the real
+cross-process path the reference's ProcessGroup backend provides
+(SURVEY.md §2.5): `launch.spawn` → per-rank `init_parallel_env` →
+`jax.distributed.initialize` (TCPStore-analog rendezvous) → eager
+collectives over two OS processes with one CPU device each.
+
+Not named test_* on purpose — pytest must not collect it in-process.
+"""
+
+import os
+import socket
+import sys
+
+
+def _worker(rank, port):
+    # pin the platform BEFORE any backend query (the axon sitecustomize
+    # imports jax at interpreter start; env vars are too late, config
+    # updates are not)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    from paddle_tpu.parallel import collective as coll
+    from paddle_tpu.parallel import env as penv
+
+    penv.init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+    assert penv.get_rank() == rank
+
+    import jax.numpy as jnp
+
+    r = coll.all_reduce(jnp.asarray([float(rank + 1)]))
+    assert r.tolist() == [3.0], r
+
+    m = coll.all_reduce(jnp.asarray([float(rank)]), op=coll.ReduceOp.MAX)
+    assert m.tolist() == [1.0], m
+
+    g = coll.all_gather(jnp.asarray([float(rank)]))
+    assert g.tolist() == [[0.0], [1.0]], g
+
+    lst = coll.all_gather([], jnp.asarray([float(rank)]))
+    assert [t.tolist() for t in lst] == [[0.0], [1.0]], lst
+
+    b = coll.broadcast(jnp.asarray([rank * 5.0]), src=1)
+    assert b.tolist() == [5.0], b
+
+    rs = coll.reduce_scatter(jnp.arange(4.0) + rank)
+    expected = [1.0, 3.0] if rank == 0 else [5.0, 7.0]
+    assert rs.tolist() == expected, rs
+
+    a2a = coll.alltoall(
+        jnp.asarray([[rank, rank], [rank + 10, rank + 10]], jnp.float32))
+    exp = ([[0.0, 0.0], [1.0, 1.0]] if rank == 0
+           else [[10.0, 10.0], [11.0, 11.0]])
+    assert a2a.tolist() == exp, a2a
+
+    sc = coll.scatter(jnp.zeros(1),
+                      tensor_list=[jnp.asarray([10.0]), jnp.asarray([20.0])]
+                      if rank == 0 else None, src=0)
+    assert sc.tolist() == ([10.0] if rank == 0 else [20.0]), sc
+
+    for fn in (lambda: coll.send(jnp.zeros(1), dst=0),
+               lambda: coll.recv(jnp.zeros(1), src=0)):
+        try:
+            fn()
+        except NotImplementedError:
+            pass
+        else:
+            raise AssertionError("eager p2p must raise in multi-process mode")
+
+    coll.barrier()
+    print(f"rank{rank} MP_OK", flush=True)
+
+
+def main():
+    from paddle_tpu.parallel import launch
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    launch.spawn(_worker, args=(port,), nprocs=2)
+    print("DRIVER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""OpTest-style numeric gradient checks (SURVEY.md §4 test strategy).
+
+The reference's OpTest harness validates every op's grad kernel against
+central finite differences (test/legacy_test/op_test.py check_grad). The
+TPU-native analog checks jax.grad through our functional/tensor surface
+against float64 central differences: for f and a fixed random cotangent u,
+    d/dx  sum(f(x) * u)   (autodiff)   vs   FD over each input element.
+
+Inputs for ops with kinks (relu, abs, max-pool, clip, ...) are sampled
+bounded away from the kink so the FD stencil stays one-sided-free.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+import paddle_tpu.tensor as pt
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _x64():
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def away_from(rng, shape, kink=0.0, margin=0.15, scale=1.0):
+    """Sample values with |x - kink| > margin (FD-safe around a kink)."""
+    x = rng.standard_normal(shape) * scale
+    x = x + np.sign(x - kink) * margin
+    return x
+
+
+def check_grads_fd(fn, args, wrt=None, eps=1e-6, rtol=5e-4, atol=1e-7,
+                   seed=0):
+    """Compare jax.grad of sum(fn(*args) * u) to central differences."""
+    rng = _rng(seed + 1)
+    args = [jnp.asarray(a, jnp.float64) if isinstance(a, np.ndarray)
+            and np.issubdtype(a.dtype, np.floating) else a for a in args]
+    out = fn(*args)
+    u = jnp.asarray(rng.standard_normal(np.shape(out)), jnp.float64)
+
+    def scalar(*a):
+        return jnp.sum(fn(*a) * u)
+
+    if wrt is None:
+        wrt = [i for i, a in enumerate(args)
+               if isinstance(a, jnp.ndarray) and jnp.issubdtype(a.dtype, jnp.floating)]
+    for i in wrt:
+        g_auto = np.asarray(jax.grad(scalar, argnums=i)(*args))
+        x = np.asarray(args[i], np.float64)
+        flat = x.reshape(-1)
+        g_num = np.zeros_like(flat)
+        for j in range(flat.size):
+            xp, xm = flat.copy(), flat.copy()
+            xp[j] += eps
+            xm[j] -= eps
+            ap = list(args)
+            ap[i] = jnp.asarray(xp.reshape(x.shape))
+            am = list(args)
+            am[i] = jnp.asarray(xm.reshape(x.shape))
+            g_num[j] = (float(scalar(*ap)) - float(scalar(*am))) / (2 * eps)
+        np.testing.assert_allclose(
+            g_auto, g_num.reshape(x.shape), rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch wrt arg {i}")
+
+
+R = _rng(42)
+
+# (name, fn, args, kwargs) — args are numpy float arrays unless noted
+OPS = [
+    # activations (kink ops sampled away from the kink)
+    ("relu", F.relu, [away_from(R, (3, 4))]),
+    ("relu6", F.relu6, [away_from(R, (3, 4), 0.0) * 2.0]),
+    ("leaky_relu", F.leaky_relu, [away_from(R, (3, 4))]),
+    ("elu", F.elu, [away_from(R, (3, 4))]),
+    ("gelu", F.gelu, [R.standard_normal((3, 4))]),
+    ("silu", F.silu, [R.standard_normal((3, 4))]),
+    ("mish", F.mish, [R.standard_normal((3, 4))]),
+    ("sigmoid", F.sigmoid, [R.standard_normal((3, 4))]),
+    ("tanh", F.tanh, [R.standard_normal((3, 4))]),
+    ("softplus", F.softplus, [R.standard_normal((3, 4))]),
+    ("hardswish", F.hardswish, [away_from(R, (3, 4), -3.0) * 0.5]),
+    ("hardsigmoid", F.hardsigmoid, [R.standard_normal((3, 4)) * 0.5]),
+    ("softmax", F.softmax, [R.standard_normal((3, 5))]),
+    ("log_softmax", F.log_softmax, [R.standard_normal((3, 5))]),
+    ("glu", F.glu, [R.standard_normal((3, 6))]),
+    # normalizations
+    ("layer_norm", lambda x, w, b: F.layer_norm(x, (6,), w, b),
+     [R.standard_normal((4, 6)), 1.0 + 0.1 * R.standard_normal(6),
+      0.1 * R.standard_normal(6)]),
+    ("rms_norm", lambda x, w: F.rms_norm(x, w),
+     [R.standard_normal((4, 6)), 1.0 + 0.1 * R.standard_normal(6)]),
+    ("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+     [R.standard_normal((2, 4, 3, 3)), 1.0 + 0.1 * R.standard_normal(4),
+      0.1 * R.standard_normal(4)]),
+    ("normalize", F.normalize, [R.standard_normal((3, 5)) + 0.5]),
+    # linear / conv / pool
+    ("linear", F.linear,
+     [R.standard_normal((3, 4)), R.standard_normal((4, 5)),
+      R.standard_normal(5)]),
+    ("conv2d", F.conv2d,
+     [R.standard_normal((1, 2, 5, 5)), R.standard_normal((3, 2, 3, 3))]),
+    ("conv1d", F.conv1d,
+     [R.standard_normal((1, 2, 7)), R.standard_normal((3, 2, 3))]),
+    ("conv2d_transpose", F.conv2d_transpose,
+     [R.standard_normal((1, 3, 4, 4)), R.standard_normal((3, 2, 3, 3))]),
+    ("avg_pool2d", lambda x: F.avg_pool2d(x, 2),
+     [R.standard_normal((1, 2, 4, 4))]),
+    ("max_pool2d", lambda x: F.max_pool2d(x, 2),
+     [R.standard_normal((1, 2, 4, 4))]),
+    ("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2),
+     [R.standard_normal((1, 2, 6, 6))]),
+    # attention
+    ("sdpa", F.scaled_dot_product_attention,
+     [R.standard_normal((1, 4, 2, 6)) * 0.5,
+      R.standard_normal((1, 4, 2, 6)) * 0.5,
+      R.standard_normal((1, 4, 2, 6)) * 0.5]),
+    # losses
+    ("mse_loss", F.mse_loss,
+     [R.standard_normal((3, 4)), R.standard_normal((3, 4))]),
+    ("l1_loss", lambda x, y: F.l1_loss(x, y),
+     [R.standard_normal((3, 4)), R.standard_normal((3, 4)) + 5.0]),
+    ("kl_div", lambda x, y: F.kl_div(x, y),
+     [R.standard_normal((3, 4)),
+      np.abs(R.standard_normal((3, 4))) + 0.5]),
+    ("bce_with_logits", F.binary_cross_entropy_with_logits,
+     [R.standard_normal((3, 4)), R.uniform(0.1, 0.9, (3, 4))]),
+    ("cross_entropy",
+     lambda x: F.cross_entropy(x, jnp.asarray([0, 2, 1])),
+     [R.standard_normal((3, 4))]),
+    ("softmax_with_cross_entropy",
+     lambda x: F.softmax_with_cross_entropy(x, jnp.asarray([[0], [2], [1]])),
+     [R.standard_normal((3, 4))]),
+    ("nll_loss",
+     lambda x: F.nll_loss(F.log_softmax(x), jnp.asarray([0, 2, 1])),
+     [R.standard_normal((3, 4))]),
+    ("cosine_similarity", F.cosine_similarity,
+     [R.standard_normal((3, 5)) + 0.5, R.standard_normal((3, 5)) + 0.5]),
+    ("label_smooth", F.label_smooth, [R.uniform(0.1, 0.9, (3, 4))]),
+    # embedding: grad wrt the table
+    ("embedding", lambda w: F.embedding(jnp.asarray([0, 2, 1]), w),
+     [R.standard_normal((4, 5))]),
+    ("pad", lambda x: F.pad(x, [1, 1, 1, 1]),
+     [R.standard_normal((2, 2, 3, 3))]),
+    # tensor math
+    ("matmul", pt.matmul,
+     [R.standard_normal((3, 4)), R.standard_normal((4, 5))]),
+    ("bmm", pt.bmm,
+     [R.standard_normal((2, 3, 4)), R.standard_normal((2, 4, 5))]),
+    ("dot", pt.dot, [R.standard_normal(5), R.standard_normal(5)]),
+    ("outer", pt.outer, [R.standard_normal(3), R.standard_normal(4)]),
+    ("einsum", lambda a, b: pt.einsum("ij,jk->ik", a, b),
+     [R.standard_normal((3, 4)), R.standard_normal((4, 2))]),
+    ("divide", pt.divide,
+     [R.standard_normal((3, 4)), np.abs(R.standard_normal((3, 4))) + 1.0]),
+    ("pow", lambda x: pt.pow(x, 3.0),
+     [np.abs(R.standard_normal((3, 4))) + 0.5]),
+    ("sqrt", pt.sqrt, [np.abs(R.standard_normal((3, 4))) + 0.5]),
+    ("rsqrt", pt.rsqrt, [np.abs(R.standard_normal((3, 4))) + 0.5]),
+    ("exp", pt.exp, [R.standard_normal((3, 4))]),
+    ("log", pt.log, [np.abs(R.standard_normal((3, 4))) + 0.5]),
+    ("abs", pt.abs, [away_from(R, (3, 4))]),
+    ("clip", lambda x: pt.clip(x, -0.5, 0.5),
+     [away_from(R, (3, 4), 0.5, 0.2) + away_from(R, (3, 4), -0.5, 0.0) * 0]),
+    ("maximum", pt.maximum,
+     [R.standard_normal((3, 4)), R.standard_normal((3, 4)) + 3.0]),
+    ("minimum", pt.minimum,
+     [R.standard_normal((3, 4)), R.standard_normal((3, 4)) + 3.0]),
+    ("sum", pt.sum, [R.standard_normal((3, 4))]),
+    ("mean", pt.mean, [R.standard_normal((3, 4))]),
+    ("prod", pt.prod, [np.abs(R.standard_normal((2, 3))) + 0.5]),
+    ("cumsum", pt.cumsum, [R.standard_normal((3, 4))]),
+    ("var", pt.var, [R.standard_normal((3, 4))]),
+    ("std", pt.std, [R.standard_normal((3, 4))]),
+    ("norm", pt.norm, [R.standard_normal((3, 4)) + 0.2]),
+    ("tril", pt.tril, [R.standard_normal((4, 4))]),
+    ("flip", lambda x: pt.flip(x, axis=0), [R.standard_normal((3, 4))]),
+    ("where", lambda x, y: pt.where(jnp.asarray(
+        [[True, False], [False, True]]), x, y),
+     [R.standard_normal((2, 2)), R.standard_normal((2, 2))]),
+    ("gather", lambda x: pt.gather(x, jnp.asarray([2, 0, 1])),
+     [R.standard_normal((3, 4))]),
+]
+
+
+@pytest.mark.parametrize("name,fn,args", [(n, f, a) for n, f, a in OPS],
+                         ids=[o[0] for o in OPS])
+def test_numeric_grad(name, fn, args):
+    check_grads_fd(fn, args)
+
+
+def test_clip_interior_only():
+    """clip grad is checked only at points strictly inside/outside bounds."""
+    x = np.asarray([[-0.9, -0.2], [0.2, 0.9]])
+    check_grads_fd(lambda v: pt.clip(v, -0.5, 0.5), [x])

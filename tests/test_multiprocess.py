@@ -1,0 +1,44 @@
+"""Multi-process execution leg (SURVEY.md §2.5 ProcessGroup parity).
+
+The reference's collective backend is genuinely cross-process
+(process_group_nccl.cc, tcp_store.cc). The TPU-native analog is
+`jax.distributed.initialize` + gloo CPU collectives in tests; this suite
+spawns two real OS processes through `paddle_tpu.parallel.launch.spawn`
+and checks the eager collective API computes true cross-process results.
+"""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DRIVER = os.path.join(HERE, "mp_driver.py")
+
+
+def test_two_process_cpu_collectives():
+    env = dict(os.environ)
+    # children pin their own platform/device count; the parent suite's
+    # 8-device forcing flag must not leak in
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE)] + env.get("PYTHONPATH", "").split(os.pathsep))
+    res = subprocess.run([sys.executable, DRIVER], capture_output=True,
+                         text=True, env=env, timeout=600)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert out.count("MP_OK") == 2, out
+    assert "DRIVER_OK" in out, out
+
+
+def test_single_process_semantics_unchanged():
+    """The in-process suite runs single-process: stacked-per-rank forms."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import collective as coll
+
+    g = coll.new_group()
+    n = g.nranks
+    x = jnp.arange(float(n)).reshape(n, 1)
+    r = coll.all_reduce(x, group=g)
+    assert r.shape == (n, 1)
