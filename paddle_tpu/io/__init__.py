@@ -233,3 +233,111 @@ class DataLoader:
         if self._iterable:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+
+# ---- dataset combinators (reference: python/paddle/io/dataset.py) ----------
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self._cum = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self._cum.append(total)
+
+    def __len__(self):
+        return self._cum[-1] if self._cum else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+        ds = bisect.bisect_right(self._cum, idx)
+        prev = self._cum[ds - 1] if ds else 0
+        return self.datasets[ds][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+
+def random_split(dataset, lengths, generator=None):
+    """Split into non-overlapping subsets (reference random_split).
+
+    `lengths` may be absolute sizes or fractions summing to 1."""
+    import numpy as _np
+    n = len(dataset)
+    if all(0 < float(l) < 1 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(math.floor(n * float(l))) for l in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+    else:
+        sizes = [int(l) for l in lengths]
+        if sum(sizes) != n:
+            raise ValueError(
+                f"sum of lengths {sum(sizes)} != dataset size {n}")
+    rng = generator if generator is not None else _np.random.RandomState()
+    perm = rng.permutation(n)
+    out, ofs = [], 0
+    for s in sizes:
+        out.append(Subset(dataset, perm[ofs:ofs + s].tolist()))
+        ofs += s
+    return out
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices, generator=None):
+        super().__init__()
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        import numpy as _np
+        rng = self.generator or _np.random.RandomState()
+        return iter([self.indices[i]
+                     for i in rng.permutation(len(self.indices))])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True,
+                 generator=None):
+        super().__init__()
+        import numpy as _np
+        self.weights = _np.asarray(weights, _np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        self.generator = generator
+
+    def __iter__(self):
+        import numpy as _np
+        rng = self.generator or _np.random.RandomState()
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples

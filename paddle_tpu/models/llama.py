@@ -20,6 +20,7 @@ import dataclasses
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu import nn
@@ -46,6 +47,8 @@ class LlamaConfig:
     sequence_parallel: bool = False
     # long-context strategy over the 'sep' mesh axis: None | 'ring' | 'ulysses'
     context_parallel: Optional[str] = None
+    # per-layer activation recompute in the no-cache (training) forward
+    recompute: bool = False
 
     @property
     def kv_heads(self):
@@ -222,8 +225,17 @@ class LlamaModel(nn.Layer):
                              start_pos=start_pos)
                 new_cache.append(c)
             return self.norm(x), new_cache
-        for layer in self.layers:
-            x = layer(x, cos, sin, attn_mask)
+        if cfg.recompute:
+            # per-layer activation recompute (reference: fleet per-layer
+            # recompute, fleet/meta_parallel recompute_hybrid): backward
+            # rematerializes each block from its input; only the layer
+            # boundaries stay live
+            for layer in self.layers:
+                x = jax.checkpoint(
+                    lambda t, _l=layer: _l(t, cos, sin, attn_mask))(x)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
 
 

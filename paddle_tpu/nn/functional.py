@@ -546,7 +546,8 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
 
 
 def soft_margin_loss(input, label, reduction="mean"):
-    loss = jnp.log1p(jnp.exp(-label * input))
+    # softplus form: log(1 + exp(z)) without overflow at large |z|
+    loss = jax.nn.softplus(-label * input)
     return _reduce_loss(loss, reduction)
 
 
@@ -755,12 +756,12 @@ def instance_norm(x, weight=None, bias=None, epsilon=1e-5,
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
     y = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
     if weight is not None:
-        shape = [1] * x.ndim
-        shape[ch_axis] = x.shape[ch_axis]
         y = y * weight.reshape(shape)
-        if bias is not None:
-            y = y + bias.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
     return y
 
 

@@ -114,9 +114,20 @@ def _mp_utils():
 
 
 def _mp_world_only(g: Group, opname: str):
-    enforce(g.nranks == jax.device_count(),
+    # The eager multi-process path gathers per PROCESS; with several local
+    # devices per process the rank arithmetic below would silently mix
+    # process and device indices — refuse loudly (in-jit shard_map
+    # collectives are the supported path on pod slices).
+    if jax.local_device_count() != 1:
+        raise NotImplementedError(
+            f"{opname}: eager multi-process collectives support only "
+            f"1 device per process (local_device_count="
+            f"{jax.local_device_count()}); use in-jit collectives "
+            "(shard_map/psum) for multi-device hosts")
+    enforce(g.nranks == jax.process_count(),
             f"{opname}: eager multi-process collectives support only the "
-            f"world group (got nranks={g.nranks}, world={jax.device_count()});"
+            f"world group (got nranks={g.nranks}, "
+            f"world={jax.process_count()});"
             " use in-jit shard_map collectives for subgroups")
 
 

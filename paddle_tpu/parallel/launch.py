@@ -74,9 +74,13 @@ def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
                              heartbeat_interval=heartbeat_interval).start()
 
         def on_change(alive, dead):
-            if dead and proc_holder[0] is not None:
+            p = proc_holder[0]  # snapshot: the child may exit concurrently
+            if dead and p is not None:
                 membership_changed[0] = True
-                proc_holder[0].terminate()
+                try:
+                    p.terminate()
+                except OSError:  # already reaped
+                    pass
 
         mgr.watch(on_change)
     restarts = 0

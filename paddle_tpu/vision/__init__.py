@@ -3,6 +3,7 @@
 Reference (SURVEY.md §2.7): python/paddle/vision/ — datasets, transforms,
 pretrained backbones (`paddle.vision.models.resnet50`)."""
 
+from paddle_tpu.vision import datasets  # noqa: F401
 from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
 from paddle_tpu.vision.models import (  # noqa: F401
@@ -11,4 +12,13 @@ from paddle_tpu.vision.models import (  # noqa: F401
     resnet34,
     resnet50,
     resnet101,
+    LeNet,
+    AlexNet,
+    VGG,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+    MobileNetV2,
+    mobilenet_v2,
 )

@@ -1,0 +1,233 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Parsers for the standard on-disk formats (MNIST IDX, CIFAR python
+batches, class-per-directory image folders). This box has zero egress, so
+`download=True` raises with instructions instead of silently failing;
+point `image_path`/`data_file` at local copies, or use FakeData for
+pipeline tests.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+_NO_EGRESS = ("this environment has no network egress — place the dataset "
+              "files locally and pass their path (download=False)")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (torchvision FakeData analog) — for
+    exercising input pipelines without any files."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        c, h, w = self.image_shape
+        img = rng.randint(0, 256, (h, w, c), dtype=np.uint8)
+        label = int(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+class MNIST(Dataset):
+    """MNIST from IDX files (reference paddle.vision.datasets.MNIST).
+
+    image_path/label_path: the ubyte(.gz) files; mode selects the default
+    filenames when a directory is given."""
+
+    NAMES = {"train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+             "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or
+                         not os.path.exists(image_path)):
+            raise RuntimeError(_NO_EGRESS)
+        if image_path and os.path.isdir(image_path):
+            img_name, lbl_name = self.NAMES[mode]
+            root = image_path
+            image_path = self._find(root, img_name)
+            label_path = self._find(root, lbl_name)
+        if not image_path or not label_path:
+            raise ValueError("MNIST needs image_path and label_path "
+                             f"({_NO_EGRESS})")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        self.transform = transform
+
+    @staticmethod
+    def _find(root, base):
+        for suffix in ("", ".gz"):
+            p = os.path.join(root, base + suffix)
+            if os.path.exists(p):
+                return p
+        raise FileNotFoundError(f"{base}[.gz] not under {root}")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    """Same IDX format, different files."""
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-pickle tar (reference Cifar10)."""
+
+    train_batches = [f"data_batch_{i}" for i in range(1, 6)]
+    test_batches = ["test_batch"]
+    archive_prefix = "cifar-10-batches-py"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and (data_file is None or not os.path.exists(data_file)):
+            raise RuntimeError(_NO_EGRESS)
+        if data_file is None:
+            raise ValueError(f"Cifar10 needs data_file ({_NO_EGRESS})")
+        names = self.train_batches if mode == "train" else self.test_batches
+        imgs, labels = [], []
+        for raw in self._iter_batches(data_file, names):
+            d = pickle.loads(raw, encoding="bytes")
+            imgs.append(np.asarray(d[b"data"], np.uint8))
+            labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def _iter_batches(self, data_file, names):
+        if os.path.isdir(data_file):
+            for n in names:
+                with open(os.path.join(data_file, n), "rb") as f:
+                    yield f.read()
+            return
+        with tarfile.open(data_file, "r:*") as tf:
+            for n in names:
+                m = tf.extractfile(f"{self.archive_prefix}/{n}")
+                yield m.read()
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    train_batches = ["train"]
+    test_batches = ["test"]
+    archive_prefix = "cifar-100-python"
+
+
+IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".tif",
+                  ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory dataset (reference DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    p = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(p) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((p, self.class_to_idx[c]))
+        self.loader = loader or self._pil_loader
+        self.transform = transform
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class ImageFolder(Dataset):
+    """Flat (or recursive) unlabeled image folder (reference ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None):
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                if fn.lower().endswith(extensions):
+                    self.samples.append(os.path.join(dirpath, fn))
+        self.loader = loader or DatasetFolder._pil_loader
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

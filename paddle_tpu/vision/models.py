@@ -112,3 +112,159 @@ def resnet50(num_classes=1000, **kw):
 
 def resnet101(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes=num_classes, **kw)
+
+
+class LeNet(nn.Layer):
+    """Reference: paddle.vision.models.LeNet (MNIST-scale)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class AlexNet(nn.Layer):
+    """Reference: paddle.vision.models.AlexNet."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2))
+        self.avgpool = nn.AdaptiveAvgPool2D(6)
+        self.classifier = nn.Sequential(
+            nn.Dropout(dropout), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Layer):
+    """Reference: paddle.vision.models.VGG (cfgs A/B/D/E = 11/13/16/19)."""
+
+    def __init__(self, cfg="D", num_classes=1000, batch_norm=False,
+                 dropout=0.5):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in _VGG_CFGS[cfg] if isinstance(cfg, str) else cfg:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, 2))
+            else:
+                layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.ReLU())
+                in_c = v
+        self.features = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2D(7)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 49, 4096), nn.ReLU(), nn.Dropout(dropout),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def vgg11(batch_norm=False, num_classes=1000, **kw):
+    return VGG("A", num_classes, batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, num_classes=1000, **kw):
+    return VGG("B", num_classes, batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, num_classes=1000, **kw):
+    return VGG("D", num_classes, batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, num_classes=1000, **kw):
+    return VGG("E", num_classes, batch_norm, **kw)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers += [nn.Conv2D(in_c, hidden, 1, bias_attr=False),
+                       nn.BatchNorm2D(hidden), nn.ReLU6()]
+        layers += [
+            nn.Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                      groups=hidden, bias_attr=False),
+            nn.BatchNorm2D(hidden), nn.ReLU6(),
+            nn.Conv2D(hidden, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+class MobileNetV2(nn.Layer):
+    """Reference: paddle.vision.models.MobileNetV2 (inverted residuals)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, dropout=0.2):
+        super().__init__()
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = max(8, int(32 * scale))
+        features = [nn.Conv2D(3, in_c, 3, stride=2, padding=1,
+                              bias_attr=False),
+                    nn.BatchNorm2D(in_c), nn.ReLU6()]
+        for t, c, n, s in cfg:
+            out_c = max(8, int(c * scale))
+            for i in range(n):
+                features.append(_InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        last = max(1280, int(1280 * scale))
+        features += [nn.Conv2D(in_c, last, 1, bias_attr=False),
+                     nn.BatchNorm2D(last), nn.ReLU6()]
+        self.features = nn.Sequential(*features)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(nn.Dropout(dropout),
+                                        nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def mobilenet_v2(scale=1.0, num_classes=1000, **kw):
+    return MobileNetV2(scale=scale, num_classes=num_classes, **kw)

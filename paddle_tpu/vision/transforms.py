@@ -77,3 +77,179 @@ class RandomHorizontalFlip:
             x = np.asarray(x)
             return x[..., ::-1].copy()
         return x
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            x = np.asarray(x)
+            chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+            return (x[:, ::-1] if chw else x[::-1]).copy()
+        return x
+
+
+class Pad:
+    """Pad all borders (reference transforms.Pad); HWC or CHW arrays."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = ((padding, padding), (padding, padding)) \
+            if isinstance(padding, int) else \
+            ((padding[1], padding[3]), (padding[0], padding[2])) \
+            if len(padding) == 4 else \
+            ((padding[1], padding[1]), (padding[0], padding[0]))
+        self.fill = fill
+        self.mode = {"constant": "constant", "reflect": "reflect",
+                     "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        (pt, pb), (pl, pr) = self.padding
+        if x.ndim == 2:
+            cfg = [(pt, pb), (pl, pr)]
+        elif chw:
+            cfg = [(0, 0), (pt, pb), (pl, pr)]
+        else:
+            cfg = [(pt, pb), (pl, pr), (0, 0)]
+        kw = {"constant_values": self.fill} if self.mode == "constant" else {}
+        return np.pad(x, cfg, mode=self.mode, **kw)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if self.padding is not None:
+            x = Pad(self.padding, fill=self.fill)(x)
+        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(th - h, 0), max(tw - w, 0)
+            x = Pad((0, 0, pw, ph), fill=self.fill)(x)
+            h, w = h + ph, w + pw
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return x[:, i:i + th, j:j + tw] if chw else x[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                crop = x[:, i:i + th, j:j + tw] if chw \
+                    else x[i:i + th, j:j + tw]
+                return Resize(self.size, self.interpolation)(crop)
+        return Resize(self.size, self.interpolation)(CenterCrop(
+            min(h, w))(x))
+
+
+class Grayscale:
+    """RGB → luma; num_output_channels 1 or 3 (reference Grayscale)."""
+
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        rgb = x[:3].astype(np.float32) if chw \
+            else x[..., :3].astype(np.float32)
+        wts = np.float32([0.299, 0.587, 0.114])
+        g = np.tensordot(wts, rgb, axes=(0, 0)) if chw \
+            else rgb @ wts
+        g = g.astype(x.dtype)
+        if chw:
+            g = g[None]
+            return np.repeat(g, self.n, axis=0) if self.n == 3 else g
+        g = g[..., None]
+        return np.repeat(g, self.n, axis=-1) if self.n == 3 else g
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation jitter on HWC/CHW uint8 or float."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        # hue shift needs HSV conversion; approximated as disabled
+        self.hue = hue
+
+    @staticmethod
+    def _factor(v):
+        return np.random.uniform(max(0.0, 1 - v), 1 + v) if v else 1.0
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        dt = x.dtype
+        xf = x.astype(np.float32)
+        hi = 255.0 if np.issubdtype(dt, np.integer) else 1.0
+        b, c, s = (self._factor(self.brightness), self._factor(self.contrast),
+                   self._factor(self.saturation))
+        xf = xf * b
+        xf = (xf - xf.mean()) * c + xf.mean()
+        chw = xf.ndim == 3 and xf.shape[0] in (1, 3, 4)
+        gray = xf.mean(axis=0, keepdims=True) if chw else \
+            xf.mean(axis=-1, keepdims=True)
+        xf = (xf - gray) * s + gray
+        return np.clip(xf, 0, hi).astype(dt)
+
+
+class RandomRotation:
+    """Random rotation via PIL (reference RandomRotation); HWC uint8."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.expand = expand
+        self.fill = fill
+
+    def __call__(self, x):
+        from PIL import Image
+        arr = np.asarray(x)
+        angle = np.random.uniform(*self.degrees)
+        img = Image.fromarray(arr.squeeze() if arr.ndim == 3 and
+                              arr.shape[-1] == 1 else arr)
+        out = np.asarray(img.rotate(angle, expand=self.expand,
+                                    fillcolor=self.fill))
+        if arr.ndim == 3 and arr.shape[-1] == 1:
+            out = out[..., None]
+        return out
+
+
+class ToPILImage:
+    def __call__(self, x):
+        from PIL import Image
+        arr = np.asarray(x)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):  # CHW → HWC
+            arr = arr.transpose(1, 2, 0)
+        if arr.dtype != np.uint8:
+            arr = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
+        return Image.fromarray(arr.squeeze())

@@ -1,0 +1,173 @@
+"""Vision datasets + io combinators + new model families + transforms
+(reference: python/paddle/vision/datasets, python/paddle/io)."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import vision
+from paddle_tpu.io import (ConcatDataset, DataLoader, Subset,
+                           SubsetRandomSampler, TensorDataset,
+                           WeightedRandomSampler, random_split)
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import (Cifar10, FakeData, DatasetFolder,
+                                        ImageFolder, MNIST)
+
+
+R = np.random.RandomState(3)
+
+
+def _write_mnist(dirpath, n=10, gz=False):
+    os.makedirs(dirpath, exist_ok=True)
+    imgs = R.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = R.randint(0, 10, (n,)).astype(np.uint8)
+    op = gzip.open if gz else open
+    suffix = ".gz" if gz else ""
+    with op(os.path.join(dirpath, "train-images-idx3-ubyte" + suffix),
+            "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+    with op(os.path.join(dirpath, "train-labels-idx1-ubyte" + suffix),
+            "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+    return imgs, labels
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    imgs, labels = _write_mnist(str(tmp_path), gz=False)
+    ds = MNIST(image_path=str(tmp_path), mode="train")
+    assert len(ds) == len(imgs)
+    img, lbl = ds[3]
+    np.testing.assert_array_equal(img, imgs[3])
+    assert lbl == int(labels[3])
+
+
+def test_mnist_gz_and_transform(tmp_path):
+    imgs, _ = _write_mnist(str(tmp_path), gz=True)
+    ds = MNIST(image_path=str(tmp_path), transform=T.ToTensor())
+    img, _ = ds[0]
+    assert img.shape == (1, 28, 28) and img.dtype == np.float32
+    assert img.max() <= 1.0
+
+
+def test_cifar10_tar(tmp_path):
+    data = R.randint(0, 256, (4, 3072), dtype=np.uint8)
+    labels = [0, 1, 2, 3]
+    batches_dir = tmp_path / "cifar-10-batches-py"
+    batches_dir.mkdir()
+    for i in range(1, 6):
+        with open(batches_dir / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    tar_path = tmp_path / "cifar10.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(batches_dir, arcname="cifar-10-batches-py")
+    ds = Cifar10(data_file=str(tar_path), mode="train")
+    assert len(ds) == 20
+    img, lbl = ds[0]
+    assert img.shape == (32, 32, 3) and lbl == 0
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(R.randint(0, 256, (8, 8, 3), dtype=np.uint8)) \
+                .save(d / f"{i}.png")
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+    img, lbl = ds[0]
+    assert img.shape == (8, 8, 3) and lbl == 0
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 4
+    assert flat[0][0].shape == (8, 8, 3)
+
+
+def test_download_raises_without_egress(tmp_path):
+    with pytest.raises((RuntimeError, ValueError)):
+        MNIST(download=True)
+    with pytest.raises((RuntimeError, ValueError)):
+        Cifar10(download=True)
+
+
+def test_fakedata_pipeline():
+    ds = FakeData(size=12, image_shape=(3, 16, 16), transform=T.Compose(
+        [T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)]))
+    dl = DataLoader(ds, batch_size=4, shuffle=True)
+    xb, yb = next(iter(dl))
+    assert np.asarray(xb).shape == (4, 3, 16, 16)
+    assert np.asarray(yb).shape == (4,)
+
+
+def test_io_combinators():
+    a = TensorDataset([jnp.arange(6.0)])
+    b = TensorDataset([jnp.arange(4.0) + 100])
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 10
+    assert float(cat[7][0]) == 101.0
+    sub = Subset(cat, [0, 7])
+    assert float(sub[1][0]) == 101.0
+    parts = random_split(cat, [6, 4], generator=np.random.RandomState(0))
+    assert len(parts[0]) == 6 and len(parts[1]) == 4
+    all_idx = sorted(i for p in parts for i in p.indices)
+    assert all_idx == list(range(10))
+    frac = random_split(cat, [0.5, 0.5],
+                        generator=np.random.RandomState(0))
+    assert len(frac[0]) + len(frac[1]) == 10
+
+    ws = WeightedRandomSampler([0.0, 0.0, 1.0], num_samples=8)
+    assert list(ws) == [2] * 8
+    sr = SubsetRandomSampler([4, 5, 6],
+                             generator=np.random.RandomState(0))
+    assert sorted(sr) == [4, 5, 6]
+
+
+def test_new_transforms():
+    img = R.randint(0, 256, (10, 12, 3), dtype=np.uint8)
+    assert T.Pad(2)(img).shape == (14, 16, 3)
+    assert T.RandomCrop(8)(img).shape == (8, 8, 3)
+    assert T.RandomResizedCrop(6)(img).shape[:2] == (6, 6)
+    g = T.Grayscale()(img)
+    assert g.shape == (10, 12, 1)
+    g3 = T.Grayscale(3)(img)
+    assert g3.shape == (10, 12, 3)
+    np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+    cj = T.ColorJitter(0.2, 0.2, 0.2)(img)
+    assert cj.shape == img.shape and cj.dtype == np.uint8
+    rot = T.RandomRotation(30)(img)
+    assert rot.shape == img.shape
+    vert = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(vert, img[::-1])
+    pil = T.ToPILImage()(T.ToTensor()(img))
+    assert pil.size == (12, 10)
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    (lambda: vision.LeNet(num_classes=10), (2, 1, 28, 28)),
+    (lambda: vision.MobileNetV2(scale=0.25, num_classes=7), (1, 3, 32, 32)),
+])
+def test_small_vision_models_forward(ctor, shape):
+    paddle_tpu.seed(0)
+    m = ctor()
+    m.eval()
+    x = jnp.asarray(R.standard_normal(shape).astype(np.float32))
+    out = functional_call(m, m.trainable_state(), x)
+    assert out.shape[0] == shape[0]
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_vgg_constructs():
+    m = vision.vgg11(num_classes=5)
+    n = sum(int(np.prod(p.shape)) for p in
+            m.trainable_state().values()) if isinstance(
+        m.trainable_state(), dict) else m.num_params()
+    assert n > 1e6
