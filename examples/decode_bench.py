@@ -76,6 +76,9 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--prompt_len", type=int, default=128)
     ap.add_argument("--new_tokens", type=int, default=256)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 (halves the weight stream — "
+                    "the fused_multi_transformer_int8 analog)")
     ns = ap.parse_args()
 
     import paddle_tpu
@@ -90,7 +93,12 @@ def main():
     paddle_tpu.seed(0)
     cfg, model = build_model(name)
     n_params = model.num_params()
-    state = model.trainable_state()
+    if ns.int8:
+        from paddle_tpu.quantization import quantize_model, quantized_state
+        quantize_model(model)
+        state = quantized_state(model)
+    else:
+        state = model.trainable_state()
 
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(
@@ -124,15 +132,20 @@ def main():
     tok_s = ns.batch * n_eff / dt
     per_seq = n_eff / dt
 
-    # roofline: average cache length over the decode window
+    # roofline: average cache length over the decode window. int8
+    # quantizes every linear INCLUDING lm_head; only the embedding table
+    # (one vocab×hidden gather source) stays bf16.
     avg_len = ns.prompt_len + ns.new_tokens / 2
-    param_bytes = 2 * n_params
+    embed_params = cfg.vocab_size * cfg.hidden_size
+    param_bytes = ((n_params - embed_params) + 2 * embed_params) if ns.int8 \
+        else 2 * n_params
     step_bytes = param_bytes + ns.batch * kv_bytes_per_token(cfg) * avg_len
     bw = HBM_BW.get(dev.device_kind, 819e9 if on_tpu else 50e9)
     roofline_tok_s = ns.batch * bw / step_bytes
 
+    tag = " int8" if ns.int8 else ""
     print(json.dumps({
-        "metric": f"{name} decode tokens/s (batch={ns.batch})",
+        "metric": f"{name}{tag} decode tokens/s (batch={ns.batch})",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "tokens_per_sec_per_seq": round(per_seq, 1),

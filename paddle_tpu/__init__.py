@@ -156,6 +156,7 @@ from paddle_tpu import parallel as distributed  # noqa: F401
 _sys.modules[__name__ + ".distributed"] = distributed
 from paddle_tpu import linalg  # noqa: F401
 from paddle_tpu import fft  # noqa: F401
+from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401

@@ -29,3 +29,9 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     if ln_bias is not None:
         out = out + ln_bias
     return out
+
+
+# reference paths: paddle.incubate.nn.functional.{fused_rotary_position_
+# embedding, fused_rms_norm} — the TPU implementations live in paddle_tpu.ops
+from paddle_tpu.ops.rope import fused_rotary_position_embedding  # noqa: F401,E402
+from paddle_tpu.ops.rms_norm import rms_norm as fused_rms_norm  # noqa: F401,E402

@@ -905,3 +905,7 @@ def adaptive_avg_pool3d(x, output_size):
     return jnp.mean(
         x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow),
         axis=(3, 5, 7))
+
+
+# reference path: paddle.nn.functional.flash_attention.flash_attention
+from paddle_tpu.ops.flash_attention import flash_attention  # noqa: F401,E402

@@ -90,10 +90,14 @@ def flash_attention(q, k, v, dropout=0.0, causal=False, attn_mask=None,
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, scale=None):
     from paddle_tpu.ops import use_pallas
-    # Pallas path: TPU, no dropout, no arbitrary mask, long enough seq to win.
+    # Pallas path: TPU, self-attention, seq any multiple of 128 (block size
+    # adapts) once long enough to beat XLA. Documented exclusions that route
+    # to the XLA path by design: attention dropout (modern LLM pretraining
+    # runs attn dropout 0; the XLA path implements it) and dense/boolean
+    # masks (padding masks belong in kv lengths — round-3 kernel work).
     if (use_pallas() and dropout_p == 0.0 and attn_mask is None
             and q.shape[1] == k.shape[1] and q.shape[1] >= 1024
-            and q.shape[1] % 512 == 0 and q.shape[-1] in (64, 128, 256)):
+            and q.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)):
         try:
             return _flash_attention_vjp(q, k, v, is_causal, scale)
         except Exception as e:
@@ -110,13 +114,21 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
 _BLK = 512
 
 
+def _pick_blk(s):
+    """Largest block in (512, 256, 128) dividing s — lets the kernels
+    cover any s % 128 == 0, not just 512-multiples."""
+    for blk in (512, 256, 128):
+        if s % blk == 0:
+            return blk
+    raise ValueError(f"seq {s} not a multiple of 128")
+
+
 def _fwd_kernels(qt, kt, vt, is_causal: bool, sc: float):
     """qt/kt/vt: (b, h, s, d) → (out (b,h,s,d), lse (b,h,s)) fp32 lse."""
     from jax.experimental import pallas as pl
 
     b, h, s, d = qt.shape
-    blk_q = min(_BLK, s)
-    blk_k = min(_BLK, s)
+    blk_q = blk_k = _pick_blk(s)
     grid = (b, h, s // blk_q)
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
@@ -185,8 +197,7 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
     from jax.experimental import pallas as pl
 
     b, h, s, d = qt.shape
-    blk_q = min(_BLK, s)
-    blk_k = min(_BLK, s)
+    blk_q = blk_k = _pick_blk(s)
     grid = (b, h, s // blk_q)
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref):
@@ -239,8 +250,7 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
     from jax.experimental import pallas as pl
 
     b, h, s, d = qt.shape
-    blk_q = min(_BLK, s)
-    blk_k = min(_BLK, s)
+    blk_q = blk_k = _pick_blk(s)
     grid = (b, h, s // blk_k)
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref):

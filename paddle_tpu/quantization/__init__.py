@@ -1,0 +1,119 @@
+"""Weight-only int8 quantization for inference.
+
+Reference (SURVEY.md §2.2-fusion): the decode crown jewels ship int8
+variants — `fused_multi_transformer_int8_op.cu`, and the python surface
+`paddle.nn.quant.weight_only_linear` / `paddle.quantization`. Decode is
+HBM-bandwidth bound (see examples/decode_bench.py): streaming int8
+weights instead of bf16 halves the bytes/step, which is the single
+biggest decode-throughput lever on TPU as on GPU.
+
+TPU-native design: weights are stored as int8 + a per-output-channel
+fp32 scale; the forward dequantizes `w = q.astype(bf16) * scale` right at
+the matmul operand, which XLA fuses into the dot's operand load — HBM
+traffic stays int8. No kernel is needed; the MXU consumes the dequantized
+tiles from VMEM.
+
+`quantize_model(model)` converts IN PLACE: every Linear-like sublayer
+(plain, column-, row-, or sequence-parallel — anything with a 2-D
+`weight` whose forward reads `self.weight`) gets its weight replaced by
+(weight_q int8, weight_scale fp32) and a class-level `weight` property
+that dequantizes on read. Class behavior (sharding constraints, bias,
+gather/scatter) is preserved exactly; TP pspecs carry over to the int8
+tensor.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer import Layer, Parameter
+
+
+def quantize_weight_int8(w) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel (last dim) int8 quantization.
+
+    w: (..., in, out) float → (int8 same shape, fp32 scale (out,))."""
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=tuple(range(wf.ndim - 1)))
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def weight_only_linear(x, weight_q, weight_scale, bias=None):
+    """paddle.nn.quant.weight_only_linear parity (int8 path)."""
+    w = weight_q.astype(x.dtype) * weight_scale.astype(x.dtype)
+    y = x @ w
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+_QUANT_CLASS_CACHE = {}
+
+
+def _quantized_class(base, dequant_dtype):
+    key = (base, jnp.dtype(dequant_dtype).name)
+    cls = _QUANT_CLASS_CACHE.get(key)
+    if cls is None:
+        def _weight(self):
+            q = self._parameters["weight_q"].value
+            s = self._parameters["weight_scale"].value
+            return q.astype(dequant_dtype) * s.astype(dequant_dtype)
+
+        cls = type(f"Int8{base.__name__}", (base,),
+                   {"weight": property(_weight),
+                    "_is_weight_only_int8": True})
+        _QUANT_CLASS_CACHE[key] = cls
+    return cls
+
+
+def _quantize_layer(layer: Layer, dequant_dtype):
+    w = layer._parameters.pop("weight")
+    q, scale = quantize_weight_int8(w.value)
+    qp = Parameter(q, trainable=False)
+    sp = Parameter(scale, trainable=False)
+    # carry the TP sharding onto the int8 tensor; the per-out-channel scale
+    # is sharded iff the out (last) dim of the weight was
+    pspec = getattr(w, "pspec", None)
+    if pspec is not None:
+        qp.pspec = pspec
+        qp.is_distributed = getattr(w, "is_distributed", False)
+        from jax.sharding import PartitionSpec as P
+        out_axis = pspec[-1] if len(pspec) else None
+        sp.pspec = P(out_axis)
+        sp.is_distributed = qp.is_distributed
+    layer._parameters["weight_q"] = qp
+    layer._parameters["weight_scale"] = sp
+    layer.__class__ = _quantized_class(type(layer), dequant_dtype)
+
+
+def quantize_model(model: Layer, dequant_dtype=jnp.bfloat16,
+                   include: Optional[Sequence[type]] = None,
+                   exclude_names: Sequence[str] = ("embed",)) -> Layer:
+    """In-place weight-only int8 conversion of every Linear-like sublayer.
+
+    A sublayer qualifies when it has a 2-D `weight` parameter and is not
+    name-matched by `exclude_names` (embeddings keep full precision — the
+    gather reads one row, quantization saves nothing and costs accuracy).
+    Returns the same model for chaining."""
+    for name, sub in model.named_sublayers(include_self=True):
+        if getattr(sub, "_is_weight_only_int8", False):
+            continue
+        w = sub._parameters.get("weight")
+        if w is None or w.value.ndim != 2:
+            continue
+        if include is not None and not isinstance(sub, tuple(include)):
+            continue
+        if any(t in name.lower() or t in type(sub).__name__.lower()
+               for t in exclude_names):
+            continue
+        _quantize_layer(sub, dequant_dtype)
+    return model
+
+
+def quantized_state(model: Layer):
+    """All named parameters (incl. the non-trainable int8/scale tensors) —
+    pass as `state=` to functional_call / inference.generate."""
+    return {n: p.value for n, p in model.named_parameters()}

@@ -1,0 +1,57 @@
+"""HuggingFace checkpoint interop.
+
+Reference users come from an ecosystem (PaddleNLP) whose Llama checkpoints
+interconvert with HuggingFace's; the TPU-native framework accepts HF
+`LlamaForCausalLM` state dicts directly. Our module tree mirrors HF naming
+(`model.layers.N.self_attn.q_proj.weight`, ...), so conversion is just
+layout: torch `nn.Linear` stores (out, in) while our Linear is (in, out) —
+linear weights transpose; embeddings and norms copy through.
+
+Works with torch tensors, numpy arrays, or anything `np.asarray` accepts
+(e.g. safetensors slices).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# weights that live in (out, in) torch-Linear layout → transpose
+_LINEAR_SUFFIXES = (
+    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+    "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight",
+    "lm_head.weight",
+)
+_SKIP_SUBSTRINGS = ("rotary_emb", "masked_bias", "attn.bias")
+
+
+def _to_np(v):
+    if hasattr(v, "detach"):  # torch tensor
+        v = v.detach().cpu().float().numpy()
+    return np.asarray(v)
+
+
+def convert_hf_llama_state_dict(hf_state: Dict, dtype=None) -> Dict:
+    """HF LlamaForCausalLM state_dict → paddle_tpu Llama state dict."""
+    out = {}
+    for k, v in hf_state.items():
+        if any(s in k for s in _SKIP_SUBSTRINGS):
+            continue
+        arr = _to_np(v)
+        if any(k.endswith(s) for s in _LINEAR_SUFFIXES):
+            arr = arr.T
+        a = jnp.asarray(arr)
+        if dtype is not None:
+            a = a.astype(dtype)
+        out[k] = a
+    return out
+
+
+def load_hf_llama(model, hf_state: Dict, dtype=None):
+    """Load a converted HF state into a paddle_tpu LlamaForCausalLM
+    (in place); returns the model's new trainable state for functional use."""
+    converted = convert_hf_llama_state_dict(hf_state, dtype=dtype)
+    model.set_state_dict(converted)
+    return model.trainable_state()

@@ -23,7 +23,10 @@ _NO_EGRESS = ("this environment has no network egress — place the dataset "
 
 class FakeData(Dataset):
     """Deterministic synthetic images (torchvision FakeData analog) — for
-    exercising input pipelines without any files."""
+    exercising input pipelines without any files.
+
+    `image_shape` is (C, H, W) metadata; raw samples are HWC uint8 arrays
+    like every decoded image in this module (run ToTensor for CHW float)."""
 
     def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
                  transform=None, seed=0):
@@ -145,7 +148,15 @@ class Cifar10(Dataset):
             return
         with tarfile.open(data_file, "r:*") as tf:
             for n in names:
-                m = tf.extractfile(f"{self.archive_prefix}/{n}")
+                member = f"{self.archive_prefix}/{n}"
+                try:
+                    m = tf.extractfile(member)
+                except KeyError:
+                    m = None
+                if m is None:
+                    raise FileNotFoundError(
+                        f"{data_file}: archive member {member!r} missing "
+                        "or not a regular file")
                 yield m.read()
 
     def __len__(self):

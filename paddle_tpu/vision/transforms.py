@@ -3,6 +3,14 @@
 import numpy as np
 
 
+def _is_chw(x):
+    """Channels-first heuristic: 3-D with a small leading dim. Ambiguous
+    only for images whose height is 1/3/4 AND whose channel count is not —
+    callers with such data should pass HWC (the dataset default)."""
+    return x.ndim == 3 and x.shape[0] in (1, 3, 4) and \
+        x.shape[-1] not in (1, 3, 4)
+
+
 class Compose:
     def __init__(self, transforms):
         self.transforms = list(transforms)
@@ -45,7 +53,7 @@ class Resize:
         import jax
         import jax.numpy as jnp
         arr = jnp.asarray(x, jnp.float32)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = _is_chw(arr)
         if chw:
             out_shape = (arr.shape[0],) + self.size
         else:
@@ -61,7 +69,7 @@ class CenterCrop:
 
     def __call__(self, x):
         x = np.asarray(x)
-        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        chw = _is_chw(x)
         h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
         th, tw = self.size
         i, j = max(0, (h - th) // 2), max(0, (w - tw) // 2)
@@ -75,7 +83,9 @@ class RandomHorizontalFlip:
     def __call__(self, x):
         if np.random.rand() < self.prob:
             x = np.asarray(x)
-            return x[..., ::-1].copy()
+            # width axis: last for 2-D/CHW, second-to-last for HWC
+            axis = -1 if x.ndim == 2 or _is_chw(x) else -2
+            return np.flip(x, axis=axis).copy()
         return x
 
 
@@ -86,7 +96,7 @@ class RandomVerticalFlip:
     def __call__(self, x):
         if np.random.rand() < self.prob:
             x = np.asarray(x)
-            chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+            chw = _is_chw(x)
             return (x[:, ::-1] if chw else x[::-1]).copy()
         return x
 
@@ -106,7 +116,7 @@ class Pad:
 
     def __call__(self, x):
         x = np.asarray(x)
-        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        chw = _is_chw(x)
         (pt, pb), (pl, pr) = self.padding
         if x.ndim == 2:
             cfg = [(pt, pb), (pl, pr)]
@@ -129,7 +139,7 @@ class RandomCrop:
         x = np.asarray(x)
         if self.padding is not None:
             x = Pad(self.padding, fill=self.fill)(x)
-        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        chw = _is_chw(x)
         h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
         th, tw = self.size
         if self.pad_if_needed and (h < th or w < tw):
@@ -150,7 +160,7 @@ class RandomResizedCrop:
 
     def __call__(self, x):
         x = np.asarray(x)
-        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
+        chw = _is_chw(x)
         h, w = (x.shape[1], x.shape[2]) if chw else (x.shape[0], x.shape[1])
         area = h * w
         for _ in range(10):
@@ -170,20 +180,32 @@ class RandomResizedCrop:
 
 
 class Grayscale:
-    """RGB → luma; num_output_channels 1 or 3 (reference Grayscale)."""
+    """RGB → luma; num_output_channels 1 or 3 (reference Grayscale).
+    Already-gray inputs (2-D, or 1-channel HWC/CHW) pass through with
+    channel replication as requested."""
 
     def __init__(self, num_output_channels=1):
         self.n = num_output_channels
 
     def __call__(self, x):
         x = np.asarray(x)
-        chw = x.ndim == 3 and x.shape[0] in (1, 3, 4)
-        rgb = x[:3].astype(np.float32) if chw \
-            else x[..., :3].astype(np.float32)
-        wts = np.float32([0.299, 0.587, 0.114])
-        g = np.tensordot(wts, rgb, axes=(0, 0)) if chw \
-            else rgb @ wts
-        g = g.astype(x.dtype)
+        if x.ndim == 2:
+            g, chw = x, False
+        elif _is_chw(x):
+            chw = True
+            if x.shape[0] == 1:
+                g = x[0]
+            else:
+                wts = np.float32([0.299, 0.587, 0.114])
+                g = np.tensordot(wts, x[:3].astype(np.float32),
+                                 axes=(0, 0)).astype(x.dtype)
+        else:
+            chw = False
+            if x.shape[-1] == 1:
+                g = x[..., 0]
+            else:
+                wts = np.float32([0.299, 0.587, 0.114])
+                g = (x[..., :3].astype(np.float32) @ wts).astype(x.dtype)
         if chw:
             g = g[None]
             return np.repeat(g, self.n, axis=0) if self.n == 3 else g
@@ -214,7 +236,7 @@ class ColorJitter:
                    self._factor(self.saturation))
         xf = xf * b
         xf = (xf - xf.mean()) * c + xf.mean()
-        chw = xf.ndim == 3 and xf.shape[0] in (1, 3, 4)
+        chw = _is_chw(xf)
         gray = xf.mean(axis=0, keepdims=True) if chw else \
             xf.mean(axis=-1, keepdims=True)
         xf = (xf - gray) * s + gray

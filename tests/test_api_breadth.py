@@ -370,3 +370,34 @@ def test_dropout3d_channels_last():
     # whole channels (last axis) dropped or kept uniformly
     per_chan = np.moveaxis(y, -1, 1).reshape(2, 8, -1)
     assert all(len(np.unique(c)) <= 1 for b in per_chan for c in b)
+
+
+@pytest.mark.skipif(
+    jnp.zeros(1).devices().pop().platform != "tpu",
+    reason="Pallas flash kernels dispatch only on TPU")
+def test_flash_pallas_uneven_seq_matches_xla():
+    """s=1280 (not a 512-multiple) now runs the Pallas path (adaptive
+    block size); numerics must match the XLA reference fwd+bwd."""
+    import jax
+
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops import flash_attention as fa
+
+    set_flags({"FLAGS_pallas_strict": True})
+    try:
+        rng = np.random.RandomState(0)
+        b, s, h, d = 1, 1280, 2, 128
+        q, k, v = (jnp.asarray(rng.standard_normal(
+            (b, s, h, d)).astype(np.float32) * 0.3) for _ in range(3))
+        o1, g1 = jax.value_and_grad(
+            lambda *a: fa._flash_attention_vjp(*a, True, None).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        o2, g2 = jax.value_and_grad(
+            lambda *a: fa._xla_attention(*a, is_causal=True).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        assert np.allclose(float(o1), float(o2), rtol=2e-3)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-2, atol=5e-3)
+    finally:
+        set_flags({"FLAGS_pallas_strict": False})

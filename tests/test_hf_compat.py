@@ -1,0 +1,55 @@
+"""HF Llama checkpoint interop: logits must match transformers' own
+LlamaForCausalLM on identical weights (the strongest cross-framework
+numerics check available on this box)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.utils.hf_compat import (convert_hf_llama_state_dict,
+                                        load_hf_llama)
+
+
+def test_hf_llama_logits_match():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=64, rms_norm_eps=1e-5)
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    state = load_hf_llama(model, hf_model.state_dict())
+
+    ids = np.random.RandomState(0).randint(0, 256, (2, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(functional_call(model, state, jnp.asarray(ids)),
+                      np.float32)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_transposes_only_linears():
+    w_lin = np.arange(12, dtype=np.float32).reshape(3, 4)  # (out=3, in=4)
+    w_emb = np.arange(8, dtype=np.float32).reshape(4, 2)
+    sd = {
+        "model.layers.0.self_attn.q_proj.weight": w_lin,
+        "model.embed_tokens.weight": w_emb,
+        "model.layers.0.self_attn.rotary_emb.inv_freq": np.zeros(2),
+    }
+    out = convert_hf_llama_state_dict(sd)
+    assert out["model.layers.0.self_attn.q_proj.weight"].shape == (4, 3)
+    assert out["model.embed_tokens.weight"].shape == (4, 2)
+    assert "model.layers.0.self_attn.rotary_emb.inv_freq" not in out

@@ -88,13 +88,18 @@ def test_sort_topk_consistent(x):
 def test_layer_norm_normalizes(x):
     if x.shape[-1] < 2:
         return
-    out = np.asarray(F.layer_norm(jnp.asarray(x), (x.shape[-1],)))
+    eps = 1e-5
+    out = np.asarray(F.layer_norm(jnp.asarray(x), (x.shape[-1],),
+                                  epsilon=eps))
     np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-3)
-    # unit variance unless the row is constant
-    row_std = x.std(-1)
-    mask = row_std > 1e-3
+    # output std is sqrt(var/(var+eps)) — epsilon matters for
+    # near-constant rows (hypothesis found x=[7.09375, 7.125, 7.125])
+    var = x.astype(np.float64).var(-1)
+    expected_std = np.sqrt(var / (var + eps))
+    mask = x.std(-1) > 1e-3
     if mask.any():
-        np.testing.assert_allclose(out.std(-1)[mask], 1.0, atol=2e-2)
+        np.testing.assert_allclose(out.std(-1)[mask], expected_std[mask],
+                                   atol=2e-2)
 
 
 @_settings

@@ -1,0 +1,88 @@
+"""Weight-only int8 quantization (reference: paddle.nn.quant
+weight_only_linear, fused_multi_transformer_int8)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.quantization import (quantize_model, quantize_weight_int8,
+                                     quantized_state, weight_only_linear)
+
+
+def test_quantize_weight_roundtrip():
+    rng = np.random.RandomState(0)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    q, scale = quantize_weight_int8(jnp.asarray(w))
+    assert q.dtype == jnp.int8 and scale.shape == (8,)
+    deq = np.asarray(q, np.float32) * np.asarray(scale)
+    # max per-channel error bounded by scale/2 (symmetric rounding)
+    err = np.abs(deq - w)
+    assert (err <= np.asarray(scale) / 2 + 1e-7).all()
+
+
+def test_weight_only_linear_matches_fp():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(8).astype(np.float32))
+    q, s = quantize_weight_int8(w)
+    y = weight_only_linear(x, q, s, b)
+    ref = x @ w + b
+    rel = np.linalg.norm(np.asarray(y - ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.02, rel
+
+
+def test_quantize_model_preserves_logits_and_decodes():
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny()
+    m = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 10)))
+    ref = functional_call(m, m.trainable_state(), ids)
+
+    quantize_model(m)
+    st = quantized_state(m)
+    assert any(k.endswith("weight_q") for k in st)
+    # embeddings stay full precision
+    assert "model.embed_tokens.weight" in st
+    assert "model.embed_tokens.weight_q" not in st
+    out = functional_call(m, st, ids)
+    a = np.asarray(ref, np.float32).ravel()
+    b = np.asarray(out, np.float32).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999, cos
+
+    from paddle_tpu.inference import generate
+    out_ids = generate(m, ids[:, :4], max_new_tokens=4, temperature=0.0,
+                       state=st, cache_dtype=jnp.float32)
+    assert out_ids.shape == (2, 8)
+
+
+def test_quantize_plain_linear_layer():
+    paddle_tpu.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    x = jnp.asarray(np.random.RandomState(2).standard_normal(
+        (3, 8)).astype(np.float32))
+    ref = functional_call(m, m.trainable_state(), x)
+    quantize_model(m)
+    st = quantized_state(m)
+    out = functional_call(m, st, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1,
+                               atol=0.05)
+    # idempotent: second call is a no-op
+    quantize_model(m)
+    assert sum(1 for k in quantized_state(m) if k.endswith("weight_q")) == 2
+
+
+def test_quantized_tp_pspec_carries_over():
+    from paddle_tpu.parallel import mp_layers as mp
+
+    paddle_tpu.seed(0)
+    col = mp.ColumnParallelLinear(8, 16, has_bias=False, gather_output=False)
+    orig_pspec = col._parameters["weight"].pspec
+    quantize_model(col)
+    assert col._parameters["weight_q"].pspec == orig_pspec
+    assert col._parameters["weight_scale"].pspec[0] == orig_pspec[-1]

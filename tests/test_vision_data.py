@@ -171,3 +171,30 @@ def test_vgg_constructs():
             m.trainable_state().values()) if isinstance(
         m.trainable_state(), dict) else m.num_params()
     assert n > 1e6
+
+
+def test_flip_axes_and_grayscale_robustness():
+    """Round-2 review regressions: HWC horizontal flip must flip WIDTH
+    (not channels); Grayscale must handle 2-D and 1-channel inputs."""
+    img = np.arange(24, dtype=np.uint8).reshape(2, 4, 3)      # HWC
+    np.testing.assert_array_equal(
+        T.RandomHorizontalFlip(prob=1.0)(img), img[:, ::-1])
+    chw = np.arange(32, dtype=np.uint8).reshape(1, 4, 8)       # CHW
+    np.testing.assert_array_equal(
+        T.RandomHorizontalFlip(prob=1.0)(chw), chw[..., ::-1])
+    assert T.Grayscale()(np.zeros((10, 12, 1), np.uint8)).shape == (10, 12, 1)
+    assert T.Grayscale()(np.zeros((10, 12), np.uint8)).shape == (10, 12, 1)
+    assert T.Grayscale(3)(np.zeros((1, 10, 12), np.uint8)).shape == (3, 10, 12)
+
+
+def test_cifar_missing_member_named(tmp_path):
+    import tarfile as tar_mod
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    (d / "data_batch_1").write_bytes(pickle.dumps(
+        {b"data": np.zeros((1, 3072), np.uint8), b"labels": [0]}))
+    t = tmp_path / "partial.tar"
+    with tar_mod.open(t, "w") as tf:
+        tf.add(d, arcname="cifar-10-batches-py")
+    with pytest.raises(FileNotFoundError, match="data_batch_2"):
+        Cifar10(data_file=str(t), mode="train")
