@@ -19,6 +19,13 @@ import numpy as np
 from paddle_tpu.nn.layer import functional_call
 
 
+def _inference_state(model):
+    """ALL named parameters, not just trainable ones — a quantized model's
+    int8 weights are trainable=False and must still be bound (otherwise
+    jit bakes them into the program as constants)."""
+    return {n: p.value for n, p in model.named_parameters()}
+
+
 def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
     """logits (b, vocab) → token ids (b,). Greedy when temperature == 0."""
     if temperature == 0.0:
@@ -54,7 +61,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     input_ids = jnp.asarray(input_ids)
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
-    state = state if state is not None else model.trainable_state()
+    state = state if state is not None else _inference_state(model)
     cache = model.init_cache(b, total, dtype=cache_dtype)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
@@ -113,7 +120,7 @@ class Predictor:
 
     def __init__(self, model, state: Optional[Dict] = None):
         self.model = model
-        self.state = state if state is not None else model.trainable_state()
+        self.state = state if state is not None else _inference_state(model)
         self._fwd = jax.jit(
             lambda st, *args, **kw: functional_call(model, st, *args, **kw))
 

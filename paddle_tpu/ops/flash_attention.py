@@ -96,8 +96,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     # runs attn dropout 0; the XLA path implements it) and dense/boolean
     # masks (padding masks belong in kv lengths — round-3 kernel work).
     if (use_pallas() and dropout_p == 0.0 and attn_mask is None
-            and q.shape[1] == k.shape[1] and q.shape[1] >= 1024
-            and q.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)):
+            and q.shape[1] == k.shape[1] and _pallas_seq_ok(q.shape[1])
+            and q.shape[-1] in (64, 128, 256)):
         try:
             return _flash_attention_vjp(q, k, v, is_causal, scale)
         except Exception as e:
@@ -396,11 +396,17 @@ _flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 # ---- forward + LSE (ring-attention building block) ------------------------
 
+def _pallas_seq_ok(s: int) -> bool:
+    """Shared dispatch predicate: long enough to beat XLA and divisible by
+    a supported block size (see _pick_blk)."""
+    return s >= 1024 and s % 128 == 0
+
+
 def _pallas_lse_ok(q, k):
     from paddle_tpu.ops import use_pallas
     s = q.shape[1]
-    return (use_pallas() and s == k.shape[1] and s >= 1024
-            and s % _BLK == 0 and q.shape[-1] in (64, 128, 256))
+    return (use_pallas() and s == k.shape[1] and _pallas_seq_ok(s)
+            and q.shape[-1] in (64, 128, 256))
 
 
 def _xla_fwd_lse(q, k, v, is_causal, scale):

@@ -49,9 +49,18 @@ def convert_hf_llama_state_dict(hf_state: Dict, dtype=None) -> Dict:
     return out
 
 
-def load_hf_llama(model, hf_state: Dict, dtype=None):
+def load_hf_llama(model, hf_state: Dict, dtype=None, strict: bool = True):
     """Load a converted HF state into a paddle_tpu LlamaForCausalLM
-    (in place); returns the model's new trainable state for functional use."""
+    (in place); returns the model's new trainable state for functional use.
+
+    strict=True (default) raises if any model parameter was NOT covered by
+    the checkpoint — a silent partial load (e.g. a tied-embeddings HF
+    checkpoint with no lm_head.weight) would otherwise leave random-init
+    weights in place."""
     converted = convert_hf_llama_state_dict(hf_state, dtype=dtype)
-    model.set_state_dict(converted)
+    missing, unexpected = model.set_state_dict(converted)
+    if strict and missing:
+        raise ValueError(
+            f"HF checkpoint did not cover model parameters {missing}; "
+            "pass strict=False to accept a partial load")
     return model.trainable_state()

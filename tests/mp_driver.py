@@ -76,14 +76,73 @@ def _worker(rank, port):
     print(f"rank{rank} MP_OK", flush=True)
 
 
-def main():
-    from paddle_tpu.parallel import launch
+def _pipeline_worker(rank, port, expected_loss):
+    """True multi-host pipeline: the pp2 1F1B train step as ONE
+    multi-controller SPMD program over a global mesh spanning two OS
+    processes (stage 0 on rank 0's device, stage 1 on rank 1's) — the
+    TPU-native answer to the reference's cross-host NCCL pipeline."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
 
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    from paddle_tpu.parallel import env as penv
+
+    penv.init_parallel_env()
+    assert jax.process_count() == 2 and jax.device_count() == 2
+
+    import numpy as np
+    import paddle_tpu
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import fleet
+    from paddle_tpu.parallel.pipeline import make_pipeline_train_step
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                        "sharding_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 2
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-3)
+    step_fn, init_fn = make_pipeline_train_step(model, opt, strategy=s)
+    state, opt_state = init_fn()
+
+    ids = np.random.RandomState(0).randint(0, 256, (2, 17))
+    batch = {"input": ids[:, :-1], "labels": ids[:, 1:]}
+    state, opt_state, loss = step_fn(state, opt_state, batch)
+    loss = float(loss)
+    assert np.isfinite(loss), loss
+    if expected_loss is not None:
+        assert abs(loss - expected_loss) < 1e-3, (loss, expected_loss)
+    print(f"rank{rank} PIPELINE_MP_OK loss={loss:.5f}", flush=True)
+
+
+def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    launch.spawn(_worker, args=(port,), nprocs=2)
+    return port
+
+
+def main():
+    from paddle_tpu.parallel import launch
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "collectives"
+    if which == "collectives":
+        launch.spawn(_worker, args=(_free_port(),), nprocs=2)
+    elif which == "pipeline":
+        expected = float(sys.argv[2]) if len(sys.argv) > 2 else None
+        launch.spawn(_pipeline_worker, args=(_free_port(), expected),
+                     nprocs=2)
+    else:
+        raise SystemExit(f"unknown driver mode {which!r}")
     print("DRIVER_OK", flush=True)
 
 

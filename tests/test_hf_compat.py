@@ -53,3 +53,14 @@ def test_convert_transposes_only_linears():
     assert out["model.layers.0.self_attn.q_proj.weight"].shape == (4, 3)
     assert out["model.embed_tokens.weight"].shape == (4, 2)
     assert "model.layers.0.self_attn.rotary_emb.inv_freq" not in out
+
+
+def test_strict_load_rejects_partial_checkpoint():
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    sd = {"model.embed_tokens.weight":
+          np.zeros((256, 64), np.float32)}  # everything else missing
+    with pytest.raises(ValueError, match="did not cover"):
+        load_hf_llama(model, sd)
+    # non-strict accepts the partial load
+    load_hf_llama(model, sd, strict=False)

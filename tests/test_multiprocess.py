@@ -42,3 +42,54 @@ def test_single_process_semantics_unchanged():
     x = jnp.arange(float(n)).reshape(n, 1)
     r = coll.all_reduce(x, group=g)
     assert r.shape == (n, 1)
+
+
+def _expected_pp2_loss():
+    """Same config as mp_driver._pipeline_worker, single-process 2-dev mesh."""
+    import numpy as np
+
+    import jax
+    import paddle_tpu
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import fleet
+    from paddle_tpu.parallel.pipeline import make_pipeline_train_step
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                        "sharding_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 2
+    fleet.init(is_collective=True, strategy=s, devices=jax.devices()[:2])
+    try:
+        paddle_tpu.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        step_fn, init_fn = make_pipeline_train_step(
+            model, AdamW(learning_rate=1e-3), strategy=s)
+        state, opt_state = init_fn()
+        ids = np.random.RandomState(0).randint(0, 256, (2, 17))
+        batch = {"input": ids[:, :-1], "labels": ids[:, 1:]}
+        _, _, loss = step_fn(state, opt_state, batch)
+        return float(loss)
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_pipeline_across_two_processes():
+    """The 1F1B pipeline train step as ONE multi-controller SPMD program
+    over a mesh spanning two OS processes (stage per process) must
+    reproduce the single-process loss exactly — the cross-host pipeline
+    story (reference: PipelineParallel over NCCL p2p across hosts)."""
+    expected = _expected_pp2_loss()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE)] + env.get("PYTHONPATH", "").split(os.pathsep))
+    res = subprocess.run([sys.executable, DRIVER, "pipeline", str(expected)],
+                         capture_output=True, text=True, env=env, timeout=900)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert out.count("PIPELINE_MP_OK") == 2, out

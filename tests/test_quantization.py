@@ -86,3 +86,19 @@ def test_quantized_tp_pspec_carries_over():
     quantize_model(col)
     assert col._parameters["weight_q"].pspec == orig_pspec
     assert col._parameters["weight_scale"].pspec[0] == orig_pspec[-1]
+
+
+def test_generate_default_state_binds_quant_weights():
+    """generate() without state= must bind int8 weights (not bake them
+    into the program as constants via trainable_state)."""
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    quantize_model(m)
+    from paddle_tpu.inference import _inference_state
+    st = _inference_state(m)
+    assert any(k.endswith("weight_q") for k in st)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (1, 4)))
+    from paddle_tpu.inference import generate
+    out = generate(m, ids, max_new_tokens=3, temperature=0.0,
+                   cache_dtype=jnp.float32)
+    assert out.shape == (1, 7)
