@@ -157,6 +157,9 @@ _sys.modules[__name__ + ".distributed"] = distributed
 from paddle_tpu import linalg  # noqa: F401
 from paddle_tpu import fft  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import audio  # noqa: F401
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401

@@ -1,0 +1,16 @@
+"""paddle.audio parity — spectral feature layers over jnp.fft.
+
+Reference: python/paddle/audio/{functional,features} — get_window,
+mel/fbank/dct math and the Spectrogram/MelSpectrogram/LogMelSpectrogram/
+MFCC layers. Everything lowers to XLA (rfft + matmuls) — TPU-friendly
+static shapes throughout.
+"""
+
+from paddle_tpu.audio import features  # noqa: F401
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.features import (  # noqa: F401
+    LogMelSpectrogram,
+    MelSpectrogram,
+    MFCC,
+    Spectrogram,
+)

@@ -1,0 +1,130 @@
+"""paddle.audio.functional parity (window/mel/dct math)."""
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    # slaney scale (librosa/paddle default)
+    freq = np.asarray(freq, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    safe = np.maximum(freq, 1e-10)  # avoid log(0) in the unused branch
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(safe / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    mel = np.asarray(mel, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def get_window(window, win_length, fftbins=True):
+    n = win_length
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / (n if fftbins
+                                                           else n - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * np.arange(n) / (n if fftbins
+                                                             else n - 1))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif window == "blackman":
+        m = n if fftbins else n - 1
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * np.arange(n) / m)
+             + 0.08 * np.cos(4 * np.pi * np.arange(n) / m))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return jnp.asarray(w, jnp.float32)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """(n_mels, n_fft//2 + 1) triangular mel filter bank."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0.0, sr / 2.0, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return jnp.asarray(fb, jnp.float32)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """(n_mels, n_mfcc) DCT-II basis (reference create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)
+    basis = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2.0)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return jnp.asarray(basis, jnp.float32)
+
+
+def frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    """(..., T) → (..., n_frames, frame_length) overlapping frames."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(frame_length // 2,
+                                          frame_length // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return jnp.take(x, idx, axis=-1)
+
+
+def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+         center=True, pad_mode="reflect"):
+    """(..., T) → complex (..., n_fft//2+1, n_frames)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = get_window(window, win_length)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    frames = frame(x, n_fft, hop_length, center, pad_mode)
+    spec = jnp.fft.rfft(frames * w, axis=-1)
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def spectrogram(x, n_fft=512, hop_length=None, win_length=None,
+                window="hann", power=2.0, center=True, pad_mode="reflect"):
+    s = jnp.abs(stft(x, n_fft, hop_length, win_length, window, center,
+                     pad_mode))
+    return s if power == 1.0 else jnp.power(s, power)
+
+
+def power_to_db(s, ref_value=1.0, amin=1e-10, top_db=80.0):
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec

@@ -23,7 +23,7 @@ def _inference_state(model):
     """ALL named parameters, not just trainable ones — a quantized model's
     int8 weights are trainable=False and must still be bound (otherwise
     jit bakes them into the program as constants)."""
-    return {n: p.value for n, p in model.named_parameters()}
+    return model.state_dict(include_buffers=False)
 
 
 def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):

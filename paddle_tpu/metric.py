@@ -1,0 +1,144 @@
+"""paddle.metric parity — streaming evaluation metrics.
+
+Reference: python/paddle/metric/metrics.py (Metric base, Accuracy,
+Precision, Recall, Auc) — host-side accumulators updated per batch.
+"""
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return type(self).__name__.lower()
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. update(pred (N, C) scores, label (N,) or (N, 1))."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk), np.int64)
+        self.total = 0
+
+    def update(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(-1)
+        maxk = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[:, :maxk]
+        match = top == label[:, None]
+        for i, k in enumerate(self.topk):
+            self.correct[i] += int(match[:, :k].any(axis=1).sum())
+        self.total += label.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        acc = self.correct / max(self.total, 1)
+        return float(acc[0]) if len(self.topk) == 1 else [float(a) for a in acc]
+
+    def name(self):
+        return self._name or "acc"
+
+
+class Precision(Metric):
+    """Binary precision. update(pred (N,) probabilities, label (N,) {0,1})."""
+
+    def __init__(self, name=None):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, pred, label):
+        pred = (np.asarray(pred).reshape(-1) > 0.5)
+        label = np.asarray(label).reshape(-1).astype(bool)
+        self.tp += int((pred & label).sum())
+        self.fp += int((pred & ~label).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp / denom) if denom else 0.0
+
+    def name(self):
+        return self._name or "precision"
+
+
+class Recall(Metric):
+    """Binary recall. update(pred (N,) probabilities, label (N,) {0,1})."""
+
+    def __init__(self, name=None):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, pred, label):
+        pred = (np.asarray(pred).reshape(-1) > 0.5)
+        label = np.asarray(label).reshape(-1).astype(bool)
+        self.tp += int((pred & label).sum())
+        self.fn += int((~pred & label).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp / denom) if denom else 0.0
+
+    def name(self):
+        return self._name or "recall"
+
+
+class Auc(Metric):
+    """ROC-AUC via threshold buckets (reference Auc num_thresholds)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        n = self.num_thresholds + 1
+        self._pos = np.zeros(n, np.int64)
+        self._neg = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:  # (N, 2) class probabilities → P(class 1)
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[labels > 0], 1)
+        np.add.at(self._neg, idx[labels <= 0], 1)
+
+    def accumulate(self):
+        # sweep thresholds high→low accumulating TP/FP; prepend the (0,0)
+        # origin so the area before the first bucket counts (all-saturated
+        # predictions otherwise integrate to 0 instead of 0.5)
+        tp = np.concatenate([[0], np.cumsum(self._pos[::-1])])
+        fp = np.concatenate([[0], np.cumsum(self._neg[::-1])])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name or "auc"

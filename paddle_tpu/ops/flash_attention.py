@@ -111,9 +111,6 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
 
 # ---- Pallas kernels (internal layout (b, h, s, d)) -------------------------
 
-_BLK = 512
-
-
 def _pick_blk(s):
     """Largest block in (512, 256, 128) dividing s — lets the kernels
     cover any s % 128 == 0, not just 512-multiples."""

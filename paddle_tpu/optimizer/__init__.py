@@ -42,12 +42,34 @@ class Optimizer:
                  grad_clip=None, multi_precision=True, apply_decay_param_fun=None):
         self._lr = learning_rate
         self._parameters = list(parameters) if parameters is not None else None
+        from paddle_tpu import regularizer as _reg
+        self._decay_l1 = isinstance(weight_decay, _reg.L1Decay)
+        if self._decay_l1 and getattr(self, "_decoupled_wd", False):
+            raise ValueError(
+                f"{type(self).__name__} applies decoupled (AdamW-style) L2 "
+                "decay; L1Decay is only meaningful with coupled-decay "
+                "optimizers (SGD/Momentum/Adam/...)")
+        if isinstance(weight_decay, (_reg.L1Decay, _reg.L2Decay)):
+            weight_decay = weight_decay.coeff
         self.weight_decay = weight_decay if weight_decay is not None else 0.0
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
         self.apply_decay_param_fun = apply_decay_param_fun
         self._step_count = 0
         self._eager_state = None
+
+    def _decay_grads(self, grads, params):
+        """Add the decay term to grads: L2 (default) or L1 when the
+        weight_decay was a paddle_tpu.regularizer.L1Decay. Honors
+        apply_decay_param_fun (params excluded there get no decay)."""
+        if not self.weight_decay:
+            return grads
+        wd = self.weight_decay
+        mask = self._decay_mask(params)
+        term = (lambda p: wd * jnp.sign(p)) if self._decay_l1 \
+            else (lambda p: wd * p)
+        return {k: g + term(params[k]) if mask[k] else g
+                for k, g in grads.items()}
 
     # -- lr ------------------------------------------------------------------
 
@@ -106,7 +128,7 @@ class Optimizer:
 
     def _decay_mask(self, params):
         if self.apply_decay_param_fun is None:
-            return _tree_map(lambda _: True, params)
+            return {k: True for k in params}
         return {k: bool(self.apply_decay_param_fun(k)) for k in params}
 
     # -- eager veneer --------------------------------------------------------
@@ -157,8 +179,7 @@ class SGD(Optimizer):
         return {}
 
     def _apply(self, grads, params, state, lr, step):
-        if self.weight_decay:
-            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        grads = self._decay_grads(grads, params)
         new = _tree_map(lambda p, g: p - lr * g, params, grads)
         return new, {}
 
@@ -176,8 +197,7 @@ class Momentum(Optimizer):
         return {"velocity": _tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)}
 
     def _apply(self, grads, params, state, lr, step):
-        if self.weight_decay:
-            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        grads = self._decay_grads(grads, params)
         vel = _tree_map(lambda v, g: self.momentum * v + g, state["velocity"], grads)
         if self.use_nesterov:
             new = _tree_map(lambda p, v, g: p - lr * (g + self.momentum * v),
@@ -209,8 +229,8 @@ class Adam(Optimizer):
         bias2 = 1.0 - b2 ** t
         wd = self.weight_decay
 
-        if not self._decoupled_wd and wd:
-            grads = _tree_map(lambda g, p: g + wd * p, grads, params)
+        if not self._decoupled_wd:
+            grads = self._decay_grads(grads, params)
 
         m1 = _tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["moment1"], grads)
         m2 = _tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
@@ -290,9 +310,7 @@ class Adagrad(Optimizer):
                                jnp.float32), params)}
 
     def _apply(self, grads, params, state, lr, step):
-        if self.weight_decay:
-            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
-                              params)
+        grads = self._decay_grads(grads, params)
         mom = _tree_map(lambda m, g: m + jnp.square(g), state["moment"], grads)
         new = _tree_map(lambda p, m, g: p - lr * g / (jnp.sqrt(m) + self.epsilon),
                         params, mom, grads)
@@ -318,9 +336,7 @@ class RMSProp(Optimizer):
 
     def _apply(self, grads, params, state, lr, step):
         rho, eps = self.rho, self.epsilon
-        if self.weight_decay:
-            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
-                              params)
+        grads = self._decay_grads(grads, params)
         ms = _tree_map(lambda m, g: rho * m + (1 - rho) * jnp.square(g),
                        state["mean_square"], grads)
         slots = {"mean_square": ms}
@@ -354,9 +370,7 @@ class Adadelta(Optimizer):
 
     def _apply(self, grads, params, state, lr, step):
         rho, eps = self.rho, self.epsilon
-        if self.weight_decay:
-            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads,
-                              params)
+        grads = self._decay_grads(grads, params)
         asg = _tree_map(lambda a, g: rho * a + (1 - rho) * jnp.square(g),
                         state["avg_sq_grad"], grads)
         upd = _tree_map(

@@ -1,0 +1,20 @@
+"""paddle.regularizer parity — weight-decay policy objects.
+
+Reference: python/paddle/regularizer.py; optimizers accept
+`weight_decay=L2Decay(1e-4)` (or a bare float meaning L2)."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

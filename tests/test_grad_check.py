@@ -201,3 +201,94 @@ def test_clip_interior_only():
     """clip grad is checked only at points strictly inside/outside bounds."""
     x = np.asarray([[-0.9, -0.2], [0.2, 0.9]])
     check_grads_fd(lambda v: pt.clip(v, -0.5, 0.5), [x])
+
+
+# ---- round-2 breadth additions ---------------------------------------------
+
+R2 = _rng(43)
+
+OPS_EXTRA = [
+    ("selu", F.selu, [away_from(R2, (3, 4))]),
+    ("celu", F.celu, [away_from(R2, (3, 4))]),
+    ("softshrink", F.softshrink, [away_from(R2, (3, 4), 0.5, 0.2) * 2.0]),
+    ("hardshrink", F.hardshrink, [away_from(R2, (3, 4), 0.5, 0.2) * 2.0]),
+    ("tanhshrink", F.tanhshrink, [R2.standard_normal((3, 4))]),
+    ("softsign", F.softsign, [R2.standard_normal((3, 4))]),
+    ("thresholded_relu", F.thresholded_relu,
+     [away_from(R2, (3, 4), 1.0, 0.2) * 2.0]),
+    ("prelu", lambda x, w: F.prelu(x, w),
+     [away_from(R2, (3, 4)), np.float32([0.25, 0.1, 0.3, 0.2])]),
+    ("smooth_l1", F.smooth_l1_loss,
+     [R2.standard_normal((3, 4)), R2.standard_normal((3, 4)) + 3.0]),
+    ("huber", F.huber_loss,
+     [R2.standard_normal((3, 4)), R2.standard_normal((3, 4)) + 3.0]),
+    # labels precomputed OUTSIDE the closures — sampling inside would make
+    # the function non-deterministic and break finite differences
+    ("soft_margin", lambda x, _lbl=jnp.asarray(np.sign(
+        _rng(7).standard_normal((3, 4))).astype(np.float64)):
+        F.soft_margin_loss(x, _lbl),
+     [R2.standard_normal((3, 4))]),
+    ("multi_label_soft_margin", lambda x, _lbl=jnp.asarray(
+        (_rng(8).uniform(size=(3, 4)) > 0.5).astype(np.float64)):
+        F.multi_label_soft_margin_loss(x, _lbl),
+     [R2.standard_normal((3, 4))]),
+    ("poisson_nll", lambda x, _lbl=jnp.asarray(
+        np.abs(_rng(9).standard_normal((3, 4)))):
+        F.poisson_nll_loss(x, _lbl),
+     [R2.standard_normal((3, 4)) * 0.5]),
+    ("binary_cross_entropy", lambda p, _lbl=jnp.asarray(
+        (_rng(10).uniform(size=(3, 4)) > 0.5).astype(np.float64)):
+        F.binary_cross_entropy(p, _lbl),
+     [R2.uniform(0.1, 0.9, (3, 4))]),
+    ("triplet", F.triplet_margin_loss,
+     [R2.standard_normal((2, 5)), R2.standard_normal((2, 5)) + 2.0,
+      R2.standard_normal((2, 5)) - 2.0]),
+    ("cosine_embedding", lambda a, b: F.cosine_embedding_loss(
+        a, b, jnp.asarray([1.0, -1.0])),
+     [R2.standard_normal((2, 5)) + 0.3, R2.standard_normal((2, 5)) + 0.3]),
+    ("instance_norm", lambda x, w, b: F.instance_norm(x, w, b),
+     [R2.standard_normal((2, 3, 4, 4)), 1.0 + 0.1 * R2.standard_normal(3),
+      0.1 * R2.standard_normal(3)]),
+    ("local_response_norm", lambda x: F.local_response_norm(x, 3),
+     [R2.standard_normal((1, 4, 3, 3))]),
+    ("conv3d", F.conv3d,
+     [R2.standard_normal((1, 2, 4, 4, 4)),
+      R2.standard_normal((2, 2, 3, 3, 3))]),
+    ("conv3d_transpose", F.conv3d_transpose,
+     [R2.standard_normal((1, 2, 3, 3, 3)),
+      R2.standard_normal((2, 2, 3, 3, 3))]),
+    ("avg_pool1d", lambda x: F.avg_pool1d(x, 2),
+     [R2.standard_normal((1, 2, 6))]),
+    ("max_pool3d", lambda x: F.max_pool3d(x, 2),
+     [R2.standard_normal((1, 1, 4, 4, 4))]),
+    ("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+     [R2.standard_normal((1, 4, 3, 3))]),
+    ("unfold", lambda x: F.unfold(x, 2, strides=2),
+     [R2.standard_normal((1, 2, 4, 4))]),
+    ("fold", lambda c: F.fold(c, 4, 2, strides=2),
+     [R2.standard_normal((1, 8, 4))]),
+    ("logsumexp", pt.logsumexp, [R2.standard_normal((3, 4))]),
+    ("cumprod_grad", lambda x: pt.cumprod(x, dim=1),
+     [np.abs(R2.standard_normal((2, 3))) + 0.5]),
+    ("kron", pt.kron,
+     [R2.standard_normal((2, 2)), R2.standard_normal((2, 3))]),
+    ("cross", pt.cross,
+     [R2.standard_normal((2, 3)), R2.standard_normal((2, 3))]),
+    ("trace", pt.trace, [R2.standard_normal((4, 4))]),
+    ("cdist", pt.cdist,
+     [R2.standard_normal((3, 4)), R2.standard_normal((2, 4)) + 4.0]),
+    ("lerp", lambda a, b: pt.lerp(a, b, 0.3),
+     [R2.standard_normal((3, 4)), R2.standard_normal((3, 4))]),
+    ("erf", pt.erf, [R2.standard_normal((3, 4))]),
+    ("expm1", pt.expm1, [R2.standard_normal((3, 4))]),
+    ("atanh", pt.atanh, [R2.uniform(-0.8, 0.8, (3, 4))]),
+    ("stft_window_grad", lambda x: jnp.abs(jnp.fft.rfft(x)).sum(),
+     [R2.standard_normal(16)]),
+]
+
+
+@pytest.mark.parametrize("name,fn,args",
+                         [(n, f, a) for n, f, a in OPS_EXTRA],
+                         ids=[o[0] for o in OPS_EXTRA])
+def test_numeric_grad_extra(name, fn, args):
+    check_grads_fd(fn, args)

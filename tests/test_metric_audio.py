@@ -1,0 +1,150 @@
+"""paddle.metric / paddle.regularizer / paddle.audio parity tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import audio
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+# ---- metric ----------------------------------------------------------------
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.asarray([[0.1, 0.9, 0.0],
+                       [0.8, 0.1, 0.1],
+                       [0.3, 0.3, 0.4]])
+    label = np.asarray([1, 1, 2])
+    m.update(pred, label)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6
+    assert abs(top2 - 3 / 3) < 1e-6
+    m.reset()
+    assert m.accumulate() == [0.0, 0.0]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    pred = np.asarray([0.9, 0.8, 0.2, 0.7])
+    label = np.asarray([1, 0, 1, 1])
+    p.update(pred, label)
+    r.update(pred, label)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6   # tp=2, fp=1
+    assert abs(r.accumulate() - 2 / 3) < 1e-6   # tp=2, fn=1
+
+
+def test_auc_matches_sklearn_style():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 2000)
+    # informative scores: separable-ish
+    preds = np.clip(labels * 0.3 + rng.uniform(0, 0.7, 2000), 0, 1)
+    auc = Auc()
+    auc.update(preds, labels)
+    got = auc.accumulate()
+    # exact AUC by rank statistic
+    pos = preds[labels == 1]
+    neg = preds[labels == 0]
+    exact = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert abs(got - exact) < 5e-3, (got, exact)
+
+
+# ---- regularizer -----------------------------------------------------------
+
+def test_regularizer_objects():
+    p = {"w": jnp.asarray([1.0, -2.0])}
+    g = {"w": jnp.zeros(2)}
+    for opt, expect in [
+        (SGD(learning_rate=1.0, weight_decay=L2Decay(0.1)), [0.9, -1.8]),
+        (SGD(learning_rate=1.0, weight_decay=L1Decay(0.1)), [0.9, -1.9]),
+        (SGD(learning_rate=1.0, weight_decay=0.1), [0.9, -1.8]),
+    ]:
+        st = opt.init_state(p)
+        new, _ = opt.update(g, st, p)
+        np.testing.assert_allclose(np.asarray(new["w"]), expect, rtol=1e-6)
+
+
+# ---- audio -----------------------------------------------------------------
+
+def test_stft_matches_numpy_reference():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal(1024).astype(np.float32)
+    n_fft, hop = 256, 64
+    got = np.asarray(AF.stft(jnp.asarray(x), n_fft=n_fft, hop_length=hop,
+                             window="hann", center=False))
+    # manual reference
+    w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+    n_frames = 1 + (1024 - n_fft) // hop
+    ref = np.stack([np.fft.rfft(x[i * hop:i * hop + n_fft] * w)
+                    for i in range(n_frames)], axis=-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_spectrogram_layer_shapes_and_center():
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)
+    x = jnp.asarray(np.random.RandomState(1).standard_normal(
+        (2, 2048)).astype(np.float32))
+    s = spec(x)
+    assert s.shape[0] == 2 and s.shape[1] == 129  # n_fft//2+1
+    assert np.asarray(s).min() >= 0.0
+
+
+def test_mel_mfcc_pipeline():
+    x = jnp.asarray(np.random.RandomState(2).standard_normal(
+        (1, 4096)).astype(np.float32))
+    mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+    ms = mel(x)
+    assert ms.shape[1] == 40
+    logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40,
+                                     top_db=80.0)
+    lm = logmel(x)
+    assert np.isfinite(np.asarray(lm)).all()
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+    mc = mfcc(x)
+    assert mc.shape[1] == 13
+
+
+def test_mel_scale_roundtrip():
+    f = np.asarray([100.0, 440.0, 4000.0])
+    np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(f)), f, rtol=1e-6)
+    np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(f, htk=True),
+                                            htk=True), f, rtol=1e-6)
+
+
+def test_fbank_properties():
+    fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=26))
+    assert fb.shape == (26, 257)
+    assert (fb >= 0).all()
+    # every filter has support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_adamw_rejects_l1_decay():
+    from paddle_tpu.optimizer import AdamW
+    with pytest.raises(ValueError, match="decoupled"):
+        AdamW(learning_rate=1e-3, weight_decay=L1Decay(0.1))
+
+
+def test_coupled_decay_honors_param_fun():
+    from paddle_tpu.optimizer import Adam
+    opt = Adam(learning_rate=0.0, weight_decay=0.5,
+               apply_decay_param_fun=lambda n: "bias" not in n)
+    p = {"w": jnp.asarray([2.0]), "bias": jnp.asarray([2.0])}
+    g = {"w": jnp.zeros(1), "bias": jnp.zeros(1)}
+    st = opt.init_state(p)
+    # lr=0 → params unchanged; but the moment update reveals decayed grads
+    _, new_st = opt.update(g, st, p)
+    assert float(new_st["moment1"]["w"][0]) != 0.0     # decay applied
+    assert float(new_st["moment1"]["bias"][0]) == 0.0  # excluded
+
+
+def test_auc_saturated_predictions():
+    auc = Auc()
+    auc.update(np.ones(10), np.asarray([0, 1] * 5))
+    assert abs(auc.accumulate() - 0.5) < 1e-6
