@@ -160,6 +160,8 @@ from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import audio  # noqa: F401
+from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401

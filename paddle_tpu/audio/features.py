@@ -1,9 +1,19 @@
 """paddle.audio.features parity — feature-extraction Layers."""
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.audio import functional as AF
 from paddle_tpu.nn.layer import Layer
+
+
+def _resolve_dtype(dtype):
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "dtype='float64' needs jax_enable_x64; enable it or use "
+            "'float32'")
+    return dt
 
 
 class Spectrogram(Layer):
@@ -14,9 +24,11 @@ class Spectrogram(Layer):
         self.cfg = dict(n_fft=n_fft, hop_length=hop_length,
                         win_length=win_length, window=window, power=power,
                         center=center, pad_mode=pad_mode)
+        self._dtype = _resolve_dtype(dtype)
 
     def forward(self, x):
-        return AF.spectrogram(x, **self.cfg)
+        return AF.spectrogram(x.astype(self._dtype),
+                              **self.cfg).astype(self._dtype)
 
 
 class MelSpectrogram(Layer):
@@ -26,9 +38,10 @@ class MelSpectrogram(Layer):
                  dtype="float32"):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
-                                       power, center, pad_mode)
+                                       power, center, pad_mode, dtype=dtype)
         self.register_buffer("fbank", AF.compute_fbank_matrix(
-            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+            sr, n_fft, n_mels, f_min, f_max, htk,
+            norm).astype(_resolve_dtype(dtype)))
 
     def forward(self, x):
         s = self.spectrogram(x)          # (..., n_freqs, n_frames)
@@ -43,7 +56,7 @@ class LogMelSpectrogram(Layer):
         super().__init__()
         self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
                                   power, center, pad_mode, n_mels, f_min,
-                                  f_max, htk, norm)
+                                  f_max, htk, norm, dtype=dtype)
         self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
 
     def forward(self, x):
@@ -61,8 +74,9 @@ class MFCC(Layer):
         self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
                                         window, power, center, pad_mode,
                                         n_mels, f_min, f_max, htk, norm,
-                                        ref_value, amin, top_db)
-        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
+                                        ref_value, amin, top_db, dtype=dtype)
+        self.register_buffer("dct", AF.create_dct(
+            n_mfcc, n_mels).astype(_resolve_dtype(dtype)))
 
     def forward(self, x):
         lm = self.logmel(x)              # (..., n_mels, n_frames)

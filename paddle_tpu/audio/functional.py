@@ -96,6 +96,10 @@ def frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
         x = jnp.pad(x, pad, mode=pad_mode)
     t = x.shape[-1]
     n_frames = 1 + (t - frame_length) // hop_length
+    if n_frames <= 0:
+        raise ValueError(
+            f"signal length {t} (after centering) is shorter than "
+            f"frame_length {frame_length} — no frames to extract")
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]
     return jnp.take(x, idx, axis=-1)

@@ -1,0 +1,160 @@
+"""paddle.distribution parity — core distributions over jax.random.
+
+Reference: python/paddle/distribution/ (Distribution base, Normal,
+Uniform, Categorical, Bernoulli, kl_divergence). Sampling draws keys from
+the framework RNG (`paddle_tpu.core.rng`), so `paddle.seed` governs it.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import rng as _rng
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return jnp.square(self.scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(_rng.next_rng_key("distribution"), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        var = jnp.square(self.scale)
+        return (-jnp.square(value - self.loc) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) \
+            + jnp.zeros_like(self.loc)
+
+    def kl_divergence(self, other):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_rng.next_rng_key("distribution"), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value <= self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = jnp.asarray(probs, jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = jnp.asarray(logits, jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs.shape
+        return jax.random.bernoulli(_rng.next_rng_key("distribution"),
+                                    self.probs, shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        return (value * jax.nn.log_sigmoid(self.logits)
+                + (1.0 - value) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return -(p * jnp.log(jnp.maximum(p, 1e-12))
+                 + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = jnp.asarray(logits, jnp.float32)
+        else:
+            self.logits = jnp.log(jnp.asarray(probs, jnp.float32))
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+
+    @property
+    def probs(self):
+        return jnp.exp(self._log_p)
+
+    def sample(self, shape=()):
+        return jax.random.categorical(
+            _rng.next_rng_key("distribution"), self.logits,
+            shape=tuple(shape) + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        logp = jnp.broadcast_to(self._log_p,
+                                value.shape + self._log_p.shape[-1:])
+        return jnp.take_along_axis(logp, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        return -jnp.sum(jnp.exp(self._log_p) * self._log_p, axis=-1)
+
+    def kl_divergence(self, other):
+        return jnp.sum(jnp.exp(self._log_p) * (self._log_p - other._log_p),
+                       axis=-1)
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Dispatch kl (reference paddle.distribution.kl_divergence)."""
+    if hasattr(p, "kl_divergence") and type(p) is type(q):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -264,6 +264,10 @@ class AdamW(Adam):
 
 
 class Lamb(Optimizer):
+    # LAMB's wd term enters the trust-ratio update decoupled-style (wd·p),
+    # so L1Decay objects are rejected like AdamW's
+    _decoupled_wd = True
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  multi_precision=True):

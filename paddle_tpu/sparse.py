@@ -1,0 +1,71 @@
+"""paddle.sparse parity — COO/CSR tensors over jax.experimental.sparse.
+
+Reference: python/paddle/sparse/ (sparse_coo_tensor, sparse_csr_tensor,
+to_dense/to_sparse_coo, elementwise + matmul over phi sparse kernels).
+TPU-native: jax's BCOO/BCSR lower sparse ops to XLA gather/scatter —
+fine for genuinely sparse data pipelines; dense MXU math remains the fast
+path for model weights.
+"""
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """indices: (ndim, nnz) — the reference layout."""
+    values = jnp.asarray(values, dtype)
+    idx = jnp.asarray(indices).T  # BCOO wants (nnz, ndim)
+    return jsparse.BCOO((values, idx), shape=tuple(shape)
+                        if shape is not None else None)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    values = jnp.asarray(values, dtype)
+    return jsparse.BCSR((values, jnp.asarray(cols), jnp.asarray(crows)),
+                        shape=tuple(shape))
+
+
+def to_dense(x):
+    return x.todense()
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+def to_sparse_csr(x):
+    return jsparse.BCSR.fromdense(jnp.asarray(x))
+
+
+def is_sparse_coo(x):
+    return isinstance(x, jsparse.BCOO)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, jsparse.BCSR)
+
+
+def matmul(x, y):
+    """Sparse @ dense (or dense @ dense passthrough)."""
+    return x @ y
+
+
+def add(x, y):
+    if is_sparse_coo(x) and is_sparse_coo(y):
+        return x + y
+    return to_dense(x) + (to_dense(y) if is_sparse_coo(y) else y)
+
+
+def nnz(x):
+    return x.nse
+
+
+# sparse.nn.functional analogs used by the reference's sparse conv nets are
+# dense-subsumed on TPU; relu on values keeps sparsity structure:
+def relu(x):
+    if is_sparse_coo(x):
+        return jsparse.BCOO((jnp.maximum(x.data, 0), x.indices),
+                            shape=x.shape)
+    return jnp.maximum(x, 0)

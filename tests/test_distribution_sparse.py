@@ -1,0 +1,97 @@
+"""paddle.distribution and paddle.sparse parity tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import sparse
+from paddle_tpu.distribution import (Bernoulli, Categorical, Normal,
+                                     Uniform, kl_divergence)
+
+
+def test_normal_moments_and_logprob():
+    paddle_tpu.seed(0)
+    d = Normal(1.0, 2.0)
+    s = d.sample((20000,))
+    assert abs(float(s.mean()) - 1.0) < 0.05
+    assert abs(float(s.std()) - 2.0) < 0.05
+    # log_prob matches the closed form at a point
+    lp = float(d.log_prob(jnp.asarray(1.0)))
+    assert abs(lp - (-np.log(2.0) - 0.5 * np.log(2 * np.pi))) < 1e-6
+    # entropy of N(mu, sigma) = 0.5 ln(2πe σ²)
+    assert abs(float(d.entropy()) -
+               (0.5 * np.log(2 * np.pi * np.e * 4.0))) < 1e-6
+
+
+def test_normal_kl_zero_same_dist():
+    a, b = Normal(0.5, 1.5), Normal(0.5, 1.5)
+    assert abs(float(kl_divergence(a, b))) < 1e-7
+    c = Normal(0.0, 1.0)
+    assert float(kl_divergence(a, c)) > 0
+
+
+def test_uniform():
+    paddle_tpu.seed(0)
+    d = Uniform(-1.0, 3.0)
+    s = d.sample((10000,))
+    assert float(s.min()) >= -1.0 and float(s.max()) <= 3.0
+    assert abs(float(d.entropy()) - np.log(4.0)) < 1e-6
+    assert np.isneginf(float(d.log_prob(jnp.asarray(5.0))))
+
+
+def test_bernoulli_and_categorical():
+    paddle_tpu.seed(0)
+    b = Bernoulli(probs=0.3)
+    s = b.sample((20000,))
+    assert abs(float(s.mean()) - 0.3) < 0.02
+    assert abs(float(b.log_prob(jnp.asarray(1.0))) - np.log(0.3)) < 1e-5
+
+    c = Categorical(probs=jnp.asarray([0.2, 0.5, 0.3]))
+    cs = np.asarray(c.sample((20000,)))
+    freq = np.bincount(cs, minlength=3) / cs.size
+    np.testing.assert_allclose(freq, [0.2, 0.5, 0.3], atol=0.02)
+    np.testing.assert_allclose(
+        np.asarray(c.log_prob(jnp.asarray([0, 1, 2]))),
+        np.log([0.2, 0.5, 0.3]), rtol=1e-5)
+    # KL(c, uniform) = log(3) - H(c)
+    u = Categorical(probs=jnp.ones(3) / 3)
+    np.testing.assert_allclose(float(kl_divergence(c, u)),
+                               np.log(3) - float(c.entropy()), rtol=1e-5)
+
+    with pytest.raises(ValueError):
+        Bernoulli()
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Normal(0, 1), Uniform(0, 1))
+
+
+def test_sparse_coo_roundtrip_and_matmul():
+    dense = np.zeros((3, 4), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 3] = -1.0
+    co = sparse.sparse_coo_tensor([[0, 2], [1, 3]], [2.0, -1.0], (3, 4))
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(co)), dense)
+    assert sparse.is_sparse_coo(co)
+    assert sparse.nnz(co) == 2
+
+    back = sparse.to_sparse_coo(jnp.asarray(dense))
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(back)), dense)
+
+    y = np.random.RandomState(0).standard_normal((4, 5)).astype(np.float32)
+    got = sparse.matmul(co, jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(got), dense @ y, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sparse_csr_and_relu():
+    dense = np.asarray([[0, 1.5], [-2.0, 0]], np.float32)
+    cs = sparse.to_sparse_csr(jnp.asarray(dense))
+    assert sparse.is_sparse_csr(cs)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(cs)), dense)
+    co = sparse.to_sparse_coo(jnp.asarray(dense))
+    r = sparse.relu(co)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(r)),
+                               np.maximum(dense, 0))
+    s = sparse.add(co, co)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), 2 * dense)

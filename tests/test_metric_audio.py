@@ -148,3 +148,21 @@ def test_auc_saturated_predictions():
     auc = Auc()
     auc.update(np.ones(10), np.asarray([0, 1] * 5))
     assert abs(auc.accumulate() - 0.5) < 1e-6
+
+
+def test_lamb_rejects_l1_decay():
+    from paddle_tpu.optimizer import Lamb
+    with pytest.raises(ValueError, match="decoupled"):
+        Lamb(learning_rate=1e-3, lamb_weight_decay=L1Decay(0.1))
+
+
+def test_audio_short_input_raises():
+    with pytest.raises(ValueError, match="shorter than"):
+        audio.Spectrogram(n_fft=512, center=False)(jnp.ones((1, 256)))
+
+
+def test_audio_dtype_honored_and_guarded():
+    with pytest.raises(ValueError, match="x64"):
+        audio.MFCC(dtype="float64")
+    m = audio.MelSpectrogram(sr=16000, n_fft=256, n_mels=8, dtype="float32")
+    assert m(jnp.ones((1, 1024))).dtype == jnp.float32
