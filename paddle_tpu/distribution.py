@@ -14,10 +14,20 @@ from paddle_tpu.core import rng as _rng
 
 
 class Distribution:
+    # subclasses with a pathwise (reparameterized) sampler set this
+    _has_rsample = False
+
     def sample(self, shape=()):
         raise NotImplementedError
 
     def rsample(self, shape=()):
+        """Reparameterized sample — only for distributions with a pathwise
+        gradient (Normal, Uniform). Discrete distributions raise instead
+        of silently returning zero gradients."""
+        if not self._has_rsample:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no reparameterized sampler; "
+                "use sample() + a score-function estimator (log_prob)")
         return self.sample(shape)
 
     def log_prob(self, value):
@@ -31,6 +41,8 @@ class Distribution:
 
 
 class Normal(Distribution):
+    _has_rsample = True
+
     def __init__(self, loc, scale, name=None):
         self.loc = jnp.asarray(loc, jnp.float32)
         self.scale = jnp.asarray(scale, jnp.float32)
@@ -65,6 +77,8 @@ class Normal(Distribution):
 
 
 class Uniform(Distribution):
+    _has_rsample = True
+
     def __init__(self, low, high, name=None):
         self.low = jnp.asarray(low, jnp.float32)
         self.high = jnp.asarray(high, jnp.float32)
@@ -140,8 +154,9 @@ class Categorical(Distribution):
 
     def log_prob(self, value):
         value = jnp.asarray(value, jnp.int32)
-        logp = jnp.broadcast_to(self._log_p,
-                                value.shape + self._log_p.shape[-1:])
+        batch = jnp.broadcast_shapes(value.shape, self._log_p.shape[:-1])
+        logp = jnp.broadcast_to(self._log_p, batch + self._log_p.shape[-1:])
+        value = jnp.broadcast_to(value, batch)
         return jnp.take_along_axis(logp, value[..., None], axis=-1)[..., 0]
 
     def entropy(self):

@@ -13,11 +13,14 @@ from jax.experimental import sparse as jsparse
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       stop_gradient=True):
-    """indices: (ndim, nnz) — the reference layout."""
+    """indices: (ndim, nnz) — the reference layout. With shape=None the
+    shape is inferred from the largest index per dimension (paddle
+    semantics)."""
     values = jnp.asarray(values, dtype)
     idx = jnp.asarray(indices).T  # BCOO wants (nnz, ndim)
-    return jsparse.BCOO((values, idx), shape=tuple(shape)
-                        if shape is not None else None)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=0))
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
@@ -52,10 +55,17 @@ def matmul(x, y):
     return x @ y
 
 
+def _densify(x):
+    return to_dense(x) if is_sparse_coo(x) or is_sparse_csr(x) else \
+        jnp.asarray(x)
+
+
 def add(x, y):
     if is_sparse_coo(x) and is_sparse_coo(y):
         return x + y
-    return to_dense(x) + (to_dense(y) if is_sparse_coo(y) else y)
+    if is_sparse_csr(x) and is_sparse_csr(y):
+        return to_sparse_csr(_densify(x) + _densify(y))  # stays CSR
+    return _densify(x) + _densify(y)
 
 
 def nnz(x):
@@ -67,5 +77,8 @@ def nnz(x):
 def relu(x):
     if is_sparse_coo(x):
         return jsparse.BCOO((jnp.maximum(x.data, 0), x.indices),
+                            shape=x.shape)
+    if is_sparse_csr(x):
+        return jsparse.BCSR((jnp.maximum(x.data, 0), x.indices, x.indptr),
                             shape=x.shape)
     return jnp.maximum(x, 0)

@@ -95,3 +95,42 @@ def test_sparse_csr_and_relu():
                                np.maximum(dense, 0))
     s = sparse.add(co, co)
     np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), 2 * dense)
+
+
+# ---- round-2 review regressions --------------------------------------------
+
+def test_rsample_semantics():
+    import jax
+    n = Normal(0.0, 1.0)
+    paddle_tpu.seed(0)
+    assert n.rsample((3,)).shape == (3,)
+    with pytest.raises(NotImplementedError, match="reparameterized"):
+        Bernoulli(probs=0.5).rsample((3,))
+    with pytest.raises(NotImplementedError):
+        Categorical(probs=jnp.ones(3) / 3).rsample((3,))
+
+
+def test_categorical_batched_logprob_broadcast():
+    c = Categorical(logits=jnp.zeros((4, 3)))
+    lp = c.log_prob(jnp.asarray(1))      # scalar value vs (4,) batch
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(np.asarray(lp), np.log([1 / 3] * 4),
+                               rtol=1e-6)
+
+
+def test_sparse_shape_inference_and_mixed_add():
+    co = sparse.sparse_coo_tensor([[0, 2], [1, 3]], [2.0, -1.0])
+    assert co.shape == (3, 4)
+    dense = np.zeros((3, 4), np.float32)
+    dense[0, 1], dense[2, 3] = 2.0, -1.0
+    # dense-first add works; csr+csr stays sparse; bcsr relu works
+    out = sparse.add(jnp.asarray(dense), co)
+    np.testing.assert_allclose(np.asarray(out), 2 * dense)
+    cs = sparse.to_sparse_csr(jnp.asarray(dense))
+    s2 = sparse.add(cs, cs)
+    assert sparse.is_sparse_csr(s2)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s2)), 2 * dense)
+    r = sparse.relu(cs)
+    assert sparse.is_sparse_csr(r)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(r)),
+                               np.maximum(dense, 0))
