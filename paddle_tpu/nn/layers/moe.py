@@ -42,6 +42,36 @@ def _ep_spec(ep_axes, ndim, extra=None):
     return P(*dims)
 
 
+def _swiglu(xe, wg, wu, wd):
+    """(E, C, h) grouped SwiGLU — the one expert-FFN math, shared by every
+    dispatch path."""
+    h1 = jnp.einsum("ech,ehf->ecf", xe, wg)
+    h2 = jnp.einsum("ech,ehf->ecf", xe, wu)
+    return jnp.einsum("ecf,efh->ech", F.silu(h1) * h2, wd)
+
+
+def _slot_scatter(xt, idx, pos, keep, cap, e):
+    """Tokens → flat (e·cap, h) expert buffer; dropped tokens get an OOB
+    slot the scatter drops. Returns (buffer, slot ids)."""
+    t, k = idx.shape
+    h = xt.shape[-1]
+    slot = jnp.where(keep, idx * cap + pos, e * cap).reshape(-1)
+    xt_k = jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
+    buf = jnp.zeros((e * cap, h), xt.dtype).at[slot].set(
+        xt_k, mode="drop", unique_indices=True)
+    return buf, slot
+
+
+def _slot_combine(ye_flat, slot, vals, keep, dtype):
+    """Gather expert outputs back by slot and mix with gate weights."""
+    t, k = vals.shape
+    h = ye_flat.shape[-1]
+    gathered = jnp.take(ye_flat, slot, axis=0, mode="fill",
+                        fill_value=0).reshape(t, k, h)
+    w = (vals * keep).astype(dtype)
+    return jnp.einsum("tk,tkh->th", w, gathered)
+
+
 def topk_routing(logits, k: int, capacity: int, normalize_topk: bool = True):
     """GShard-style top-k routing with static capacity — compact form.
 
@@ -177,9 +207,7 @@ class GroupedSwiGLUExperts(Layer):
         spec = lambda nd: _ep_spec(self.ep_axes, nd)
         for a in self.ep_axes:
             xe = constrain(xe, spec, a)     # all_to_all into expert shards
-        h1 = jnp.einsum("ech,ehf->ecf", xe, self.w_gate)
-        h2 = jnp.einsum("ech,ehf->ecf", xe, self.w_up)
-        y = jnp.einsum("ecf,efh->ech", F.silu(h1) * h2, self.w_down)
+        y = _swiglu(xe, self.w_gate, self.w_up, self.w_down)
         for a in self.ep_axes:
             y = constrain(y, spec, a)
         return y
@@ -214,7 +242,7 @@ class MoELayer(Layer):
         gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate]
         if gate == "switch" and top_k not in (None, 1):
             raise ValueError(f"gate='switch' is top-1 routing; got top_k={top_k}")
-        if dispatch_mode not in ("scatter", "einsum"):
+        if dispatch_mode not in ("scatter", "einsum", "alltoall"):
             raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
         self.gate = gate_cls(hidden_size, num_experts,
                              capacity_factor=capacity_factor)
@@ -237,19 +265,9 @@ class MoELayer(Layer):
         SURVEY.md §2.6-EP)."""
         e = self.num_experts
         idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)
-        t, k = idx.shape
-        h = xt.shape[-1]
-        # destination slot in the (E·C) expert buffer; dropped → OOB, which
-        # scatter/gather treat as no-op / zero-fill
-        slot = jnp.where(keep, idx * cap + pos, e * cap).reshape(-1)
-        xt_k = jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
-        xe = jnp.zeros((e * cap, h), dtype).at[slot].set(
-            xt_k, mode="drop", unique_indices=True).reshape(e, cap, h)
-        ye = self.experts(xe).reshape(e * cap, h)
-        gathered = jnp.take(ye, slot, axis=0, mode="fill",
-                            fill_value=0).reshape(t, k, h)
-        w = (vals * keep).astype(dtype)
-        yt = jnp.einsum("tk,tkh->th", w, gathered)
+        buf, slot = _slot_scatter(xt.astype(dtype), idx, pos, keep, cap, e)
+        ye = self.experts(buf.reshape(e, cap, -1)).reshape(e * cap, -1)
+        yt = _slot_combine(ye, slot, vals, keep, dtype)
         return yt, aux, stats
 
     def _forward_einsum(self, xt, dtype):
@@ -259,6 +277,91 @@ class MoELayer(Layer):
         ye = self.experts(xe)                             # (E, C, h)
         yt = jnp.einsum("tec,ech->th", combine.astype(dtype), ye)
         return yt, aux, None
+
+    def _forward_alltoall(self, xt, dtype):
+        """Explicit lax.all_to_all dispatch over the EP axis inside a
+        shard_map — the literal global_scatter/global_gather mechanism
+        (SURVEY.md §2.6-EP, collective/global_scatter_op.cu): each device
+        routes its token shard, exchanges fixed-capacity per-destination
+        buffers with an all_to_all, runs its local experts, and reverses
+        the exchange to combine.
+
+        Requires an active hybrid mesh whose `ep_axes` product divides
+        num_experts; tokens must be shardable over that axis.
+
+        CPU-sim caveat: XLA:CPU runs one thread per simulated device with
+        a 40 s collective-rendezvous timeout; on a single-core host, long
+        uninterrupted loops over this program can starve a participant and
+        abort (rendezvous.cc "Termination timeout"). Real multi-chip
+        executions are unaffected."""
+        from jax import shard_map
+
+        from paddle_tpu.parallel.topology import (
+            get_hybrid_communicate_group)
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError(
+                "dispatch_mode='alltoall' needs fleet.init (an active "
+                "hybrid mesh); use 'scatter' for single-mesh-free runs")
+        mesh = hcg.mesh
+        ep = self.experts.ep_axes
+        if len(ep) != 1:
+            raise NotImplementedError("alltoall dispatch supports one EP axis")
+        axis = ep[0]
+        mp_axis = self.experts.mp_axis
+        if mp_axis in mesh.shape and mesh.shape[mp_axis] > 1:
+            raise NotImplementedError(
+                "dispatch_mode='alltoall' replicates expert FFNs over the "
+                f"'{mp_axis}' axis; with mp_degree > 1 use the 'scatter' "
+                "path (GSPMD shards the expert FFN contraction)")
+        pdim = mesh.shape[axis]
+        e = self.num_experts
+        if e % pdim or xt.shape[0] % pdim:
+            raise ValueError(
+                f"the '{axis}' axis size {pdim} must divide both "
+                f"num_experts {e} and the token count {xt.shape[0]}")
+        e_loc = e // pdim
+        gate_w = self.gate.proj.weight
+        wg, wu, wd = (self.experts.w_gate, self.experts.w_up,
+                      self.experts.w_down)
+        cap = self.gate.capacity(xt.shape[0] // pdim)
+        top_k = self.gate.top_k
+
+        def body(xt_loc, gate_w, wg, wu, wd):
+            # xt_loc (T_loc, h); expert weights sharded dim0 over the axis
+            h = xt_loc.shape[-1]
+            logits = jnp.matmul(xt_loc.astype(jnp.float32),
+                                gate_w.astype(jnp.float32))
+            idx, vals, pos, keep, aux, _ = topk_routing(logits, top_k, cap)
+            # slot layout groups experts by owner: dest p owns experts
+            # [p*e_loc, (p+1)*e_loc)
+            send, slot = _slot_scatter(xt_loc, idx, pos, keep, cap, e)
+            send = send.reshape(pdim, e_loc * cap, h)
+            # exchange: device q's block p  →  device p's block q
+            recv = jax.lax.all_to_all(send, axis, split_axis=0,
+                                      concat_axis=0, tiled=False)
+            # recv (pdim_src, e_loc*cap, h) → (e_loc, pdim_src*cap, h)
+            xe = recv.reshape(pdim, e_loc, cap, h).transpose(1, 0, 2, 3) \
+                .reshape(e_loc, pdim * cap, h)
+            ye = _swiglu(xe, wg, wu, wd)
+            # reverse exchange
+            back = ye.reshape(e_loc, pdim, cap, h).transpose(1, 0, 2, 3) \
+                .reshape(pdim, e_loc * cap, h)
+            got = jax.lax.all_to_all(back, axis, split_axis=0,
+                                     concat_axis=0, tiled=False)
+            yt = _slot_combine(got.reshape(e * cap, h), slot, vals, keep,
+                               xt_loc.dtype)
+            # aux is a per-shard mean over local tokens; average over shards
+            return yt, jax.lax.pmean(aux, axis)
+
+        espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
+        yt, aux = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(), espec(3), espec(3), espec(3)),
+            out_specs=(P(axis), P()),
+            check_vma=False)(xt, gate_w, wg, wu, wd)
+        return yt.astype(dtype), aux, None
 
     def _forward_dropless(self, xt, dtype):
         """Sort + ragged grouped matmul: every routed token is computed
@@ -289,6 +392,8 @@ class MoELayer(Layer):
             yt, aux, stats = self._forward_dropless(xt, x.dtype)
         elif self.dispatch_mode == "scatter":
             yt, aux, stats = self._forward_capacity(xt, x.dtype)
+        elif self.dispatch_mode == "alltoall":
+            yt, aux, stats = self._forward_alltoall(xt, x.dtype)
         else:
             yt, aux, stats = self._forward_einsum(xt, x.dtype)
         out = yt.reshape(b, s, h)
