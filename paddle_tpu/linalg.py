@@ -84,17 +84,23 @@ def lstsq(x, y, rcond=None, driver=None):
 
 
 def lu(x):
+    """LU factorization. Returns (LU, pivots) with 1-based LAPACK pivots
+    (reference convention: paddle.linalg.lu returns ipiv starting at 1)."""
     lu_mat, piv = jax.scipy.linalg.lu_factor(x)
-    return lu_mat, piv
+    return lu_mat, piv + 1
 
 
 def lu_unpack(lu_mat, piv):
-    """Unpack a 2-D lu_factor result into (P, L, U) with P @ L @ U == A."""
+    """Unpack a 2-D lu_factor result into (P, L, U) with P @ L @ U == A.
+
+    Consumes the 1-based pivots produced by :func:`lu` (LAPACK/reference
+    convention)."""
     m, n = lu_mat.shape[-2], lu_mat.shape[-1]
     k = min(m, n)
     L = jnp.tril(lu_mat[..., :k], k=-1) + jnp.eye(m, k, dtype=lu_mat.dtype)
     U = jnp.triu(lu_mat[..., :k, :])
     perm = jnp.arange(m)
+    piv = piv - 1  # back to 0-based row indices
 
     def body(i, perm):  # LAPACK ipiv: row i was swapped with row piv[i]
         j = piv[i]

@@ -34,15 +34,23 @@ class Accuracy(Metric):
         self.total = 0
 
     def update(self, pred, label):
+        """Accumulate and return the CURRENT BATCH's accuracy (reference
+        semantics: update() is batch-local, accumulate() is the running
+        value)."""
         pred = np.asarray(pred)
         label = np.asarray(label).reshape(-1)
         maxk = max(self.topk)
         top = np.argsort(-pred, axis=-1)[:, :maxk]
         match = top == label[:, None]
+        batch_correct = np.zeros(len(self.topk), np.int64)
         for i, k in enumerate(self.topk):
-            self.correct[i] += int(match[:, :k].any(axis=1).sum())
-        self.total += label.shape[0]
-        return self.accumulate()
+            batch_correct[i] = int(match[:, :k].any(axis=1).sum())
+        self.correct += batch_correct
+        n = label.shape[0]
+        self.total += n
+        batch_acc = batch_correct / max(n, 1)
+        return (float(batch_acc[0]) if len(self.topk) == 1
+                else [float(a) for a in batch_acc])
 
     def accumulate(self):
         acc = self.correct / max(self.total, 1)

@@ -613,10 +613,17 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     """Connectionist Temporal Classification (reference: warpctc kernel,
     paddle.nn.functional.ctc_loss).
 
-    log_probs: (T, B, C) log-softmax outputs; labels: (B, L) int32 padded;
-    input_lengths (B,), label_lengths (B,). Forward DP in the log semiring
-    runs as one lax.scan over time — static shapes, TPU-friendly.
+    log_probs: (T, B, C) raw logits — log_softmax is applied internally,
+    matching the reference contract (warpctc softmaxes internally).
+    Passing already-log-softmaxed inputs is also fine: log_softmax is
+    idempotent. labels: (B, L) int32 padded; input_lengths (B,),
+    label_lengths (B,). Forward DP in the log semiring runs as one
+    lax.scan over time — static shapes, TPU-friendly.
+
+    reduction='mean' divides each sequence's loss by its label length
+    before averaging (reference/torch semantics).
     """
+    log_probs = jax.nn.log_softmax(log_probs, axis=-1)
     T, B, C = log_probs.shape
     L = labels.shape[1]
     S = 2 * L + 1
@@ -669,6 +676,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     loss = -ll
     if norm_by_times:
         loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        denom = jnp.maximum(label_lengths, 1).astype(loss.dtype)
+        return jnp.mean(loss / denom)
     return _reduce_loss(loss, reduction)
 
 

@@ -220,13 +220,20 @@ class Engine:
         return self._state
 
     def sync_model(self):
-        """Copy the trained state back into the Layer tree (eager access)."""
+        """Copy the trained state back into the Layer tree (eager access).
+
+        The pipeline path trains on stage-prefixed/stacked keys that don't
+        map back onto the Layer tree — that specific (zero-overlap) mismatch
+        is skipped; a partial overlap means a genuinely broken state and
+        raises rather than half-updating the model."""
         if self._state is not None:
-            # pipeline path uses prefixed/stacked keys — skip silently there
-            try:
-                self.model.set_state_dict(self._state)
-            except Exception:
-                pass
+            model_keys = set(self.model.state_dict())
+            if model_keys & set(self._state):
+                missing, _unexpected = self.model.set_state_dict(self._state)
+                if missing:
+                    raise ValueError(
+                        "Engine.sync_model: trained state only partially "
+                        f"covers the model; missing {sorted(missing)[:8]}...")
         return self.model
 
     def save(self, path):

@@ -189,6 +189,11 @@ def all_gather(tensor_list_or_x, x=None, group=None, sync_op=True, axis=0):
 
 
 def reduce(x, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to `dst`. Implemented as all_reduce: every rank receives the
+    reduced value, a strict superset of the reference contract (which
+    defines the result only at `dst`). `dst` is accepted for API parity;
+    there is no bandwidth saving on TPU — XLA's all-reduce over ICI is the
+    primitive a rooted reduce would lower to anyway."""
     return all_reduce(x, op=op, group=group)
 
 
@@ -205,6 +210,12 @@ def broadcast(x, src=0, group=None, sync_op=True):
 
 
 def scatter(x, tensor_list=None, src=0, group=None, sync_op=True):
+    """Scatter `tensor_list` from `src`, one chunk per rank.
+
+    Multi-process note: implemented as a full broadcast of the stacked
+    list followed by a local slice — O(world) data per rank for an
+    O(1/world) result. Fine at the tensor sizes eager scatter is used for
+    (setup/debug); inside jit, GSPMD sharding is the fast path."""
     g = _get_group(group)
     if _multiprocess():
         _mp_world_only(g, "scatter")

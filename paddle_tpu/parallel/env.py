@@ -32,8 +32,8 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
             # (the CPU-simulated analog of the reference's Gloo backend,
             # SURVEY.md §2.5); harmless when the backend is TPU.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:
-            pass
+        except (AttributeError, KeyError):
+            pass  # older jax without this config knob — TPU path unaffected
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid or 0)
     _initialized[0] = True

@@ -96,9 +96,14 @@ class Fleet:
 
 
 class HybridParallelOptimizer:
-    """Wraps an optimizer; grad-clip global norm reduces across the whole mesh
-    in one XLA reduction (the reference fuses allreduces across groups by hand
-    — meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py)."""
+    """API-shape veneer over the inner optimizer — it intentionally adds NO
+    behavior. The reference class (meta_optimizers/dygraph_optimizer/
+    hybrid_parallel_optimizer.py) exists to hand-fuse the grad-clip
+    global-norm allreduces across dp/mp/pp/sharding groups; under GSPMD the
+    clip in the inner optimizer already computes the global norm in one XLA
+    reduction over the whole mesh, so there is nothing left to fuse. The
+    class survives only so `fleet.distributed_optimizer(opt)` returns the
+    reference's type shape."""
 
     def __init__(self, inner, hcg, strategy):
         self._inner = inner

@@ -42,10 +42,7 @@ def convert_hf_llama_state_dict(hf_state: Dict, dtype=None) -> Dict:
         arr = _to_np(v)
         if any(k.endswith(s) for s in _LINEAR_SUFFIXES):
             arr = arr.T
-        a = jnp.asarray(arr)
-        if dtype is not None:
-            a = a.astype(dtype)
-        out[k] = a
+        out[k] = _cast(arr, dtype)
     return out
 
 
@@ -58,9 +55,125 @@ def load_hf_llama(model, hf_state: Dict, dtype=None, strict: bool = True):
     checkpoint with no lm_head.weight) would otherwise leave random-init
     weights in place."""
     converted = convert_hf_llama_state_dict(hf_state, dtype=dtype)
+    return _strict_load(model, converted, strict)
+
+
+def _strict_load(model, converted, strict):
     missing, unexpected = model.set_state_dict(converted)
     if strict and missing:
         raise ValueError(
             f"HF checkpoint did not cover model parameters {missing}; "
             "pass strict=False to accept a partial load")
     return model.trainable_state()
+
+
+def _cast(arr, dtype):
+    a = jnp.asarray(arr)
+    return a.astype(dtype) if dtype is not None else a
+
+
+def convert_hf_gpt2_state_dict(hf_state: Dict, tie_word_embeddings=True,
+                               dtype=None) -> Dict:
+    """HF GPT2LMHeadModel state_dict → paddle_tpu GPTPretrainModel state.
+
+    HF GPT-2 stores its projections as Conv1D — (in, out) layout, the SAME
+    as our Linear — so unlike Llama, no transposes except the (out, in)
+    lm_head. Key renames: transformer.* → gpt.*, attn.c_attn → attn.qkv_proj,
+    attn.c_proj → attn.out_proj, mlp.c_fc → fc_in, mlp.c_proj → fc_out.
+    """
+    rename = (("transformer.", "gpt."),
+              ("attn.c_attn", "attn.qkv_proj"),
+              ("attn.c_proj", "attn.out_proj"),
+              ("mlp.c_fc", "fc_in"),
+              ("mlp.c_proj", "fc_out"))
+    out = {}
+    for k, v in hf_state.items():
+        # GPT-2's causal-mask buffers are `.attn.bias`/`.attn.masked_bias`;
+        # the substring rule would also eat the real `c_attn.bias`
+        if k.endswith(".attn.bias") or k.endswith(".attn.masked_bias"):
+            continue
+        if k == "lm_head.weight":
+            if tie_word_embeddings:
+                # tied to wte — our tied model unembeds with wte.T. Guard
+                # against a genuinely untied checkpoint being silently
+                # truncated to the embedding weights.
+                wte = hf_state.get("transformer.wte.weight")
+                if wte is not None and not np.array_equal(_to_np(v),
+                                                          _to_np(wte)):
+                    raise ValueError(
+                        "lm_head.weight differs from transformer.wte.weight "
+                        "but the target model is tie_word_embeddings=True — "
+                        "build the model untied to keep the trained head")
+                continue
+            out[k] = _cast(_to_np(v).T, dtype)
+            continue
+        nk = k
+        for old, new in rename:  # rename table also strips the mlp. prefix
+            nk = nk.replace(old, new)
+        out[nk] = _cast(_to_np(v), dtype)
+    return out
+
+
+def load_hf_gpt2(model, hf_state: Dict, dtype=None, strict: bool = True):
+    """Load an HF GPT2LMHeadModel state_dict into a paddle_tpu
+    GPTPretrainModel (in place); returns the new trainable state."""
+    tied = getattr(model.cfg, "tie_word_embeddings", True)
+    converted = convert_hf_gpt2_state_dict(
+        hf_state, tie_word_embeddings=tied, dtype=dtype)
+    return _strict_load(model, converted, strict)
+
+
+def convert_hf_mixtral_state_dict(hf_state: Dict, dtype=None) -> Dict:
+    """HF MixtralForCausalLM state_dict → paddle_tpu MixtralForCausalLM.
+
+    Attention/lm_head linears transpose like Llama. The sparse-MoE block
+    regroups: HF's per-expert `block_sparse_moe.experts.E.{w1,w2,w3}.weight`
+    ((out, in) each) stack into our grouped (E, in, out) tensors
+    `moe.experts.{w_gate,w_down,w_up}`, and the (E, h) router
+    `block_sparse_moe.gate.weight` transposes into `moe.gate.proj.weight`.
+    """
+    import re
+    out = {}
+    experts = {}  # (layer, expert, which) -> np array
+    exp_re = re.compile(
+        r"model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight")
+    for k, v in hf_state.items():
+        if any(s in k for s in _SKIP_SUBSTRINGS):
+            continue
+        m = exp_re.match(k)
+        if m:
+            layer, eidx, which = int(m.group(1)), int(m.group(2)), m.group(3)
+            experts[(layer, eidx, which)] = _to_np(v).T  # (in, out)
+            continue
+        arr = _to_np(v)
+        if k.endswith("block_sparse_moe.gate.weight"):
+            nk = k.replace("block_sparse_moe.gate.weight", "moe.gate.proj.weight")
+            out[nk] = _cast(arr.T, dtype)  # (E, h) → (h, E)
+            continue
+        if any(k.endswith(s) for s in _LINEAR_SUFFIXES):
+            arr = arr.T
+        out[k] = _cast(arr, dtype)
+    if experts:
+        n_layers = max(k[0] for k in experts) + 1
+        n_exp = max(k[1] for k in experts) + 1
+        names = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}
+        for layer in range(n_layers):
+            for which, ours in names.items():
+                # a sharded/partial checkpoint may miss some experts for
+                # this (layer, which) group: leave the grouped tensor out
+                # so _strict_load reports it as missing, rather than
+                # KeyError-ing mid-conversion
+                group = [(layer, e, which) for e in range(n_exp)]
+                if not all(g in experts for g in group):
+                    continue
+                stack = np.stack([experts[g] for g in group])
+                out[f"model.layers.{layer}.moe.experts.{ours}"] = _cast(
+                    stack, dtype)
+    return out
+
+
+def load_hf_mixtral(model, hf_state: Dict, dtype=None, strict: bool = True):
+    """Load an HF MixtralForCausalLM state_dict into a paddle_tpu
+    MixtralForCausalLM (in place); returns the new trainable state."""
+    converted = convert_hf_mixtral_state_dict(hf_state, dtype=dtype)
+    return _strict_load(model, converted, strict)

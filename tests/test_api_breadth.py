@@ -315,6 +315,33 @@ def test_cholesky_solve_both_triangles():
                                    atol=1e-3)
 
 
+def test_ctc_loss_mean_raw_logits_matches_torch():
+    # reference contract: raw logits in, reduction='mean' divides each
+    # sequence's loss by its label length before averaging (ADVICE r2)
+    torch = pytest.importorskip("torch")
+    T, B, C, L = 12, 3, 6, 4
+    logits = R.standard_normal((T, B, C)).astype(np.float32)
+    labels = R.randint(1, C, (B, L)).astype(np.int32)
+    input_lengths = np.asarray([12, 10, 8], np.int32)
+    label_lengths = np.asarray([4, 3, 2], np.int32)
+    ours = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                      jnp.asarray(input_lengths), jnp.asarray(label_lengths),
+                      blank=0, reduction="mean")
+    ref = torch.nn.functional.ctc_loss(
+        torch.tensor(logits).log_softmax(-1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(input_lengths.astype(np.int64)),
+        torch.tensor(label_lengths.astype(np.int64)),
+        blank=0, reduction="mean")
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_lu_pivots_one_based():
+    a = R.standard_normal((5, 5)).astype(np.float32)
+    _, piv = pl.lu(jnp.asarray(a))
+    assert int(np.asarray(piv).min()) >= 1  # LAPACK/reference convention
+
+
 def test_ctc_loss_empty_label_matches_torch():
     torch = pytest.importorskip("torch")
     T, B, C = 8, 2, 5

@@ -41,6 +41,72 @@ def test_hf_llama_logits_match():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_hf_gpt2_logits_match():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+    from paddle_tpu.utils.hf_compat import load_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=64, n_layer=2, n_head=4,
+        activation_function="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    tie_word_embeddings=True)
+    import paddle_tpu as _pt
+    _pt.seed(0)
+    model = GPTPretrainModel(cfg)
+    state = load_hf_gpt2(model, hf_model.state_dict())
+
+    ids = np.random.RandomState(1).randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    model.eval()
+    ours = np.asarray(functional_call(model, state, jnp.asarray(ids)),
+                      np.float32)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_mixtral_logits_match():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    from paddle_tpu.utils.hf_compat import load_hf_mixtral
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2,
+        tie_word_embeddings=False, sliding_window=None)
+    torch.manual_seed(0)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    # capacity_factor high enough that no token drops — HF routing is
+    # dropless, so parity requires no capacity truncation
+    cfg = MixtralConfig(vocab_size=128, hidden_size=64, intermediate_size=96,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_position_embeddings=64, rms_norm_eps=1e-5,
+                        num_experts=4, top_k=2, capacity_factor=8.0)
+    import paddle_tpu as _pt
+    _pt.seed(0)
+    model = MixtralForCausalLM(cfg)
+    state = load_hf_mixtral(model, hf_model.state_dict())
+
+    ids = np.random.RandomState(2).randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    model.eval()
+    logits, _aux = functional_call(model, state, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(logits, np.float32), ref,
+                               rtol=3e-4, atol=3e-4)
+
+
 def test_convert_transposes_only_linears():
     w_lin = np.arange(12, dtype=np.float32).reshape(3, 4)  # (out=3, in=4)
     w_emb = np.arange(8, dtype=np.float32).reshape(4, 2)

@@ -29,6 +29,16 @@ def test_accuracy_topk():
     assert m.accumulate() == [0.0, 0.0]
 
 
+def test_accuracy_update_returns_batch_local():
+    # reference semantics: update() -> current batch; accumulate() -> running
+    m = Accuracy(topk=(1,))
+    p1 = np.asarray([[0.1, 0.9], [0.9, 0.1]])   # both correct
+    p2 = np.asarray([[0.1, 0.9], [0.9, 0.1]])   # both wrong
+    assert abs(m.update(p1, np.asarray([1, 0])) - 1.0) < 1e-6
+    assert abs(m.update(p2, np.asarray([0, 1])) - 0.0) < 1e-6
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
 def test_precision_recall():
     p, r = Precision(), Recall()
     pred = np.asarray([0.9, 0.8, 0.2, 0.7])
