@@ -117,16 +117,18 @@ def main():
     n_short = max(8, ns.new_tokens // 4)
     timed(n_short)            # compile both lengths
     timed(ns.new_tokens)
-    reps = 3
-    t_short = t_long = 0.0
+    # the tunnel adds hundreds of ms of jitter per dispatch; the min over
+    # reps is the robust estimator of the true (jitter-free) wall time
+    reps = 5
+    t_short, t_long = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
         timed(n_short)
-        t_short += time.perf_counter() - t0
+        t_short.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         timed(ns.new_tokens)
-        t_long += time.perf_counter() - t0
-    dt = (t_long - t_short) / reps
+        t_long.append(time.perf_counter() - t0)
+    dt = min(t_long) - min(t_short)
     n_eff = ns.new_tokens - n_short
 
     tok_s = ns.batch * n_eff / dt

@@ -15,6 +15,7 @@ from typing import Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from paddle_tpu.nn.layer import functional_call
 
@@ -58,10 +59,21 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     dispatch — the fused_multi_transformer-style decode path); after an eos
     every subsequent token of that row is emitted as eos.
     """
+    from paddle_tpu.core.flags import flag
+
     input_ids = jnp.asarray(input_ids)
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
     state = state if state is not None else _inference_state(model)
+    # fused decode path (ops.fused_decode, the fused_multi_transformer
+    # analog): whole decoder stack per step in one Pallas call on TPU /
+    # one stacked jnp program elsewhere. The cache length is padded to the
+    # kernel's 128-token chunk size (attention masks the tail either way).
+    plan = (model.fused_decode_plan(state, probe=True)
+            if flag("FLAGS_fused_decode")
+            and hasattr(model, "fused_decode_plan") else None)
+    if plan is not None:
+        total = -(-total // 128) * 128
     cache = model.init_cache(b, total, dtype=cache_dtype)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
@@ -73,8 +85,56 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
     jit_key = (b, prompt_len, max_new_tokens, float(temperature),
                int(top_k), float(top_p), eos, jnp.dtype(cache_dtype).name,
-               model.training)
+               model.training, plan is not None)
     run = jit_cache.get(jit_key)
+    if run is None and plan is not None:
+        from paddle_tpu.ops import rope as rope_ops
+        from paddle_tpu.ops.fused_decode import fused_decode_step
+
+        cos_tab, sin_tab = rope_ops.rope_cos_sin(
+            total, plan["head_dim"], base=plan["rope_base"])
+
+        def run_impl(state, cache, ids, key):
+            # rebuild the plan from the traced state so the stacked weights
+            # flow from the `state` argument (not baked-in constants)
+            plan_t = model.fused_decode_plan(state)
+            # prefill on the layered path, then stack caches for the kernel
+            out, cache = functional_call(model, state, ids, cache=cache,
+                                         start_pos=0)
+            # fused kernel cache layout: combined flat (L, b, S, 2*nkv*hd)
+            kv = jnp.stack([jnp.concatenate(
+                [c["k"].reshape(b, total, -1), c["v"].reshape(b, total, -1)],
+                axis=-1) for c in cache])
+            key, k0 = jax.random.split(key)
+            tok = _sample_logits(out[:, -1, :], k0, temperature, top_k,
+                                 top_p)
+            finished = jnp.zeros((b,), bool)
+
+            def step(carry, i):
+                tok, kv, key, finished = carry
+                finished = finished | (tok == eos)
+                key, ki = jax.random.split(key)
+                pos = prompt_len + i - 1
+                x = plan_t["embed"](tok)
+                cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
+                sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
+                x, kv = fused_decode_step(
+                    x, plan_t["params"], kv, pos, cos, sin,
+                    num_heads=plan_t["num_heads"],
+                    num_kv_heads=plan_t["num_kv_heads"], eps=plan_t["eps"],
+                    rope_base=plan_t["rope_base"])
+                nxt = _sample_logits(plan_t["head"](x), ki, temperature,
+                                     top_k, top_p)
+                nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
+                return (nxt, kv, key, finished), nxt
+
+            (tok_last, kv, key, finished), toks = jax.lax.scan(
+                step, (tok, kv, key, finished),
+                jnp.arange(1, max_new_tokens))
+            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+        run = jax.jit(run_impl)
+        jit_cache[jit_key] = run
     if run is None:
         def run_impl(state, cache, ids, key):
             out, cache = functional_call(model, state, ids, cache=cache,

@@ -347,6 +347,44 @@ class LlamaForCausalLM(CausalLMBase):
         return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
                 for _ in range(cfg.num_layers)]
 
+    def fused_decode_plan(self, state, probe=False):
+        """Plan for the fused decode-step path (ops.fused_decode — the
+        fused_multi_transformer analog): stacked per-layer weights plus
+        embed/head closures, or None when this config can't ride it
+        (active TP mesh, quantized weights, odd head_dim).
+
+        With probe=True only eligibility + static meta are computed (no
+        device work) — generate() probes before jit and builds the real
+        plan from the traced state inside the jitted program."""
+        from paddle_tpu.parallel.mp_layers import _active_mesh
+        cfg = self.cfg
+        if _active_mesh(mp.MP_AXIS) is not None or cfg.head_dim % 2:
+            return None
+        if "model.layers.0.self_attn.q_proj.weight" not in state:
+            return None     # quantized / non-standard state
+        meta = {
+            "num_heads": cfg.num_heads, "num_kv_heads": cfg.kv_heads,
+            "head_dim": cfg.head_dim, "eps": cfg.rms_norm_eps,
+            "rope_base": cfg.rope_base,
+        }
+        if probe:
+            return meta
+        from paddle_tpu.ops import fused_decode as fd
+        from paddle_tpu.ops.rms_norm import rms_norm
+        params = fd.build_fused_params(state, cfg.num_layers)
+        embed_w = state["model.embed_tokens.weight"]
+        norm_w = state["model.norm.weight"]
+        head_w = (embed_w.T if cfg.tie_word_embeddings
+                  else state["lm_head.weight"])
+
+        def embed(tok):                       # (b,) -> (b, h)
+            return jnp.take(embed_w, tok, axis=0)
+
+        def head(x):                          # (b, h) -> (b, vocab)
+            return jnp.dot(rms_norm(x, norm_w, cfg.rms_norm_eps), head_w)
+
+        return dict(meta, params=params, embed=embed, head=head)
+
     def loss(self, logits, labels):
         # reduction='mean' divides by the count of non-ignored labels
         return self.loss_fn(logits, labels, reduction="mean")

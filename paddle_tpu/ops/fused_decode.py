@@ -1,0 +1,498 @@
+"""Fused decode-step kernel — the fused_multi_transformer analog.
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu +
+masked_multihead_attention (SURVEY.md §2.2 fusion row, §2.8-1, §7 stage 6):
+the reference's inference crown jewel runs one token through the whole
+decoder stack with hand-fused CUDA kernels (qkv + rope + KV-cache append +
+masked attention + FFN), streaming each layer's weights exactly once.
+
+TPU-native design: ONE `pallas_call` for the entire stack per decode step.
+
+* grid = (num_layers, 1 + ffn_blocks): phase 0 of each layer does
+  rmsnorm→qkv→rope→cache-append→masked attention over the *filled prefix
+  only*→o-proj; phases 1..J stream the SwiGLU FFN in column blocks.
+* Layer weights ride BlockSpecs indexed by the layer grid dim, so Mosaic's
+  pipeline double-buffers them: layer l+1's weights stream from HBM while
+  layer l computes — the "stream weights once, overlap with compute"
+  property the CUDA kernel gets from its warp pipeline.
+* The KV cache lives in HBM (`pl.ANY` memory space, input/output aliased —
+  updated in place). The new token's k/v is DMA'd into slot `pos`; the
+  attention loop then DMAs 128-token chunks of the *filled* prefix
+  [0, pos] into VMEM — unlike the XLA scan path it never touches the
+  unfilled tail, and the whole residual stream stays in fp32 in VMEM.
+* The hidden state x crosses grid steps in a VMEM scratch accumulator, so
+  the only HBM traffic per step is weights (once), the filled KV prefix,
+  and one token's cache append — which IS the decode roofline.
+
+The stack covers the Llama block (RMSNorm / GQA / RoPE / SwiGLU, no
+biases). `fused_decode_reference` is the jnp twin used for numerics tests
+and as the non-TPU fallback; `examples/decode_bench.py` measures the win.
+"""
+
+import functools
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Stacked parameter pytree
+# ---------------------------------------------------------------------------
+
+def build_fused_params(state: Dict[str, jax.Array], num_layers: int,
+                       prefix: str = "model.layers.") -> Dict[str, jax.Array]:
+    """Stack a Llama-style flat state dict into per-layer-stacked arrays.
+
+    Returns {ln1 (L,h), wqkv (L,h,(nh+2nkv)*hd), wo (L,nh*hd,h), ln2 (L,h),
+    wg (L,h,ffn), wu (L,h,ffn), wd (L,ffn,h)}. The qkv projections are
+    fused along the output dim (q|k|v) the way fused_multi_transformer's
+    qkv_weight is packed.
+    """
+    def layer(i, name):
+        return state[f"{prefix}{i}.{name}.weight"]
+
+    ln1, wqkv, wo, ln2, wg, wu, wd = [], [], [], [], [], [], []
+    for i in range(num_layers):
+        ln1.append(layer(i, "input_layernorm"))
+        wqkv.append(jnp.concatenate([
+            layer(i, "self_attn.q_proj"),
+            layer(i, "self_attn.k_proj"),
+            layer(i, "self_attn.v_proj")], axis=1))
+        wo.append(layer(i, "self_attn.o_proj"))
+        ln2.append(layer(i, "post_attention_layernorm"))
+        wg.append(layer(i, "mlp.gate_proj"))
+        wu.append(layer(i, "mlp.up_proj"))
+        wd.append(layer(i, "mlp.down_proj"))
+    return {
+        "ln1": jnp.stack(ln1), "wqkv": jnp.stack(wqkv), "wo": jnp.stack(wo),
+        "ln2": jnp.stack(ln2), "wg": jnp.stack(wg), "wu": jnp.stack(wu),
+        "wd": jnp.stack(wd),
+    }
+
+
+def _rms(x, w, eps):
+    """fp32 rms-normalize, cast to w.dtype path of ops.rms_norm."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * lax.rsqrt(var + eps))
+    return (y.astype(w.dtype) * w)
+
+
+def _rope1(x, cos, sin):
+    """x (b, n, hd) fp32; cos/sin (1, 1, hd)."""
+    hd = x.shape[-1]
+    x1 = x[..., : hd // 2]
+    x2 = x[..., hd // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rot * sin
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (numerics twin + non-TPU fallback)
+# ---------------------------------------------------------------------------
+
+def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
+                           num_heads: int, num_kv_heads: int,
+                           eps: float = 1e-5):
+    """One decode step through the whole stack; pure jnp.
+
+    x (b, h); the KV cache is stored COMBINED and FLAT as
+    (L, b, S, 2*nkv*hd) with k in lanes [0, nkv*hd) and v in the rest —
+    the layout the Pallas kernel DMAs (one copy per chunk, lane dim a
+    128-multiple); pos scalar int; cos/sin (1, hd) fp32 for position
+    `pos`. Returns (x_out (b, h), kv_cache). Matches the Pallas kernel up
+    to XLA fusion differences: residual stream fp32, attention over
+    [0, pos] only (masked), softmax fp32.
+    """
+    L, b, S, dkv2 = kv_cache.shape
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = dkv // nkv
+    rep = nh // nkv
+    dq = nh * hd
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+    cos_b = cos.reshape(1, 1, hd).astype(jnp.float32)
+    sin_b = sin.reshape(1, 1, hd).astype(jnp.float32)
+
+    xf = x.astype(jnp.float32)
+    for l in range(L):
+        xn = _rms(xf, params["ln1"][l], eps)
+        qkv = jnp.dot(xn, params["wqkv"][l],
+                      preferred_element_type=jnp.float32)
+        q = qkv[:, :dq].reshape(b, nh, hd)
+        k = qkv[:, dq:dq + nkv * hd].reshape(b, nkv, hd)
+        v = qkv[:, dq + nkv * hd:].reshape(b, nkv, hd)
+        q = _rope1(q, cos_b, sin_b)
+        k = _rope1(k, cos_b, sin_b)
+        kv_cache = lax.dynamic_update_slice(
+            kv_cache, jnp.concatenate(
+                [k.reshape(b, dkv), v.reshape(b, dkv)],
+                axis=-1).astype(kv_cache.dtype)[None, :, None],
+            (l, 0, pos, 0))
+        kl = kv_cache[l, :, :, :dkv].astype(jnp.float32).reshape(
+            b, S, nkv, hd)
+        vl = kv_cache[l, :, :, dkv:].astype(jnp.float32).reshape(
+            b, S, nkv, hd)
+        qg = q.reshape(b, nkv, rep, hd) * scale
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg, kl)
+        valid = jnp.arange(S)[None, None, None] <= pos
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
+        attn = attn.reshape(b, dq).astype(dtype)
+        xf = xf + jnp.dot(attn, params["wo"][l],
+                          preferred_element_type=jnp.float32)
+        xn2 = _rms(xf, params["ln2"][l], eps)
+        g = jnp.dot(xn2, params["wg"][l], preferred_element_type=jnp.float32)
+        u = jnp.dot(xn2, params["wu"][l], preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(g) * u).astype(dtype)
+        xf = xf + jnp.dot(act, params["wd"][l],
+                          preferred_element_type=jnp.float32)
+    return xf.astype(dtype), kv_cache
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _pick_ffn_blocks(ffn: int, target: int = 3072):
+    """Smallest J with ffn % J == 0 and ffn // J <= target."""
+    for j in range(1, ffn + 1):
+        if ffn % j == 0 and ffn // j <= target:
+            return j, ffn // j
+    return ffn, 1
+
+
+def _fused_decode_pallas(x, params, kv_cache, pos, *,
+                         num_heads: int, num_kv_heads: int, head_dim: int,
+                         rope_base: float = 10000.0,
+                         eps: float = 1e-5, chunk: int = 0):
+    # NOTE: not jit-wrapped — always invoked inside the caller's jit (the
+    # generate() scan); a nested jit around a pallas_call trips XLA's
+    # closed_call lowering cache.
+    #
+    # Mosaic layout rules shape this kernel (probed on v5e):
+    #  * values cannot reshape the lane dim -> heads are split with lane
+    #    SLICES (static, unrolled) and per-kv-group batched matmuls
+    #  * DMA slices on the token (minor-2) dim must be 8-aligned -> the
+    #    cache append is an aligned 8-token read-modify-write
+    #  * HBM lane dims want 128-multiples -> the cache is stored flat as
+    #    (L, b, S, nkv*hd)
+    #  * bf16 relayouts through unit-dim inserts fail -> all merging math
+    #    runs in fp32 with full-ref casts at the end
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, b, S, dkv2 = kv_cache.shape
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = head_dim
+    assert hd == dkv // nkv
+    rep = nh // nkv
+    h = x.shape[1]
+    dq = nh * hd
+    dqkv = dq + 2 * dkv
+    ffn = params["wg"].shape[2]
+    J, fblk = _pick_ffn_blocks(ffn)
+    if not chunk:
+        chunk = 128
+    ck = min(chunk, S)
+    assert S % ck == 0, f"cache len {S} not a multiple of chunk {ck}"
+    assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    def kernel(pos_ref, x_in_ref, ln1_ref, wqkv_ref,
+               wo_ref, ln2_ref, wg_ref, wu_ref, wd_ref, kv_in,
+               x_out_ref, kv_ref,
+               x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
+               wsem, rsem):
+        del kv_in  # aliased with kv_ref
+        li = pl.program_id(0)
+        j = pl.program_id(1)
+        pos = pos_ref[0]
+
+        @pl.when(j == 0)
+        def attention_phase():
+            @pl.when(li == 0)
+            def _():
+                x_s[...] = x_in_ref[...].astype(jnp.float32)
+
+            # cache-append RMW block reads: layer 0 issues its own; for
+            # later layers the previous layer's FFN j==1 step prefetched
+            # them (plus chunk 0) so attention starts with data in flight
+            blk = (pos // 8) * 8
+            off = pos - blk
+            rkb = pltpu.make_async_copy(
+                kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s, wsem.at[0])
+
+            @pl.when(li == 0)
+            def _():
+                rkb.start()
+
+            xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
+            qkv = jnp.dot(xn, wqkv_ref[...],
+                          preferred_element_type=jnp.float32)
+            # rope angles computed in-kernel from pos (NeoX convention:
+            # freqs repeated over both halves) — no XLA-side cos/sin table
+            half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+                    % (hd // 2)).astype(jnp.float32)
+            inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
+            ang = pos.astype(jnp.float32) * inv_freq
+            cos_b = jnp.cos(ang)
+            sin_b = jnp.sin(ang)
+            rope2 = lambda t: (t * cos_b + jnp.concatenate(
+                [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
+            # heads via lane slices (no lane reshapes): q into a 3D f32
+            # scratch; new k/v staged FLAT (b, dkv) f32 for the RMW merge
+            for g in range(nh):
+                q_s[:, g, :] = rope2(qkv[:, g * hd:(g + 1) * hd])
+            for g in range(nkv):
+                kv32_s[:, g * hd:(g + 1) * hd] = rope2(
+                    qkv[:, dq + g * hd:dq + (g + 1) * hd])
+                kv32_s[:, dkv + g * hd:dkv + (g + 1) * hd] = \
+                    qkv[:, dq + dkv + g * hd:dq + dkv + (g + 1) * hd]
+
+            # ---- online softmax, three stages sharing one set of
+            # carries: (a) double-buffered chunk loop over the prefix
+            # [0, blk) from HBM; (b) the freshly merged 8-token block
+            # [blk, pos] straight from VMEM; stage (b) also hides the RMW
+            # write-back behind the o-proj.
+            def chunk_copy(c, slot):
+                return pltpu.make_async_copy(
+                    kv_ref.at[li, :, pl.ds(c * ck, ck)],
+                    kvch_s.at[slot], rsem.at[slot])
+
+            def merge(carry, kmat, vmat, idx, limit, width):
+                """One online-softmax block update. kmat/vmat readers
+                return (b, width, hd) f32 for kv-group g."""
+                ms, ls, accs = carry
+                ms2, ls2, accs2 = [], [], []
+                for g in range(nkv):
+                    kg = kmat(g)
+                    vg = vmat(g)
+                    qg = q_s[:, g * rep:(g + 1) * rep, :] * scale
+                    sc = lax.dot_general(
+                        qg, kg, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)  # (b, rep, w)
+                    sc = jnp.where(idx < limit, sc, NEG_INF)
+                    m_new = jnp.maximum(ms[g], jnp.max(sc, axis=-1))
+                    alpha = jnp.exp(ms[g] - m_new)
+                    pp = jnp.exp(sc - m_new[..., None])
+                    acc = accs[g] * alpha[..., None] + lax.dot_general(
+                        pp, vg, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)  # (b, rep, hd)
+                    ms2.append(m_new)
+                    ls2.append(ls[g] * alpha + jnp.sum(pp, axis=-1))
+                    accs2.append(acc)
+                return ms2, ls2, accs2
+
+            nc = (blk + ck - 1) // ck          # chunks covering [0, blk)
+
+            @pl.when((li == 0) & (nc > 0))
+            def _():
+                chunk_copy(0, 0).start()
+
+            def body(c, carry):
+                slot = lax.rem(c, 2)
+
+                @pl.when(c + 1 < nc)
+                def _():
+                    chunk_copy(c + 1, lax.rem(c + 1, 2)).start()
+
+                chunk_copy(c, slot).wait()
+                idx = c * ck + lax.broadcasted_iota(
+                    jnp.int32, (1, 1, ck), 2)
+                return merge(
+                    carry,
+                    lambda g: kvch_s[slot, :, :, g * hd:(g + 1) * hd].astype(
+                        jnp.float32),
+                    lambda g: kvch_s[slot, :, :,
+                                     dkv + g * hd:dkv + (g + 1) * hd].astype(
+                        jnp.float32),
+                    idx, blk, ck)
+
+            m0 = [jnp.full((b, rep), NEG_INF, jnp.float32)
+                  for _ in range(nkv)]
+            l0 = [jnp.zeros((b, rep), jnp.float32) for _ in range(nkv)]
+            a0 = [jnp.zeros((b, rep, hd), jnp.float32) for _ in range(nkv)]
+            carry = lax.fori_loop(0, nc, body, (m0, l0, a0))
+
+            # merge the new token into the RMW block, attend to it from
+            # VMEM, and write the block back (waited in FFN j==1)
+            rkb.wait()
+            sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
+            kvblk_s[...] = jnp.where(
+                sel, kv32_s[...][:, None, :],
+                kvblk_s[...].astype(jnp.float32)).astype(dtype)
+            wkb = pltpu.make_async_copy(
+                kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
+            wkb.start()
+            bidx = blk + lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
+            ms, ls, accs = merge(
+                carry,
+                lambda g: kvblk_s[:, :, g * hd:(g + 1) * hd].astype(
+                    jnp.float32),
+                lambda g: kvblk_s[:, :,
+                                  dkv + g * hd:dkv + (g + 1) * hd].astype(
+                    jnp.float32),
+                bidx, pos + 1, 8)
+
+            # o-proj without a lane-merge relayout: per-head partial
+            # matmuls against wo's row blocks (head = g*rep + r)
+            x = x_s[...]
+            for g in range(nkv):
+                norm = accs[g] / ls[g][..., None]           # (b, rep, hd)
+                for r in range(rep):
+                    hh = g * rep + r
+                    x = x + jnp.dot(
+                        norm[:, r, :].astype(dtype),
+                        wo_ref[hh * hd:(hh + 1) * hd, :],
+                        preferred_element_type=jnp.float32)
+            x_s[...] = x
+            xn_s[...] = _rms(x, ln2_ref[...].reshape(h), eps).astype(dtype)
+            acc_s[...] = jnp.zeros_like(acc_s)
+
+        @pl.when(j > 0)
+        def ffn_phase():
+            @pl.when(j == 1)
+            def prefetch_next_layer():
+                # drain this layer's cache write-back, then issue the next
+                # layer's RMW-block + chunk-0 reads so its attention phase
+                # never stalls on DMA latency
+                blk = (pos // 8) * 8
+                pltpu.make_async_copy(
+                    kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)],
+                    wsem.at[0]).wait()
+
+                @pl.when(li + 1 < L)
+                def _():
+                    pltpu.make_async_copy(
+                        kv_ref.at[li + 1, :, pl.ds(blk, 8)], kvblk_s,
+                        wsem.at[0]).start()
+
+                    @pl.when(blk > 0)
+                    def _():
+                        pltpu.make_async_copy(
+                            kv_ref.at[li + 1, :, pl.ds(0, ck)],
+                            kvch_s.at[0], rsem.at[0]).start()
+
+            xn = xn_s[...]
+            g = jnp.dot(xn, wg_ref[...], preferred_element_type=jnp.float32)
+            u = jnp.dot(xn, wu_ref[...], preferred_element_type=jnp.float32)
+            act = (jax.nn.silu(g) * u).astype(dtype)
+            acc_s[...] += jnp.dot(act, wd_ref[...],
+                                  preferred_element_type=jnp.float32)
+
+            @pl.when(j == J)
+            def _():
+                x = x_s[...] + acc_s[...]
+                x_s[...] = x
+                x_out_ref[...] = x.astype(dtype)
+
+    def jm(ll, jj):
+        # j==0 reuses whatever the previous grid step held (layer l-1's
+        # last FFN block) so the attention phase issues no FFN-weight
+        # fetch; j>=1 streams block j-1 of layer l.
+        return lax.select(jj == 0,
+                          lax.max(ll - 1, 0) * 0 + (J - 1) if J > 1 else 0,
+                          jj - 1)
+    grid = (L, 1 + J)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # pos
+            pl.BlockSpec((b, h), lambda l, j: (0, 0)),             # x
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln1
+            pl.BlockSpec((None, h, dqkv), lambda l, j: (l, 0, 0)),  # wqkv
+            pl.BlockSpec((None, dq, h), lambda l, j: (l, 0, 0)),   # wo
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln2
+            pl.BlockSpec((None, h, fblk),
+                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
+                                       jm(l, j))),                  # wg
+            pl.BlockSpec((None, h, fblk),
+                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
+                                       jm(l, j))),                  # wu
+            pl.BlockSpec((None, fblk, h),
+                         lambda l, j: (lax.max(l - (j == 0), 0),
+                                       jm(l, j), 0)),               # wd
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
+        ],
+        out_specs=[
+            pl.BlockSpec((b, h), lambda l, j: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h), dtype),
+            jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),          # x_s
+            pltpu.VMEM((b, h), dtype),                # xn_s
+            pltpu.VMEM((b, h), jnp.float32),          # acc_s
+            pltpu.VMEM((b, nh, hd), jnp.float32),     # q_s
+            pltpu.VMEM((b, 2 * dkv), jnp.float32),    # kv32_s staging
+            pltpu.VMEM((b, 8, 2 * dkv), kv_cache.dtype),   # kvblk_s RMW
+            pltpu.VMEM((2, b, ck, 2 * dkv), kv_cache.dtype),  # kvch_s dbuf
+            pltpu.SemaphoreType.DMA((1,)),            # wsem
+            pltpu.SemaphoreType.DMA((2,)),            # rsem
+        ],
+        input_output_aliases={9: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            # v5e has 128 MiB VMEM; the default 16 MiB scoped limit can't
+            # hold a layer's double-buffered weights + KV chunks
+            vmem_limit_bytes=100 * 1024 * 1024),
+        name="fused_decode_step",
+    )(jnp.asarray(pos, jnp.int32).reshape(1), x,
+      params["ln1"][:, None], params["wqkv"],
+      params["wo"], params["ln2"][:, None], params["wg"], params["wu"],
+      params["wd"], kv_cache)
+    return out[0], out[1]
+
+
+
+_fallback_logged = False
+
+
+def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
+                      num_heads: int, num_kv_heads: int, eps: float = 1e-5,
+                      rope_base: float = 10000.0):
+    """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
+
+    Args follow fused_decode_reference (combined flat KV cache). `pos` may
+    be traced (it is the scan counter inside `inference.generate`).
+    """
+    from paddle_tpu.ops import use_pallas
+    dkv = kv_cache.shape[-1] // 2
+    if use_pallas() and dkv % 128 == 0 and kv_cache.shape[2] % 128 == 0:
+        try:
+            return _fused_decode_pallas(
+                x, params, kv_cache, pos,
+                num_heads=num_heads, num_kv_heads=num_kv_heads,
+                head_dim=dkv // num_kv_heads,
+                rope_base=rope_base, eps=eps)
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            from paddle_tpu.core.flags import flag
+            if flag("FLAGS_pallas_strict"):
+                raise
+            global _fallback_logged
+            if not _fallback_logged:
+                _fallback_logged = True
+                import logging
+                logging.getLogger("paddle_tpu.ops.fused_decode").warning(
+                    "Pallas fused decode failed (%s: %s); using the jnp "
+                    "reference path. FLAGS_pallas_strict=1 to raise.",
+                    type(e).__name__, e)
+    return fused_decode_reference(
+        x, params, kv_cache, pos, cos, sin,
+        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps)

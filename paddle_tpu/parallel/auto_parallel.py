@@ -229,11 +229,16 @@ class Engine:
         if self._state is not None:
             model_keys = set(self.model.state_dict())
             if model_keys & set(self._state):
-                missing, _unexpected = self.model.set_state_dict(self._state)
+                # check coverage BEFORE mutating — a partial overlap must
+                # not leave the model half-updated (parameters only; missing
+                # buffers are fine, matching set_state_dict's semantics)
+                missing = (set(dict(self.model.named_parameters()))
+                           - set(self._state))
                 if missing:
                     raise ValueError(
                         "Engine.sync_model: trained state only partially "
                         f"covers the model; missing {sorted(missing)[:8]}...")
+                self.model.set_state_dict(self._state)
         return self.model
 
     def save(self, path):

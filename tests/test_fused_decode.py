@@ -1,0 +1,107 @@
+"""Fused decode-step (fused_multi_transformer analog) — CPU-side numerics.
+
+The Pallas kernel itself only runs on TPU (tests_tpu/ has the on-chip
+parity suite); here the jnp twin `fused_decode_reference` — which the
+kernel is tested against on hardware — is validated against the layered
+decode path, and the generate() integration is checked end to end.
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu
+(SURVEY.md §2.2 fusion row, §7 stage 6).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.inference import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.ops import fused_decode as fd
+from paddle_tpu.ops.rope import rope_cos_sin
+
+
+def tiny_model(nkv=2):
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=3,
+                      num_heads=4, num_kv_heads=nkv, intermediate_size=256,
+                      max_position_embeddings=512)
+    return cfg, LlamaForCausalLM(cfg).bfloat16()
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    set_flags({"FLAGS_fused_decode": True})
+
+
+def test_build_fused_params_shapes():
+    cfg, m = tiny_model()
+    p = fd.build_fused_params(m.state_dict(include_buffers=False),
+                              cfg.num_layers)
+    L, h, hd = cfg.num_layers, cfg.hidden_size, cfg.head_dim
+    assert p["wqkv"].shape == (L, h, (cfg.num_heads + 2 * cfg.kv_heads) * hd)
+    assert p["wo"].shape == (L, cfg.num_heads * hd, h)
+    assert p["wg"].shape == (L, h, cfg.intermediate_size)
+    assert p["ln1"].shape == (L, h)
+
+
+@pytest.mark.parametrize("nkv", [2, 4])  # GQA and MHA
+def test_reference_step_matches_layered_decode(nkv):
+    """One fused_decode_reference step == the layered cache forward."""
+    cfg, m = tiny_model(nkv)
+    state = m.state_dict(include_buffers=False)
+    plan = m.fused_decode_plan(state)
+    assert plan is not None
+    b, prompt, S = 2, 7, 128
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, prompt)))
+
+    # layered prefill + one layered decode step
+    cache = m.init_cache(b, S)
+    logits, cache = m(ids, cache=cache, start_pos=0)
+    tok = jnp.argmax(logits[:, -1, :], axis=-1)
+    logits2, cache2 = m(tok[:, None], cache=cache, start_pos=prompt)
+
+    # fused reference step from the same stacked cache
+    kv = jnp.stack([jnp.concatenate(
+        [c["k"].reshape(b, S, -1), c["v"].reshape(b, S, -1)], axis=-1)
+        for c in cache])
+    cos, sin = rope_cos_sin(S, cfg.head_dim, base=cfg.rope_base)
+    x = plan["embed"](tok)
+    x, kv = fd.fused_decode_reference(
+        x, plan["params"], kv, prompt, cos[prompt:prompt + 1],
+        sin[prompt:prompt + 1], num_heads=cfg.num_heads,
+        num_kv_heads=cfg.kv_heads, eps=cfg.rms_norm_eps)
+    fused_logits = plan["head"](x)
+
+    ref = np.asarray(logits2[:, -1, :], np.float32)
+    got = np.asarray(fused_logits, np.float32)
+    assert np.argmax(ref, -1).tolist() == np.argmax(got, -1).tolist()
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+    # cache rows at `prompt` were appended
+    kref = cache2[1]["k"][:, prompt].reshape(b, -1)
+    kgot = kv[1, :, prompt, :kref.shape[-1]]
+    np.testing.assert_allclose(np.asarray(kgot, np.float32),
+                               np.asarray(kref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_generate_fused_matches_unfused():
+    cfg, m = tiny_model()
+    rng = np.random.RandomState(1)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 9)))
+    set_flags({"FLAGS_fused_decode": False})
+    out_ref = generate(m, prompt, max_new_tokens=16, temperature=0.0)
+    m._generate_jit_cache = {}
+    set_flags({"FLAGS_fused_decode": True})
+    out_fused = generate(m, prompt, max_new_tokens=16, temperature=0.0)
+    assert np.asarray(out_ref).tolist() == np.asarray(out_fused).tolist()
+
+
+def test_plan_gates_on_quantized_state():
+    cfg, m = tiny_model()
+    state = m.state_dict(include_buffers=False)
+    bad = {k: v for k, v in state.items()
+           if "q_proj" not in k}          # missing keys -> no plan
+    assert m.fused_decode_plan(bad) is None
