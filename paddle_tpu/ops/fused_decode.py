@@ -332,7 +332,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
             kvblk_s[...] = jnp.where(
                 sel, kv32_s[...][:, None, :],
-                kvblk_s[...].astype(jnp.float32)).astype(dtype)
+                kvblk_s[...].astype(jnp.float32)).astype(kv_cache.dtype)
             wkb = pltpu.make_async_copy(
                 kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
             wkb.start()

@@ -1,0 +1,39 @@
+"""On-TPU Pallas parity suite (VERDICT r2 missing #3).
+
+Runs on the real chip (`python -m pytest tests_tpu -q`) — unlike tests/,
+which pins the 8-device CPU simulator, this conftest leaves the default
+backend (the axon-tunneled TPU) in place and skips everything when no TPU
+is present. FLAGS_pallas_strict=1 for the whole suite: a kernel that falls
+back to XLA is a FAILURE here, not a silent pass.
+
+Reference discipline: the OpTest pattern (SURVEY.md §4) — every Pallas
+kernel checked against its XLA twin, forward and backward, on hardware.
+"""
+
+import jax
+import pytest
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _on_tpu():
+        skip = pytest.mark.skip(reason="no TPU backend — parity suite "
+                                "requires the real chip")
+        for it in items:
+            it.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _strict_pallas():
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"FLAGS_pallas_strict": True, "FLAGS_use_pallas_kernels": True})
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    yield
+    set_flags({"FLAGS_pallas_strict": False})

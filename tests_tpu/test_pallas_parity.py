@@ -1,0 +1,161 @@
+"""Pallas-vs-XLA numeric parity on the real TPU, strict mode.
+
+Covers every Pallas kernel in paddle_tpu/ops: flash attention (forward,
+backward, LSE variant, GQA), the fused decode-step kernel, and the rms_norm
+kernel kept for benchmarking. CPU CI never executes these paths
+(use_pallas() is False off-TPU); this suite is the hardware leg of the
+reference's OpTest discipline (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import flash_attention as fa
+
+
+def rand(key, *shape, dtype=jnp.bfloat16, scale=0.5):
+    return (jax.random.normal(jax.random.PRNGKey(key), shape) * scale).astype(
+        dtype)
+
+
+def assert_close(a, b, rtol=2e-2, atol=2e-2, frac=0.995):
+    """bf16-tolerant: allclose on >=99.5% of entries, tight on the mean."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    ok = np.isclose(a, b, rtol=rtol, atol=atol).mean()
+    assert ok >= frac, f"only {ok:.4f} of entries close"
+    assert np.abs(a - b).mean() < atol, np.abs(a - b).mean()
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("nkv", [8, 2])   # MHA / GQA
+def test_flash_forward_parity(causal, nkv):
+    b, s, h, d = 2, 1024, 8, 64
+    q = rand(0, b, s, h, d)
+    k = rand(1, b, s, nkv, d)
+    v = rand(2, b, s, nkv, d)
+    pal = fa._flash_attention_pallas(q, k, v, causal, None)
+    ref = fa._xla_attention(q, k, v, is_causal=causal)
+    assert_close(pal, ref)
+
+
+def test_flash_backward_parity():
+    b, s, h, d = 2, 1024, 4, 64
+    q = rand(3, b, s, h, d)
+    k = rand(4, b, s, h, d)
+    v = rand(5, b, s, h, d)
+
+    def pal_loss(q, k, v):
+        return jnp.sum(fa._flash_attention_vjp(q, k, v, True, None)
+                       .astype(jnp.float32) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(fa._xla_attention(q, k, v, is_causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    gp = jax.jit(jax.grad(pal_loss, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        assert_close(a, b_, rtol=5e-2, atol=5e-2)
+
+
+def test_flash_lse_parity():
+    b, s, h, d = 2, 1024, 4, 64
+    q = rand(6, b, s, h, d)
+    k = rand(7, b, s, h, d)
+    v = rand(8, b, s, h, d)
+    out_p, lse_p = fa._flash_fwd(q, k, v, True, None)
+    out_r, lse_r = fa._xla_fwd_lse(q, k, v, True, None)
+    assert_close(out_p, out_r)
+    assert_close(lse_p[..., 0], lse_r, rtol=1e-2, atol=1e-2)
+
+
+def test_sdpa_dispatches_pallas_on_tpu():
+    """The public API path must actually take the kernel (strict mode would
+    raise on kernel failure; this guards the dispatch predicate)."""
+    b, s, h, d = 2, 1024, 4, 64
+    q = rand(9, b, s, h, d)
+    k = rand(10, b, s, h, d)
+    v = rand(11, b, s, h, d)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ref = fa._xla_attention(q, k, v, is_causal=True)
+    assert_close(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# fused decode step
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nkv,rep", [(4, 1), (2, 2)])
+def test_fused_decode_kernel_parity(nkv, rep):
+    from paddle_tpu.ops import fused_decode as fd
+    from paddle_tpu.ops.rope import rope_cos_sin
+
+    L, b, S, hd, h, ffn = 3, 8, 256, 64, 256, 512
+    nh = nkv * rep
+    if nkv * hd % 128:
+        pytest.skip("dkv not a lane multiple")
+    r = np.random.RandomState(0)
+    f = lambda *s: jnp.asarray(r.randn(*s) * 0.05, jnp.bfloat16)
+    params = {"ln1": jnp.ones((L, h), jnp.bfloat16),
+              "wqkv": f(L, h, (nh + 2 * nkv) * hd),
+              "wo": f(L, nh * hd, h), "ln2": jnp.ones((L, h), jnp.bfloat16),
+              "wg": f(L, h, ffn), "wu": f(L, h, ffn), "wd": f(L, ffn, h)}
+    x = f(b, h)
+    kv = f(L, b, S, 2 * nkv * hd)
+    pos = 130
+    cos, sin = rope_cos_sin(S, hd)
+
+    xr, kvr = jax.jit(lambda *a: fd.fused_decode_reference(
+        *a, num_heads=nh, num_kv_heads=nkv, eps=1e-5))(
+        x, params, kv, pos, cos[pos:pos + 1], sin[pos:pos + 1])
+    xp, kvp = jax.jit(lambda x, p, kv: fd._fused_decode_pallas(
+        x, p, kv, pos, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+        eps=1e-5))(x, params, kv)
+
+    assert_close(xp, xr)
+    # cache: identical except bf16-ulp noise at the written token
+    d = np.abs(np.asarray(kvr, np.float32) - np.asarray(kvp, np.float32))
+    touched = sorted(set(np.argwhere(d > 1e-3)[:, 2].tolist()))
+    assert touched in ([], [pos]), touched
+    assert d.max() < 0.05, d.max()
+
+
+def test_fused_generate_matches_layered_on_tpu():
+    import paddle_tpu
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.inference import generate
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256, num_layers=3,
+                      num_heads=4, num_kv_heads=2, intermediate_size=512,
+                      max_position_embeddings=512)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 9)))
+    out_fused = generate(m, prompt, max_new_tokens=20, temperature=0.0)
+    m._generate_jit_cache = {}
+    set_flags({"FLAGS_fused_decode": False})
+    out_ref = generate(m, prompt, max_new_tokens=20, temperature=0.0)
+    set_flags({"FLAGS_fused_decode": True})
+    assert np.asarray(out_fused).tolist() == np.asarray(out_ref).tolist()
+
+
+# ---------------------------------------------------------------------------
+# rms_norm bench kernel
+# ---------------------------------------------------------------------------
+
+def test_rms_norm_pallas_parity():
+    from paddle_tpu.ops import rms_norm as rn
+    x = rand(12, 4, 512, 1024, dtype=jnp.bfloat16)
+    w = rand(13, 1024, dtype=jnp.bfloat16, scale=1.0)
+    pal = rn._rms_norm_pallas(x, w, 1e-5)
+    ref = rn._rms_norm_ref(x, w, 1e-5)
+    assert_close(pal, ref, rtol=1e-2, atol=1e-2)
