@@ -15,6 +15,8 @@ Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
 # XLA:CPU workaround: AllReducePromotion's CloneAllReduce assumes the
@@ -100,6 +102,22 @@ def main():
         report("llama-65b", cfg,
                {"dp_degree": 1, "mp_degree": 4, "pp_degree": 2,
                 "sharding_degree": 1}, n_micro=4, seq=2048, batch=4)
+    if which.startswith("65b-d"):
+        # 1/D validation at bigger virtual meshes (VERDICT r2 #5): run with
+        #   XLA_FLAGS=--xla_force_host_platform_device_count=16 ... 65b-d16-l8
+        #   XLA_FLAGS=--xla_force_host_platform_device_count=32 ... 65b-d32-l8
+        # exact 65B tensor shapes, depth reduced to fit host RAM; the
+        # args/device line vs the 8-device sweep checks the 1/D claim.
+        _, d, l = which.split("-")
+        n_dev, layers = int(d[1:]), int(l[1:])
+        mesh = {16: {"dp_degree": 1, "mp_degree": 4, "pp_degree": 4,
+                     "sharding_degree": 1},
+                32: {"dp_degree": 1, "mp_degree": 8, "pp_degree": 4,
+                     "sharding_degree": 1}}[n_dev]
+        cfg = LlamaConfig.llama_65b()
+        cfg.num_layers = layers
+        report(f"65b-shape-{layers}L-{n_dev}dev", cfg, mesh,
+               n_micro=8, seq=2048, batch=8)
 
 
 if __name__ == "__main__":

@@ -37,6 +37,8 @@ class MixtralConfig(LlamaConfig):
     aux_loss_weight: float = 0.01
     num_shared_experts: int = 0       # DeepSeekMoE: always-on experts
     moe_gate: str = "gshard"          # 'gshard' (top-k) | 'switch' (top-1)
+    moe_dispatch: str = "scatter"     # 'scatter'|'sort'|'einsum'|'alltoall'
+    moe_dropless: bool = False        # sort + ragged_dot, no capacity drops
 
     @classmethod
     def tiny(cls, vocab_size=256):
@@ -70,7 +72,9 @@ class MixtralDecoderLayer(nn.Layer):
                             cfg.num_experts, top_k=cfg.top_k,
                             capacity_factor=cfg.capacity_factor,
                             gate=cfg.moe_gate,
-                            initializer_range=cfg.initializer_range)
+                            initializer_range=cfg.initializer_range,
+                            dispatch_mode=cfg.moe_dispatch,
+                            dropless=cfg.moe_dropless)
         if cfg.num_shared_experts:
             shared_cfg = dataclasses.replace(
                 cfg, intermediate_size=cfg.intermediate_size

@@ -18,11 +18,13 @@ TPU-first design:
   adds it to the task loss (the pipeline schedule threads it per-stage).
 """
 
+import functools
 import math
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.nn.layer import Layer
@@ -34,9 +36,11 @@ EP_AXES = ("dp",)   # default: expert parallelism rides the dp axis
 
 
 def _ep_spec(ep_axes, ndim, extra=None):
-    """Spec sharding dim0 (experts) over ep_axes; `extra` maps dim→axis."""
+    """Spec sharding dim0 (experts) over ep_axes (replicated when empty —
+    the dropless path); `extra` maps dim→axis."""
     dims = [None] * ndim
-    dims[0] = ep_axes if len(ep_axes) > 1 else ep_axes[0]
+    if ep_axes:
+        dims[0] = ep_axes if len(ep_axes) > 1 else ep_axes[0]
     for d, a in (extra or {}).items():
         dims[d] = a
     return P(*dims)
@@ -50,14 +54,26 @@ def _swiglu(xe, wg, wu, wd):
     return jnp.einsum("ecf,efh->ech", F.silu(h1) * h2, wd)
 
 
+def _slots(idx, pos, keep, cap, e):
+    """Copy→slot map (t·k,): kept copies get unique slots in [0, e·cap);
+    dropped copies get the OUT-OF-BOUNDS value e·cap (mode="drop" scatters
+    discard them — never an in-bounds duplicate)."""
+    return jnp.where(keep, idx * cap + pos, e * cap).reshape(-1)
+
+
+def _token_copies(xt, k):
+    """(t, h) → (t·k, h) row copies; the broadcast's VJP sums the k
+    copy-grads back per token."""
+    t, h = xt.shape
+    return jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
+
+
 def _slot_scatter(xt, idx, pos, keep, cap, e):
     """Tokens → flat (e·cap, h) expert buffer; dropped tokens get an OOB
     slot the scatter drops. Returns (buffer, slot ids)."""
-    t, k = idx.shape
-    h = xt.shape[-1]
-    slot = jnp.where(keep, idx * cap + pos, e * cap).reshape(-1)
-    xt_k = jnp.broadcast_to(xt[:, None], (t, k, h)).reshape(t * k, h)
-    buf = jnp.zeros((e * cap, h), xt.dtype).at[slot].set(
+    slot = _slots(idx, pos, keep, cap, e)
+    xt_k = _token_copies(xt, idx.shape[1])
+    buf = jnp.zeros((e * cap, xt.shape[-1]), xt.dtype).at[slot].set(
         xt_k, mode="drop", unique_indices=True)
     return buf, slot
 
@@ -70,6 +86,47 @@ def _slot_combine(ye_flat, slot, vals, keep, dtype):
                         fill_value=0).reshape(t, k, h)
     w = (vals * keep).astype(dtype)
     return jnp.einsum("tk,tkh->th", w, gathered)
+
+
+def _perm_maps(slot, e, cap, tk):
+    """Invert the copy→slot map: (buf_src (E·cap,) int, hit (E·cap,) bool)
+    give, for every expert-buffer slot, which token-copy fills it (if any).
+
+    One int32 scatter of tk scalars. Kept copies have unique in-bounds
+    slots; dropped copies carry the OUT-OF-BOUNDS slot e*cap, which
+    mode="drop" discards — so unique_indices holds. Cheap: the expensive
+    ROW movement all happens as gathers — see _permute_rows."""
+    buf_src = jnp.full((e * cap,), tk, jnp.int32).at[slot].set(
+        jnp.arange(tk, dtype=jnp.int32), mode="drop", unique_indices=True)
+    hit = buf_src < tk
+    return jnp.where(hit, buf_src, 0), hit
+
+
+@jax.custom_vjp
+def _permute_rows(x, fwd_idx, fwd_ok, bwd_idx, bwd_ok):
+    """out[i] = fwd_ok[i] ? x[fwd_idx[i]] : 0 — a (partial) row
+    permutation whose backward is the INVERSE gather (bwd_idx/bwd_ok), so
+    neither direction lowers to an XLA scatter (TPU scatters serialize
+    row-by-row; gathers run at bandwidth). The index sets must be mutually
+    inverse over their valid entries."""
+    out = jnp.take(x, jnp.where(fwd_ok, fwd_idx, 0), axis=0)
+    return jnp.where(fwd_ok[:, None], out, 0)
+
+
+def _permute_rows_fwd(x, fwd_idx, fwd_ok, bwd_idx, bwd_ok):
+    return _permute_rows(x, fwd_idx, fwd_ok, bwd_idx, bwd_ok), \
+        (fwd_idx, fwd_ok, bwd_idx, bwd_ok)
+
+
+def _permute_rows_bwd(res, g):
+    fwd_idx, fwd_ok, bwd_idx, bwd_ok = res
+    dx = jnp.take(g, jnp.where(bwd_ok, bwd_idx, 0), axis=0)
+    dx = jnp.where(bwd_ok[:, None], dx, 0)
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return dx, f0(fwd_idx), f0(fwd_ok), f0(bwd_idx), f0(bwd_ok)
+
+
+_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
 
 
 def topk_routing(logits, k: int, capacity: int, normalize_topk: bool = True):
@@ -242,7 +299,7 @@ class MoELayer(Layer):
         gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate]
         if gate == "switch" and top_k not in (None, 1):
             raise ValueError(f"gate='switch' is top-1 routing; got top_k={top_k}")
-        if dispatch_mode not in ("scatter", "einsum", "alltoall"):
+        if dispatch_mode not in ("scatter", "sort", "einsum", "alltoall"):
             raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
         self.gate = gate_cls(hidden_size, num_experts,
                              capacity_factor=capacity_factor)
@@ -268,6 +325,28 @@ class MoELayer(Layer):
         buf, slot = _slot_scatter(xt.astype(dtype), idx, pos, keep, cap, e)
         ye = self.experts(buf.reshape(e, cap, -1)).reshape(e * cap, -1)
         yt = _slot_combine(ye, slot, vals, keep, dtype)
+        return yt, aux, stats
+
+    def _forward_sort(self, xt, dtype):
+        """Permutation dispatch: one argsort inverts the copy→slot map, and
+        both dispatch and combine run as gathers in forward AND backward
+        (custom-VJP inverse-permutation) — no XLA scatter anywhere. TPU
+        scatters serialize row-by-row; this path replaces them with
+        bandwidth-rate gathers and is the single-chip default."""
+        e = self.num_experts
+        t, h = xt.shape
+        idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)
+        k = idx.shape[1]
+        keep_f = keep.reshape(-1)
+        slot = _slots(idx, pos, keep, cap, e)
+        slot_cl = jnp.clip(slot, 0, e * cap - 1)
+        buf_src, hit = _perm_maps(slot, e, cap, t * k)
+        xt_k = _token_copies(xt.astype(dtype), k)
+        buf = _permute_rows(xt_k, buf_src, hit, slot_cl, keep_f)
+        ye = self.experts(buf.reshape(e, cap, h)).reshape(e * cap, h)
+        gathered = _permute_rows(ye, slot_cl, keep_f, buf_src, hit)
+        w = (vals * keep).astype(dtype)
+        yt = jnp.einsum("tk,tkh->th", w, gathered.reshape(t, k, h))
         return yt, aux, stats
 
     def _forward_einsum(self, xt, dtype):
@@ -392,6 +471,8 @@ class MoELayer(Layer):
             yt, aux, stats = self._forward_dropless(xt, x.dtype)
         elif self.dispatch_mode == "scatter":
             yt, aux, stats = self._forward_capacity(xt, x.dtype)
+        elif self.dispatch_mode == "sort":
+            yt, aux, stats = self._forward_sort(xt, x.dtype)
         elif self.dispatch_mode == "alltoall":
             yt, aux, stats = self._forward_alltoall(xt, x.dtype)
         else:

@@ -8,22 +8,33 @@ Layouts follow the reference: q/k/v are (batch, seq, num_heads, head_dim).
 GQA/MQA supported via num_kv_heads < num_heads. The Pallas path (blockwise
 online-softmax, fp32 accumulators, causal block skipping, LSE saved for the
 backward; dq and dk/dv backward kernels recompute probabilities per block so
-the (s, s) matrix is never materialized) is used on TPU for long sequences;
-the XLA einsum path covers everything else. Kernels compute internally in
+the (s, s) matrix is never materialized) covers, on TPU:
+
+* self-attention AND cross-attention (sq != sk, causal aligned bottom-right
+  like the reference / flash-attn-2),
+* per-batch KV valid lengths (`kv_lens` — the padding-mask form the CUDA
+  kernel takes via cu_seqlens),
+* segment ids (`segment_ids` / `kv_segment_ids` — packed-sequence masking,
+  the TPU-native equivalent of flash_attn_unpadded's varlen batches),
+
+forward and backward. Documented exclusions that ride the XLA einsum path:
+attention dropout and arbitrary dense masks. Kernels compute internally in
 (b, h, s, d) so the trailing block dims meet TPU tiling (8, 128).
 """
 
 import functools
-import logging
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NEG_INF = -1e30
 LANES = 128
+
+import logging
 
 logger = logging.getLogger("paddle_tpu.ops.flash_attention")
 _fallback_logged = False
@@ -47,8 +58,29 @@ def _repeat_kv(k, n_rep):
         b, s, h * n_rep, d)
 
 
+def _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k):
+    """Dense (b, 1, sq, sk) or (1, 1, sq, sk) bool mask for the XLA path."""
+    masks = []
+    if is_causal:
+        masks.append(jnp.tril(jnp.ones((sq, sk), bool),
+                              k=sk - sq)[None, None])
+    if kv_lens is not None:
+        masks.append((jnp.arange(sk)[None, :] <
+                      kv_lens[:, None])[:, None, None, :])
+    if seg_q is not None:
+        masks.append((seg_q[:, :, None] ==
+                      seg_k[:, None, :])[:, None])
+    if not masks:
+        return None
+    m = masks[0]
+    for extra in masks[1:]:
+        m = m & extra
+    return m
+
+
 def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
-                   dropout_p=0.0, training=True):
+                   dropout_p=0.0, training=True, kv_lens=None,
+                   seg_q=None, seg_k=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     n_rep = h // k.shape[2]
@@ -60,15 +92,21 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.promote_types(
                             q.dtype, jnp.float32)) * scale
-    if is_causal:
-        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        scores = jnp.where(causal[None, None], scores, NEG_INF)
+    structured = _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k)
+    if structured is not None:
+        scores = jnp.where(structured, scores, NEG_INF)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             scores = jnp.where(attn_mask, scores, NEG_INF)
         else:
             scores = scores + attn_mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if structured is not None and (kv_lens is not None or seg_q is not None
+                                   or sk < sq):
+        # fully-masked rows emit 0 (flash-attn-2 convention; the Pallas
+        # kernels match) instead of softmax's uniform garbage. Plain causal
+        # self-attention can't produce empty rows — skip the extra pass.
+        probs = jnp.where(structured.any(-1, keepdims=True), probs, 0.0)
     if dropout_p > 0.0 and training:
         from paddle_tpu.core import rng as _rng
         key = _rng.next_rng_key("dropout")
@@ -79,34 +117,60 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
 
 
 def flash_attention(q, k, v, dropout=0.0, causal=False, attn_mask=None,
-                    training=True, scale=None):
+                    training=True, scale=None, kv_lens=None,
+                    segment_ids=None, kv_segment_ids=None):
     """paddle.nn.functional.flash_attention parity. Returns (out, None)."""
     out = scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=dropout, is_causal=causal,
-        training=training, scale=scale)
+        training=training, scale=scale, kv_lens=kv_lens,
+        segment_ids=segment_ids, kv_segment_ids=kv_segment_ids)
     return out, None
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, scale=None):
+                                 is_causal=False, training=True, scale=None,
+                                 kv_lens=None, segment_ids=None,
+                                 kv_segment_ids=None):
+    """Attention with the fused-kernel dispatch.
+
+    TPU-native extensions beyond the reference veneer: `kv_lens` (b,) valid
+    KV lengths (padding mask), `segment_ids` (b, sq) / `kv_segment_ids`
+    (b, sk) packed-sequence masks (attention only within equal ids). Both
+    run inside the Pallas kernels; on other backends they lower to dense
+    masks on the XLA path.
+    """
     from paddle_tpu.ops import use_pallas
-    # Pallas path: TPU, self-attention, seq any multiple of 128 (block size
-    # adapts) once long enough to beat XLA. Documented exclusions that route
-    # to the XLA path by design: attention dropout (modern LLM pretraining
-    # runs attn dropout 0; the XLA path implements it) and dense/boolean
-    # masks (padding masks belong in kv lengths — round-3 kernel work).
+    seg_q = segment_ids
+    seg_k = kv_segment_ids if kv_segment_ids is not None else segment_ids
+    if (seg_q is None) != (seg_k is None):
+        raise ValueError("segment_ids and kv_segment_ids must be given "
+                         "together (or segment_ids alone when sq == sk)")
+    if (segment_ids is not None and kv_segment_ids is None
+            and q.shape[1] != k.shape[1]):
+        raise ValueError(
+            "segment_ids alone requires sq == sk; pass kv_segment_ids "
+            f"explicitly for cross-attention (sq={q.shape[1]}, "
+            f"sk={k.shape[1]})")
+    # Pallas path: TPU, seq dims multiples of 128 and long enough to beat
+    # XLA. Documented exclusions routed to XLA by design: attention dropout
+    # (modern LLM pretraining runs attn dropout 0) and arbitrary dense
+    # masks (the structured forms — causal/kv_lens/segments — are in the
+    # kernels).
     if (use_pallas() and dropout_p == 0.0 and attn_mask is None
-            and q.shape[1] == k.shape[1] and _pallas_seq_ok(q.shape[1])
+            and _pallas_seq_ok(q.shape[1], k.shape[1])
             and q.shape[-1] in (64, 128, 256)):
         try:
-            return _flash_attention_vjp(q, k, v, is_causal, scale)
+            return _flash_call(q, k, v, is_causal, scale, kv_lens,
+                               seg_q, seg_k)
         except Exception as e:
             from paddle_tpu.core.flags import flag
             if flag("FLAGS_pallas_strict"):
                 raise
             _log_fallback("forward", e)
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
-                          scale=scale, dropout_p=dropout_p, training=training)
+                          scale=scale, dropout_p=dropout_p,
+                          training=training, kv_lens=kv_lens,
+                          seg_q=seg_q, seg_k=seg_k)
 
 
 # ---- Pallas kernels (internal layout (b, h, s, d)) -------------------------
@@ -120,32 +184,105 @@ def _pick_blk(s):
     raise ValueError(f"seq {s} not a multiple of 128")
 
 
-def _fwd_kernels(qt, kt, vt, is_causal: bool, sc: float):
-    """qt/kt/vt: (b, h, s, d) → (out (b,h,s,d), lse (b,h,s)) fp32 lse."""
+def _causal_nk(qi, blk_q, blk_k, off, sk):
+    """Number of k-blocks a causal q-block attends to (bottom-right
+    aligned: q row i sees k cols <= i + off)."""
+    hi = qi * blk_q + blk_q - 1 + off          # last visible k col
+    return jnp.clip((hi // blk_k) + 1, 0, sk // blk_k)
+
+
+def _block_mask(s_blk, qi, ki, blk_q, blk_k, off, is_causal,
+                kvlen_b, segq_blk, segk_ref):
+    """Apply the structured masks to one (blk_q, blk_k) score block.
+
+    kvlen_b: scalar valid length or None; segq_blk: (blk_q, 1) ids or
+    None; segk_ref: callable ki -> (1, blk_k) ids."""
+    k_pos = ki * blk_k + lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 1)
+    if is_causal:
+        q_pos = qi * blk_q + lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        s_blk = jnp.where(q_pos + off >= k_pos, s_blk, NEG_INF)
+    if kvlen_b is not None:
+        s_blk = jnp.where(k_pos < kvlen_b, s_blk, NEG_INF)
+    if segq_blk is not None:
+        s_blk = jnp.where(segq_blk == segk_ref(ki), s_blk, NEG_INF)
+    return s_blk
+
+
+def _seg_specs():
+    """Builder for (b, 1, s) segment-id BlockSpecs: spec(blk, full) blocks
+    the axis by `blk` indexed by the grid's third dim, or takes the whole
+    `full` axis when blk is None."""
     from jax.experimental import pallas as pl
 
-    b, h, s, d = qt.shape
-    blk_q = blk_k = _pick_blk(s)
-    grid = (b, h, s // blk_q)
+    def spec(blk, full):
+        if blk is None:
+            return pl.BlockSpec((None, 1, full),
+                                lambda bi, hi, i: (bi, 0, 0))
+        return pl.BlockSpec((None, 1, blk), lambda bi, hi, i: (bi, 0, i))
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+    return spec
+
+
+def _build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, extra):
+    """Shared operand assembly: [q, k, v, (lens), (segq, segk)] + extra."""
+    ops = [qt, kt, vt]
+    if kv_lens is not None:
+        ops.append(kv_lens.astype(jnp.int32))
+    if seg_q is not None:
+        ops.append(seg_q.astype(jnp.int32)[:, None])   # (b, 1, sq)
+        ops.append(seg_k.astype(jnp.int32)[:, None])   # (b, 1, sk)
+    return ops + extra
+
+
+def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
+                 seg_k=None):
+    """qt (b,h,sq,d), kt/vt (b,h,sk,d) → (out (b,h,sq,d), lse (b,h,sq))."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    blk_q = _pick_blk(sq)
+    blk_k = _pick_blk(sk)
+    off = sk - sq
+    grid = (b, h, sq // blk_q)
+    has_len = kv_lens is not None
+    has_seg = seg_q is not None
+
+    def kernel(*refs):
+        i = 3
+        lens_ref = refs[i] if has_len else None
+        i += has_len
+        segq_ref = refs[i] if has_seg else None
+        segk_ref = refs[i + 1] if has_seg else None
+        i += 2 * has_seg
+        q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+        o_ref, lse_ref = refs[i], refs[i + 1]
+
+        bi = pl.program_id(0)
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32) * sc  # (blk_q, d)
+        kvlen_b = lens_ref[bi] if has_len else None
+        segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
+                    if has_seg else None)          # (blk_q, 1)
+        seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
+            if has_seg else None
 
         def body(ki, carry):
             acc, m_prev, l_prev = carry
             kv = k_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = qv @ kv.T  # (blk_q, blk_k)
-            if is_causal:
-                q_pos = qi * blk_q + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 0)
-                k_pos = ki * blk_k + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 1)
-                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+            s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
+                                is_causal, kvlen_b, segq_blk, seg_at)
             m_cur = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
             alpha = jnp.exp(m_prev - m_cur)
-            p = jnp.exp(s_blk - m_cur[:, None])
+            # rows with no valid entry yet keep m at NEG_INF — their p
+            # must be 0, not exp(0), so fully-masked rows emit 0
+            p = jnp.where(m_cur[:, None] <= NEG_INF * 0.5, 0.0,
+                          jnp.exp(s_blk - m_cur[:, None]))
             l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
             acc = acc * alpha[:, None] + p @ vv
             return acc, m_cur, l_cur
@@ -153,28 +290,30 @@ def _fwd_kernels(qt, kt, vt, is_causal: bool, sc: float):
         acc0 = jnp.zeros((blk_q, d), jnp.float32)
         m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
         l0 = jnp.zeros((blk_q,), jnp.float32)
-        if is_causal:
-            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k \
-                else (qi * blk_q) // blk_k + 1
-        else:
-            n_k = s // blk_k
+        n_k = _causal_nk(qi, blk_q, blk_k, off, sk) if is_causal \
+            else sk // blk_k
         acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
-        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+        lsafe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc / lsafe[:, None]).astype(o_ref.dtype)
         # TPU tiling wants 2-D trailing blocks: replicate lse across lanes
-        lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+        lse_ref[...] = jnp.broadcast_to((m + jnp.log(lsafe))[:, None],
                                         (qv.shape[0], LANES))
+
+    qspec = pl.BlockSpec((None, None, blk_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0))
+    kfull = lambda: pl.BlockSpec((None, None, sk, d),
+                                 lambda bi, hi, qi: (bi, hi, 0, 0))
+    in_specs = [qspec, kfull(), kfull()]
+    if has_len:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_seg:
+        spec = _seg_specs()
+        in_specs += [spec(blk_q, sq), spec(None, sk)]
 
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, blk_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, blk_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -182,78 +321,123 @@ def _fwd_kernels(qt, kt, vt, is_causal: bool, sc: float):
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
-            jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
         ],
-    )(qt, kt, vt)
+    )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, []))
     return out, lse
 
 
-def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
-    """dq: loop over k-blocks for each q-block. All (b,h,s,·)."""
+def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
+                   kv_lens=None, seg_q=None, seg_k=None):
+    """dq: loop over k-blocks for each q-block."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    b, h, s, d = qt.shape
-    blk_q = blk_k = _pick_blk(s)
-    grid = (b, h, s // blk_q)
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    blk_q = _pick_blk(sq)
+    blk_k = _pick_blk(sk)
+    off = sk - sq
+    grid = (b, h, sq // blk_q)
+    has_len = kv_lens is not None
+    has_seg = seg_q is not None
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref):
+    def kernel(*refs):
+        i = 3
+        lens_ref = refs[i] if has_len else None
+        i += has_len
+        segq_ref = refs[i] if has_seg else None
+        segk_ref = refs[i + 1] if has_seg else None
+        i += 2 * has_seg
+        q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+        do_ref, lse_ref, dl_ref, dq_ref = refs[i:i + 4]
+
+        bi = pl.program_id(0)
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)          # (blk_q, d)
         lse_q = lse_ref[...][:, 0]                    # (blk_q,)
         delta_q = dl_ref[...][:, 0]                   # (blk_q,)
+        kvlen_b = lens_ref[bi] if has_len else None
+        segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
+                    if has_seg else None)
+        seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
+            if has_seg else None
 
         def body(ki, dq_acc):
             kv = k_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = (qv @ kv.T) * sc
-            if is_causal:
-                q_pos = qi * blk_q + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 0)
-                k_pos = ki * blk_k + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 1)
-                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
-            p = jnp.exp(s_blk - lse_q[:, None])       # (blk_q, blk_k)
+            s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
+                                is_causal, kvlen_b, segq_blk, seg_at)
+            p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
+                          jnp.exp(s_blk - lse_q[:, None]))
             dp = do @ vv.T                            # (blk_q, blk_k)
             ds = p * (dp - delta_q[:, None])
             return dq_acc + (ds @ kv) * sc
 
-        if is_causal:
-            n_k = qi * (blk_q // blk_k) + 1 if blk_q >= blk_k \
-                else (qi * blk_q) // blk_k + 1
-        else:
-            n_k = s // blk_k
+        n_k = _causal_nk(qi, blk_q, blk_k, off, sk) if is_causal \
+            else sk // blk_k
         dq = lax.fori_loop(0, n_k, body, jnp.zeros((blk_q, d), jnp.float32))
         dq_ref[...] = dq.astype(dq_ref.dtype)
 
-    full = lambda: pl.BlockSpec((None, None, s, d),
-                                lambda bi, hi, qi: (bi, hi, 0, 0))
+    kfull = lambda: pl.BlockSpec((None, None, sk, d),
+                                 lambda bi, hi, qi: (bi, hi, 0, 0))
     qblk = lambda: pl.BlockSpec((None, None, blk_q, d),
                                 lambda bi, hi, qi: (bi, hi, qi, 0))
     row = lambda: pl.BlockSpec((None, None, blk_q, LANES),
                                lambda bi, hi, qi: (bi, hi, qi, 0))
+    in_specs = [qblk(), kfull(), kfull()]
+    if has_len:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_seg:
+        spec = _seg_specs()
+        in_specs += [spec(blk_q, sq), spec(None, sk)]
+    in_specs += [qblk(), row(), row()]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[qblk(), full(), full(), qblk(), row(), row()],
+        in_specs=in_specs,
         out_specs=qblk(),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
-    )(qt, kt, vt, dot, lse, delta)
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+    )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
+                       [dot, lse, delta]))
 
 
-def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
+def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
+                    kv_lens=None, seg_q=None, seg_k=None):
     """dk, dv: loop over q-blocks for each k-block."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    b, h, s, d = qt.shape
-    blk_q = blk_k = _pick_blk(s)
-    grid = (b, h, s // blk_k)
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    blk_q = _pick_blk(sq)
+    blk_k = _pick_blk(sk)
+    off = sk - sq
+    grid = (b, h, sk // blk_k)
+    has_len = kv_lens is not None
+    has_seg = seg_q is not None
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref):
+    def kernel(*refs):
+        i = 3
+        lens_ref = refs[i] if has_len else None
+        i += has_len
+        segq_ref = refs[i] if has_seg else None
+        segk_ref = refs[i + 1] if has_seg else None
+        i += 2 * has_seg
+        q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+        do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs[i:i + 5]
+
+        bi = pl.program_id(0)
         ki = pl.program_id(2)
         kv = k_ref[...].astype(jnp.float32)           # (blk_k, d)
         vv = v_ref[...].astype(jnp.float32)
+        kvlen_b = lens_ref[bi] if has_len else None
+        # k-side ids for THIS block, as (1, blk_k); q-side read per block
+        segk_blk = segk_ref[...] if has_seg else None
+        seg_at = (lambda _ki: segk_blk) if has_seg else None
 
         def body(qi, carry):
             dk_acc, dv_acc = carry
@@ -262,23 +446,23 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
             lse_q = lse_ref[pl.ds(qi * blk_q, blk_q), 0]
             delta_q = dl_ref[pl.ds(qi * blk_q, blk_q), 0]
             s_blk = (qv @ kv.T) * sc                  # (blk_q, blk_k)
-            if is_causal:
-                q_pos = qi * blk_q + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 0)
-                k_pos = ki * blk_k + lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 1)
-                s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
-            p = jnp.exp(s_blk - lse_q[:, None])
+            segq_blk = (jnp.transpose(
+                segq_ref[:, pl.ds(qi * blk_q, blk_q)], (1, 0))
+                if has_seg else None)
+            s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
+                                is_causal, kvlen_b, segq_blk, seg_at)
+            p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
+                          jnp.exp(s_blk - lse_q[:, None]))
             dv_acc = dv_acc + p.T @ do
             dp = do @ vv.T
             ds = p * (dp - delta_q[:, None])
             dk_acc = dk_acc + (ds.T @ qv) * sc
             return dk_acc, dv_acc
 
-        n_q = s // blk_q
+        n_q = sq // blk_q
         if is_causal:
-            # only q-blocks at or below the diagonal see this k-block
-            q0 = (ki * blk_k) // blk_q
+            # only q rows with q_pos + off >= ki*blk_k see this k-block
+            q0 = jnp.clip((ki * blk_k - off) // blk_q, 0, n_q)
         else:
             q0 = 0
         dk, dv = lax.fori_loop(q0, n_q, body,
@@ -287,20 +471,28 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal: bool, sc: float):
         dk_ref[...] = dk.astype(dk_ref.dtype)
         dv_ref[...] = dv.astype(dv_ref.dtype)
 
-    full = lambda: pl.BlockSpec((None, None, s, d),
-                                lambda bi, hi, ki: (bi, hi, 0, 0))
+    qfull = lambda: pl.BlockSpec((None, None, sq, d),
+                                 lambda bi, hi, ki: (bi, hi, 0, 0))
     kblk = lambda: pl.BlockSpec((None, None, blk_k, d),
                                 lambda bi, hi, ki: (bi, hi, ki, 0))
-    frow = lambda: pl.BlockSpec((None, None, s, LANES),
+    frow = lambda: pl.BlockSpec((None, None, sq, LANES),
                                 lambda bi, hi, ki: (bi, hi, 0, 0))
+    in_specs = [qfull(), kblk(), kblk()]
+    if has_len:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_seg:
+        spec = _seg_specs()
+        in_specs += [spec(None, sq), spec(blk_k, sk)]
+    in_specs += [qfull(), frow(), frow()]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[full(), kblk(), kblk(), full(), frow(), frow()],
+        in_specs=in_specs,
         out_specs=[kblk(), kblk()],
-        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
-                   jax.ShapeDtypeStruct((b, h, s, d), qt.dtype)],
-    )(qt, kt, vt, dot, lse, delta)
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype)],
+    )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
+                       [dot, lse, delta]))
 
 
 @functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
@@ -310,8 +502,9 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
     return out
 
 
-def _flash_fwd(q, k, v, is_causal, scale):
-    b, s, h, d = q.shape
+def _flash_fwd(q, k, v, is_causal, scale, kv_lens=None, seg_q=None,
+               seg_k=None):
+    b, sq, h, d = q.shape
     n_rep = h // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
@@ -319,30 +512,56 @@ def _flash_fwd(q, k, v, is_causal, scale):
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out_t, lse = _fwd_kernels(qt, kt, vt, is_causal, sc)
+    out_t, lse = _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=kv_lens,
+                              seg_q=seg_q, seg_k=seg_k)
     return jnp.transpose(out_t, (0, 2, 1, 3)), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_vjp(q, k, v, is_causal, scale):
+def _float0_like(a):
+    return np.zeros(a.shape, jax.dtypes.float0) if a is not None else None
+
+
+def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k):
+    """Differentiable entry covering all structured-mask forms."""
+    flags = (kv_lens is not None, seg_q is not None)
+    dummy_len = kv_lens if flags[0] else jnp.zeros((1,), jnp.int32)
+    dummy_sq = seg_q if flags[1] else jnp.zeros((1, 1), jnp.int32)
+    dummy_sk = seg_k if flags[1] else jnp.zeros((1, 1), jnp.int32)
+    return _flash_vjp_entry(q, k, v, dummy_len, dummy_sq, dummy_sk,
+                            flags, is_causal, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash_vjp_entry(q, k, v, kv_lens, seg_q, seg_k, flags, is_causal,
+                     scale):
     """Pallas forward + Pallas backward (dq / dk+dv block kernels)."""
-    out, _ = _flash_fwd(q, k, v, is_causal, scale)
+    has_len, has_seg = flags
+    out, _ = _flash_fwd(q, k, v, is_causal, scale,
+                        kv_lens=kv_lens if has_len else None,
+                        seg_q=seg_q if has_seg else None,
+                        seg_k=seg_k if has_seg else None)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, is_causal, scale):
-    out, lse = _flash_fwd(q, k, v, is_causal, scale)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, kv_lens, seg_q, seg_k, flags, is_causal, scale):
+    has_len, has_seg = flags
+    out, lse = _flash_fwd(q, k, v, is_causal, scale,
+                          kv_lens=kv_lens if has_len else None,
+                          seg_q=seg_q if has_seg else None,
+                          seg_k=seg_k if has_seg else None)
+    return out, (q, k, v, out, lse, kv_lens, seg_q, seg_k)
 
 
-def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None):
-    """Shared Pallas backward. `lse` is (b, h, s, LANES). When `g_lse`
-    (b, h, s) is given (cotangent on the returned LSE, e.g. from a ring
+def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
+                     kv_lens=None, seg_q=None, seg_k=None):
+    """Shared Pallas backward. `lse` is (b, h, sq, LANES). When `g_lse`
+    (b, h, sq) is given (cotangent on the returned LSE, e.g. from a ring
     merge), it folds into the softmax-grad correction: dS = P·(dP − Δ)
     with Δ_eff = rowsum(dout·out) − g_lse, since ∂lse/∂S = P."""
-    b, s, h, d = q.shape
+    b, sq, h, d = q.shape
     n_kv = k.shape[2]
     n_rep = h // n_kv
+    sk = k.shape[1]
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
     kr = _repeat_kv(k, n_rep)
@@ -358,45 +577,59 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None):
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
-    dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc)
-    dk_t, dv_t = _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc)
+    kw = dict(kv_lens=kv_lens, seg_q=seg_q, seg_k=seg_k)
+    dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc, **kw)
+    dk_t, dv_t = _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal,
+                                 sc, **kw)
 
     from_t = lambda x: jnp.transpose(x, (0, 2, 1, 3))
     dq = from_t(dq_t).astype(q.dtype)
     dk = from_t(dk_t)
     dv = from_t(dv_t)
     if n_rep != 1:    # GQA: sum grads over the repeated head groups
-        dk = dk.reshape(b, s, n_kv, n_rep, d).sum(axis=3)
-        dv = dv.reshape(b, s, n_kv, n_rep, d).sum(axis=3)
+        dk = dk.reshape(b, sk, n_kv, n_rep, d).sum(axis=3)
+        dv = dv.reshape(b, sk, n_kv, n_rep, d).sum(axis=3)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_vjp_bwd(is_causal, scale, res, g):
-    q, k, v, out, lse = res
+def _flash_vjp_bwd(flags, is_causal, scale, res, g):
+    q, k, v, out, lse, kv_lens, seg_q, seg_k = res
+    has_len, has_seg = flags
+    kw = dict(kv_lens=kv_lens if has_len else None,
+              seg_q=seg_q if has_seg else None,
+              seg_k=seg_k if has_seg else None)
     try:
-        return _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale)
+        dq, dk, dv = _pallas_bwd_impl(q, k, v, out, lse, g, is_causal,
+                                      scale, **kw)
     except Exception as e:
         from paddle_tpu.core.flags import flag
         if flag("FLAGS_pallas_strict"):
             raise
         _log_fallback("backward", e)
         _, pull = jax.vjp(
-            lambda q_, k_, v_: _xla_attention(q_, k_, v_,
-                                              is_causal=is_causal,
-                                              scale=scale, dropout_p=0.0),
+            lambda q_, k_, v_: _xla_attention(
+                q_, k_, v_, is_causal=is_causal, scale=scale, dropout_p=0.0,
+                **kw),
             q, k, v)
-        return pull(g)
+        dq, dk, dv = pull(g)
+    return (dq, dk, dv, _float0_like(res[5]), _float0_like(res[6]),
+            _float0_like(res[7]))
 
 
-_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_vjp_entry.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+# Back-compat alias used by benches/tests: plain self-attention entry.
+def _flash_attention_vjp(q, k, v, is_causal, scale):
+    return _flash_call(q, k, v, is_causal, scale, None, None, None)
 
 
 # ---- forward + LSE (ring-attention building block) ------------------------
 
-def _pallas_seq_ok(s: int) -> bool:
+def _pallas_seq_ok(sq: int, sk: Optional[int] = None) -> bool:
     """Shared dispatch predicate: long enough to beat XLA and divisible by
     a supported block size (see _pick_blk)."""
-    return s >= 1024 and s % 128 == 0
+    sk = sq if sk is None else sk
+    return (max(sq, sk) >= 1024 and sq % 128 == 0 and sk % 128 == 0)
 
 
 def _pallas_lse_ok(q, k):

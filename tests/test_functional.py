@@ -107,6 +107,56 @@ def test_attention_gqa():
     assert out.shape == (b, s, hq, d)
 
 
+def test_attention_kv_lens_masks_padding():
+    """kv_lens=L must equal slicing k/v to length L."""
+    b, s, h, d = 2, 16, 2, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v,
+                                         kv_lens=jnp.asarray([10, 16]))
+    ref0 = F.scaled_dot_product_attention(q[:1], k[:1, :10], v[:1, :10])
+    ref1 = F.scaled_dot_product_attention(q[1:], k[1:], v[1:])
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref0[0]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref1[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_attention_segment_ids_block_diagonal():
+    """Packed segments == running each segment separately."""
+    b, s, h, d = 1, 12, 2, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    seg = jnp.asarray([[0] * 5 + [1] * 7])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                         segment_ids=seg)
+    ref_a = F.scaled_dot_product_attention(q[:, :5], k[:, :5], v[:, :5],
+                                           is_causal=True)
+    ref_b = F.scaled_dot_product_attention(q[:, 5:], k[:, 5:], v[:, 5:],
+                                           is_causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :5]), np.asarray(ref_a),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[:, 5:]), np.asarray(ref_b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_attention_cross_causal_bottom_right():
+    """Causal cross-attention aligns bottom-right; fully-masked rows are 0."""
+    b, sq, sk, h, d = 1, 6, 4, 2, 8
+    q = jnp.asarray(rs.randn(b, sq, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, sk, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, sk, h, d).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    # rows 0..sq-sk-1 see nothing -> exactly 0 (flash-attn-2 convention)
+    np.testing.assert_array_equal(np.asarray(out[:, :sq - sk]), 0.0)
+    # the last row sees everything
+    ref = F.scaled_dot_product_attention(q[:, -1:], k, v)
+    np.testing.assert_allclose(np.asarray(out[:, -1:]), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_rope():
     from paddle_tpu.ops.rope import fused_rotary_position_embedding, rope_cos_sin
     b, s, h, d = 2, 8, 2, 16

@@ -68,6 +68,39 @@ def ep_fleet():
     set_hybrid_communicate_group(None)
 
 
+@pytest.mark.parametrize("mode", ["sort", "einsum"])
+def test_dispatch_modes_match_scatter(mode):
+    """Every dispatch mode computes the same function (fwd + grads)."""
+    paddle_tpu.seed(0)
+    ref = MoELayer(64, 128, 4, top_k=2, dispatch_mode="scatter")
+    st = ref.trainable_state()
+    alt = MoELayer(64, 128, 4, top_k=2, dispatch_mode=mode)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 64), jnp.float32)
+
+    def loss(m, s):
+        y, aux = functional_call(m, s, x)
+        return jnp.sum(y ** 2) + aux
+
+    l1, g1 = jax.value_and_grad(lambda s: loss(ref, s))(st)
+    l2, g2 = jax.value_and_grad(lambda s: loss(alt, s))(st)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_dropless_constructs_and_drops_nothing():
+    """Regression: dropless (ep_axes=()) once crashed in _ep_spec; and the
+    ragged path must report a zero dropped fraction."""
+    paddle_tpu.seed(0)
+    layer = MoELayer(32, 64, 4, top_k=2, dropless=True,
+                     capacity_factor=0.25)     # tiny capacity: irrelevant
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 8, 32), jnp.float32)
+    out, aux, stats = layer(x, return_stats=True)
+    assert out.shape == x.shape
+    assert float(stats["moe_dropped_fraction"]) == 0.0
+
+
 def test_mixtral_ep_sharded_matches_dense(ep_fleet):
     f, s = ep_fleet
     cfg = MixtralConfig.tiny()

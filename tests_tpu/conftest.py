@@ -31,9 +31,10 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _strict_pallas():
-    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.flags import get_flags, set_flags
+    prior = get_flags(["FLAGS_pallas_strict", "FLAGS_use_pallas_kernels"])
     set_flags({"FLAGS_pallas_strict": True, "FLAGS_use_pallas_kernels": True})
     import paddle_tpu
     paddle_tpu.seed(0)
     yield
-    set_flags({"FLAGS_pallas_strict": False})
+    set_flags(prior)
