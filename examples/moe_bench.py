@@ -108,13 +108,8 @@ def main():
             with jax.profiler.trace("/tmp/moe_bench_prof"):
                 state, opt_state, losses = run(state, opt_state)
                 float(losses[-1])
-            for pl_ in xplane.load_latest("/tmp/moe_bench_prof"):
-                for ln in pl_.lines:
-                    if ln.name == "XLA Modules":
-                        tot = sum(ev.duration_ps for ev in ln.events
-                                  if "jit_run" in ev.name)
-                        if tot:
-                            dt_dev = tot / 1e12
+            dt_dev = xplane.device_total_seconds("/tmp/moe_bench_prof",
+                                                 "jit_run")
         except Exception:
             pass
 

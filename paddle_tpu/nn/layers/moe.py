@@ -328,11 +328,11 @@ class MoELayer(Layer):
         return yt, aux, stats
 
     def _forward_sort(self, xt, dtype):
-        """Permutation dispatch: one argsort inverts the copy→slot map, and
-        both dispatch and combine run as gathers in forward AND backward
-        (custom-VJP inverse-permutation) — no XLA scatter anywhere. TPU
-        scatters serialize row-by-row; this path replaces them with
-        bandwidth-rate gathers and is the single-chip default."""
+        """Permutation dispatch: one cheap int32 SCALAR scatter builds the
+        inverse copy→slot map (_perm_maps), then dispatch and combine run
+        as row gathers in forward AND backward (custom-VJP
+        inverse-permutation) — no ROW scatter anywhere. TPU row-scatters
+        serialize; gathers run near bandwidth. Single-chip default."""
         e = self.num_experts
         t, h = xt.shape
         idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)

@@ -292,6 +292,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         l0 = jnp.zeros((blk_q,), jnp.float32)
         n_k = _causal_nk(qi, blk_q, blk_k, off, sk) if is_causal \
             else sk // blk_k
+        if has_len:   # skip k-blocks entirely past the valid length
+            n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
         acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
         lsafe = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc / lsafe[:, None]).astype(o_ref.dtype)
@@ -379,6 +381,8 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
 
         n_k = _causal_nk(qi, blk_q, blk_k, off, sk) if is_causal \
             else sk // blk_k
+        if has_len:
+            n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
         dq = lax.fori_loop(0, n_k, body, jnp.zeros((blk_q, d), jnp.float32))
         dq_ref[...] = dq.astype(dq_ref.dtype)
 

@@ -238,3 +238,18 @@ def export_chrome_trace(log_dir: str, out_path: Optional[str] = None) -> str:
     with open(out_path, "w") as f:
         json.dump(to_chrome_trace(planes), f)
     return out_path
+
+
+def device_total_seconds(log_dir: str, name_substr: str) -> Optional[float]:
+    """Total device execution seconds of modules whose name contains
+    `name_substr`, from the latest trace in log_dir ('XLA Modules' line).
+    Returns None when no matching events exist. Shared by the benches —
+    device-clock timing is immune to the remote tunnel's dispatch
+    latency."""
+    total = 0
+    for plane in load_latest(log_dir):
+        for line in plane.lines:
+            if line.name == "XLA Modules":
+                total += sum(e.duration_ps for e in line.events
+                             if name_substr in e.name)
+    return total / 1e12 if total else None
