@@ -366,7 +366,9 @@ class MoELayer(Layer):
         the exchange to combine.
 
         Requires an active hybrid mesh whose `ep_axes` product divides
-        num_experts; tokens must be shardable over that axis.
+        num_experts; tokens must be shardable over that axis. Composes
+        with mp_degree > 1: each expert's FFN is column/row-sharded over
+        the mp axis inside the same shard_map (psum on the down-proj).
 
         CPU-sim caveat: XLA:CPU runs one thread per simulated device with
         a 40 s collective-rendezvous timeout; on a single-core host, long
@@ -389,11 +391,7 @@ class MoELayer(Layer):
             raise NotImplementedError("alltoall dispatch supports one EP axis")
         axis = ep[0]
         mp_axis = self.experts.mp_axis
-        if mp_axis in mesh.shape and mesh.shape[mp_axis] > 1:
-            raise NotImplementedError(
-                "dispatch_mode='alltoall' replicates expert FFNs over the "
-                f"'{mp_axis}' axis; with mp_degree > 1 use the 'scatter' "
-                "path (GSPMD shards the expert FFN contraction)")
+        mp_deg = mesh.shape.get(mp_axis, 1)
         pdim = mesh.shape[axis]
         e = self.num_experts
         if e % pdim or xt.shape[0] % pdim:
@@ -408,7 +406,11 @@ class MoELayer(Layer):
         top_k = self.gate.top_k
 
         def body(xt_loc, gate_w, wg, wu, wd):
-            # xt_loc (T_loc, h); expert weights sharded dim0 over the axis
+            # xt_loc (T_loc, h); expert weights sharded dim0 over the EP
+            # axis and (when mp_deg > 1) the ffn dim over the mp axis —
+            # each device holds a column slice of its local experts' FFNs
+            # and the down-proj partial sums reduce over mp (Megatron-style
+            # TP inside each expert, composed with EP alltoall)
             h = xt_loc.shape[-1]
             logits = jnp.matmul(xt_loc.astype(jnp.float32),
                                 gate_w.astype(jnp.float32))
@@ -424,6 +426,8 @@ class MoELayer(Layer):
             xe = recv.reshape(pdim, e_loc, cap, h).transpose(1, 0, 2, 3) \
                 .reshape(e_loc, pdim * cap, h)
             ye = _swiglu(xe, wg, wu, wd)
+            if mp_deg > 1:      # reduce the ffn-sharded contraction
+                ye = jax.lax.psum(ye, mp_axis)
             # reverse exchange
             back = ye.reshape(e_loc, pdim, cap, h).transpose(1, 0, 2, 3) \
                 .reshape(pdim, e_loc * cap, h)
@@ -434,10 +438,13 @@ class MoELayer(Layer):
             # aux is a per-shard mean over local tokens; average over shards
             return yt, jax.lax.pmean(aux, axis)
 
-        espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
+        mp_s = mp_axis if mp_deg > 1 else None
         yt, aux = shard_map(
             body, mesh=mesh,
-            in_specs=(P(axis), P(), espec(3), espec(3), espec(3)),
+            in_specs=(P(axis), P(),
+                      P(axis, None, mp_s),     # w_gate (E, h, f)
+                      P(axis, None, mp_s),     # w_up
+                      P(axis, mp_s, None)),    # w_down (E, f, h)
             out_specs=(P(axis), P()),
             check_vma=False)(xt, gate_w, wg, wu, wd)
         return yt.astype(dtype), aux, None

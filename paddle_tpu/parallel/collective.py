@@ -20,11 +20,14 @@ async dispatch makes every call non-blocking already, so ops return arrays and
 Multi-process semantics: when `jax.process_count() > 1` (after
 `init_parallel_env` / `jax.distributed.initialize`), the eager functions
 switch from the single-process stacked-per-rank convention to true
-cross-process collectives over `multihost_utils` — each process passes its
-LOCAL value and receives the collective result, matching the reference's
-ProcessGroup semantics. Point-to-point `send`/`recv` have no eager
-multi-process implementation (use in-jit `ppermute`); they raise rather
-than silently compute garbage.
+cross-process collectives — each process passes its LOCAL value and
+receives the collective result, matching the reference's ProcessGroup
+semantics. The world group on 1-device processes rides
+`multihost_utils.process_allgather`; subgroups, multi-device hosts, and
+eager point-to-point `send`/`recv` ride the coordination-service KV
+exchange (`_kv_put_get` — the TCPStore analog, control-plane sizes).
+`src`/`dst` arguments are GLOBAL process ranks everywhere, like the
+reference.
 """
 
 from typing import List, Optional, Sequence
@@ -51,20 +54,41 @@ def _multiprocess() -> bool:
 
 
 class Group:
-    """A communicator: an ordered set of devices with a private 1-D mesh."""
+    """A communicator: an ordered set of devices with a private 1-D mesh.
 
-    def __init__(self, devices: Sequence, name: str = "group"):
+    Multi-process mode additionally carries PROCESS-group semantics:
+    `process_ranks` is the ordered set of member process indices (eager
+    collectives exchange one value per PROCESS), `pg_rank` is this
+    process's position in it (-1 when not a member), `pg_size` the member
+    count. Single-process SPMD keeps the stacked-per-rank device forms."""
+
+    def __init__(self, devices: Sequence, name: str = "group",
+                 process_ranks: Optional[Sequence[int]] = None):
         self.devices = list(devices)
         self.nranks = len(self.devices)
         self.name = name
         self.mesh = Mesh(np.asarray(self.devices), axis_names=("g",))
-        # single-process SPMD: all group members live here (rank 0);
-        # multi-process: this process's rank in the world
-        self.rank = jax.process_index() if _multiprocess() else 0
+        if _multiprocess():
+            self.process_ranks = (list(process_ranks)
+                                  if process_ranks is not None
+                                  else list(range(jax.process_count())))
+            me = jax.process_index()
+            self.pg_rank = (self.process_ranks.index(me)
+                            if me in self.process_ranks else -1)
+            self.pg_size = len(self.process_ranks)
+            self.rank = jax.process_index()
+        else:
+            self.process_ranks = [0]
+            self.pg_rank = 0
+            self.pg_size = 1
+            self.rank = 0
 
     @property
     def world_size(self):
         return self.nranks
+
+    def is_member(self):
+        return self.pg_rank >= 0 or not _multiprocess()
 
     def __repr__(self):
         return f"Group(nranks={self.nranks}, name={self.name!r})"
@@ -81,11 +105,24 @@ def _get_group(group: Optional[Group]) -> Group:
     return _default_group[0]
 
 
+_group_counter = [0]
+
+
 def new_group(ranks=None, backend=None, name="group") -> Group:
+    """Create a communicator. `ranks` are DEVICE indices in single-process
+    SPMD and PROCESS indices in multi-process mode (reference ProcessGroup
+    semantics — each process contributes one value)."""
+    _group_counter[0] += 1
+    uname = f"{name}#{_group_counter[0]}"
     devs = jax.devices()
+    if _multiprocess():
+        if ranks is None:
+            ranks = list(range(jax.process_count()))
+        gdevs = [d for d in devs if d.process_index in set(ranks)]
+        return Group(gdevs, name=uname, process_ranks=ranks)
     if ranks is None:
         ranks = list(range(len(devs)))
-    return Group([devs[r] for r in ranks], name=name)
+    return Group([devs[r] for r in ranks], name=uname)
 
 
 def _sharded_over_group(x, g: Group):
@@ -113,22 +150,88 @@ def _mp_utils():
     return multihost_utils
 
 
-def _mp_world_only(g: Group, opname: str):
-    # The eager multi-process path gathers per PROCESS; with several local
-    # devices per process the rank arithmetic below would silently mix
-    # process and device indices — refuse loudly (in-jit shard_map
-    # collectives are the supported path on pod slices).
-    if jax.local_device_count() != 1:
-        raise NotImplementedError(
-            f"{opname}: eager multi-process collectives support only "
-            f"1 device per process (local_device_count="
-            f"{jax.local_device_count()}); use in-jit collectives "
-            "(shard_map/psum) for multi-device hosts")
-    enforce(g.nranks == jax.process_count(),
-            f"{opname}: eager multi-process collectives support only the "
-            f"world group (got nranks={g.nranks}, "
-            f"world={jax.process_count()});"
-            " use in-jit shard_map collectives for subgroups")
+def _is_world(g: Group) -> bool:
+    return g.process_ranks == list(range(jax.process_count()))
+
+
+def _fast_world_path(g: Group) -> bool:
+    """multihost_utils.process_allgather is the fast path, but it is a
+    WORLD collective over one-device processes; subgroups and multi-device
+    hosts ride the coordination-service KV exchange instead."""
+    return _is_world(g) and jax.local_device_count() == 1
+
+
+def _member_only(g: Group, opname: str):
+    if not g.is_member():
+        raise RuntimeError(
+            f"{opname}: process {jax.process_index()} is not a member of "
+            f"group {g.name!r} (ranks {g.process_ranks}) — only member "
+            "processes may enter a collective")
+
+
+# ---- coordination-service exchange (subgroups / multi-device hosts / p2p)
+# The jax.distributed coordination service doubles as the reference's
+# TCPStore: small-tensor eager exchange for setup/debug flows. The data
+# plane (training collectives) stays in-jit over ICI — these veneers are
+# the ported-user-code story, not the fast path. Keys are sequence-
+# numbered per tag; members must call in the same order (standard
+# ProcessGroup contract). Values transit base64-encoded npy bytes.
+
+_kv_seq: dict = {}
+
+
+def _kv_client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    enforce(client is not None, "jax.distributed is not initialized")
+    return client
+
+
+def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
+                consume=False):
+    """Post `payload` (np array) as rank `me` (skipped when payload is
+    None — pure receive), fetch each rank in `peers`.
+
+    Garbage collection: entering sequence s proves every member finished
+    call s-1 (their keys existed), hence completed call s-2 — so each
+    rank deletes its OWN s-2 key. `consume=True` (single-reader p2p)
+    deletes a fetched key immediately."""
+    import base64
+    import io
+
+    client = _kv_client()
+    seq = _kv_seq.get(tag, 0)
+    _kv_seq[tag] = seq + 1
+    if payload is not None:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(payload), allow_pickle=False)
+        client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
+                             base64.b64encode(buf.getvalue()).decode("ascii"))
+        if seq >= 2:
+            try:
+                client.key_value_delete(f"ptkv/{tag}/{seq - 2}/{me}")
+            except Exception:
+                pass
+    out = {}
+    for r in peers:
+        key = f"ptkv/{tag}/{seq}/{r}"
+        raw = client.blocking_key_value_get(key, timeout_ms)
+        out[r] = np.load(io.BytesIO(base64.b64decode(raw)),
+                         allow_pickle=False)
+        if consume:
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+    return out
+
+
+def _kv_allgather(g: Group, x, opname: str):
+    """(pg_size, ...) stack of every member process's value."""
+    _member_only(g, opname)
+    vals = _kv_put_get(f"{g.name}/{opname}", x, g.pg_rank,
+                       range(g.pg_size))
+    return jnp.asarray(np.stack([vals[r] for r in range(g.pg_size)]))
 
 
 _MP_REDUCERS = {
@@ -146,8 +249,10 @@ def all_reduce(x, op=ReduceOp.SUM, group=None, sync_op=True):
     the cross-process reduction."""
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "all_reduce")
-        gathered = _mp_utils().process_allgather(x)     # (nprocs, ...)
+        if _fast_world_path(g):
+            gathered = _mp_utils().process_allgather(x)  # (nprocs, ...)
+        else:
+            gathered = _kv_allgather(g, x, "all_reduce")
         return _MP_REDUCERS[op](gathered, axis=0).astype(x.dtype)
     if g.nranks == 1:
         return x
@@ -177,13 +282,15 @@ def all_gather(tensor_list_or_x, x=None, group=None, sync_op=True, axis=0):
         out_list, x = None, tensor_list_or_x
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "all_gather")
-        res = _mp_utils().process_allgather(x)          # (nprocs, ...)
+        if _fast_world_path(g):
+            res = _mp_utils().process_allgather(x)      # (nprocs, ...)
+        else:
+            res = _kv_allgather(g, x, "all_gather")
     else:
         res = x  # already globally visible in single-process SPMD
     if out_list is not None:
-        for i in range(g.nranks):
-            out_list.append(res[i])
+        for i in range(res.shape[0]):   # rows = processes (multi-process)
+            out_list.append(res[i])     # or device ranks (single-process)
         return out_list
     return res
 
@@ -200,9 +307,15 @@ def reduce(x, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def broadcast(x, src=0, group=None, sync_op=True):
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "broadcast")
-        return _mp_utils().broadcast_one_to_all(
-            x, is_source=jax.process_index() == src)
+        if _fast_world_path(g):
+            return _mp_utils().broadcast_one_to_all(
+                x, is_source=jax.process_index() == src)
+        _member_only(g, "broadcast")
+        src_pg = g.process_ranks.index(src)   # src is a GLOBAL rank
+        vals = _kv_put_get(f"{g.name}/broadcast",
+                           x if g.pg_rank == src_pg else None,
+                           g.pg_rank, [src_pg])
+        return jnp.asarray(vals[src_pg])
     if g.nranks == 1:
         return x
     src_slice = x[src]
@@ -218,15 +331,13 @@ def scatter(x, tensor_list=None, src=0, group=None, sync_op=True):
     (setup/debug); inside jit, GSPMD sharding is the fast path."""
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "scatter")
-        if tensor_list is not None and jax.process_index() == src:
-            stacked = jnp.stack(tensor_list)
-        else:
-            # non-src ranks contribute only the output shape
-            stacked = jnp.broadcast_to(x[None], (g.nranks,) + tuple(x.shape))
-        data = _mp_utils().broadcast_one_to_all(
-            stacked, is_source=jax.process_index() == src)
-        return data[g.rank]
+        _member_only(g, "scatter")
+        src_pg = g.process_ranks.index(src)   # src is a GLOBAL rank
+        stacked = (np.stack([np.asarray(t) for t in tensor_list])
+                   if g.pg_rank == src_pg else None)
+        vals = _kv_put_get(f"{g.name}/scatter", stacked, g.pg_rank,
+                           [src_pg])
+        return jnp.asarray(vals[src_pg][g.pg_rank])
     if tensor_list is not None:
         return jnp.stack(tensor_list)[g.rank] if g.nranks > 1 else tensor_list[0]
     return x
@@ -238,11 +349,13 @@ def reduce_scatter(x, op=ReduceOp.SUM, group=None, sync_op=True):
     this rank's reduced (chunk, ...) slice."""
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "reduce_scatter")
-        gathered = _mp_utils().process_allgather(x)
+        if _fast_world_path(g):
+            gathered = _mp_utils().process_allgather(x)
+        else:
+            gathered = _kv_allgather(g, x, "reduce_scatter")
         reduced = _MP_REDUCERS[op](gathered, axis=0).astype(x.dtype)
-        chunk = reduced.shape[0] // g.nranks
-        return reduced[g.rank * chunk:(g.rank + 1) * chunk]
+        chunk = reduced.shape[0] // g.pg_size
+        return reduced[g.pg_rank * chunk:(g.pg_rank + 1) * chunk]
     if g.nranks == 1:
         return x
     x = _sharded_over_group(x, g)
@@ -268,9 +381,11 @@ def alltoall(x, group=None, sync_op=True):
     (nranks, ...) — row j is rank j's chunk for this rank."""
     g = _get_group(group)
     if _multiprocess():
-        _mp_world_only(g, "alltoall")
-        gathered = _mp_utils().process_allgather(x)     # (nprocs, nranks, ...)
-        return gathered[:, g.rank]
+        if _fast_world_path(g):
+            gathered = _mp_utils().process_allgather(x)  # (np, nranks, ...)
+        else:
+            gathered = _kv_allgather(g, x, "alltoall")
+        return gathered[:, g.pg_rank]
     if g.nranks == 1:
         return x
     return jnp.swapaxes(x, 0, 1)
@@ -280,29 +395,38 @@ all_to_all = alltoall
 
 
 def send(x, dst=0, group=None, sync_op=True):
+    """Eager point-to-point. Multi-process: the payload rides the
+    coordination-service KV store (control-plane sizes; in-jit
+    lax.ppermute / the pipeline schedules are the data plane)."""
     g = _get_group(group)
     if _multiprocess():
-        raise NotImplementedError(
-            "eager send() has no multi-process implementation on TPU — "
-            "point-to-point transfers belong inside jit (lax.ppermute / "
-            "pipeline schedules); refusing to silently no-op")
+        _member_only(g, "send")
+        me = jax.process_index()              # GLOBAL ranks in p2p tags
+        _kv_put_get(f"{g.name}/p2p/{me}->{dst}", x, me, [])
+        return x
     # Point-to-point outside jit is a device_put in single-process SPMD.
     return jax.device_put(x, g.devices[dst])
 
 
 def recv(x, src=0, group=None, sync_op=True):
+    """Eager point-to-point receive (see send)."""
+    g = _get_group(group)
     if _multiprocess():
-        raise NotImplementedError(
-            "eager recv() has no multi-process implementation on TPU — "
-            "point-to-point transfers belong inside jit (lax.ppermute / "
-            "pipeline schedules); refusing to silently no-op")
+        _member_only(g, "recv")
+        me = jax.process_index()
+        vals = _kv_put_get(f"{g.name}/p2p/{src}->{me}", None, None,
+                           [src], consume=True)
+        return jnp.asarray(vals[src]).astype(x.dtype).reshape(x.shape)
     return x
 
 
 def barrier(group=None):
     g = _get_group(group)
     if _multiprocess():
-        _mp_utils().sync_global_devices("paddle_tpu.barrier")
+        if _is_world(g):
+            _mp_utils().sync_global_devices("paddle_tpu.barrier")
+        else:
+            _kv_allgather(g, np.zeros((), np.int8), "barrier")
         return
     jax.block_until_ready(jnp.zeros((), jnp.int32))
 
