@@ -163,3 +163,48 @@ def householder_product(x, tau):
                       jnp.where(jnp.arange(m) == i, 1.0, x[..., i]))
         Q = Q - tau[..., i] * (Q @ v)[..., None] * v[None, :].conj()
     return Q[..., :n]
+
+
+# ---- round-3 long tail (VERDICT r2 #7) -------------------------------------
+
+def vector_norm(x, ord=2, axis=None, keepdim=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, ord="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdim)
+
+
+def vecdot(x, y, axis=-1):
+    return jnp.vecdot(x, y, axis=axis)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def solve_triangular(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def tensorinv(x, ind=2):
+    return jnp.linalg.tensorinv(x, ind=ind)
+
+
+def tensorsolve(x, y, axes=None):
+    return jnp.linalg.tensorsolve(x, y, axes=axes)

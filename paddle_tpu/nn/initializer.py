@@ -128,3 +128,35 @@ class KaimingUniform(Initializer):
         k = _rng.next_rng_key("params")
         return jax.random.uniform(k, tuple(shape), minval=-limit,
                                   maxval=limit).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference paddle.nn.initializer.Dirac):
+    out channel i passes through in channel i at the kernel center."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, key, shape, dtype):
+        w = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        ocpg = oc // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        # per group g, diagonal d < min(oc_per_group, in_channels)
+        for g in range(self.groups):
+            for d in range(min(ocpg, ic)):
+                w[(g * ocpg + d, d) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference paddle.nn.initializer.Orthogonal)."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        q = jax.nn.initializers.orthogonal(self.gain, column_axis=-1)(
+            key, (rows, cols), jnp.float32)
+        return q.reshape(shape).astype(dtype)

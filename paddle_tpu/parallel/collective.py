@@ -188,14 +188,17 @@ def _kv_client():
 
 
 def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
-                consume=False):
+                consume=False, gc=False):
     """Post `payload` (np array) as rank `me` (skipped when payload is
     None — pure receive), fetch each rank in `peers`.
 
-    Garbage collection: entering sequence s proves every member finished
-    call s-1 (their keys existed), hence completed call s-2 — so each
-    rank deletes its OWN s-2 key. `consume=True` (single-reader p2p)
-    deletes a fetched key immediately."""
+    Garbage collection (`gc=True` — ONLY valid for allgather-style calls
+    where every member fetches from every member): entering sequence s
+    then proves every member finished call s-1, hence consumed the s-2
+    keys — each rank deletes its OWN s-2 key. One-way ops (send/
+    broadcast/scatter) must NOT gc (a slow reader may not have consumed
+    old keys); p2p recv passes `consume=True` instead (single reader
+    deletes the key after reading)."""
     import base64
     import io
 
@@ -207,7 +210,7 @@ def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
         np.save(buf, np.asarray(payload), allow_pickle=False)
         client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
                              base64.b64encode(buf.getvalue()).decode("ascii"))
-        if seq >= 2:
+        if gc and seq >= 2:
             try:
                 client.key_value_delete(f"ptkv/{tag}/{seq - 2}/{me}")
             except Exception:
@@ -230,7 +233,7 @@ def _kv_allgather(g: Group, x, opname: str):
     """(pg_size, ...) stack of every member process's value."""
     _member_only(g, opname)
     vals = _kv_put_get(f"{g.name}/{opname}", x, g.pg_rank,
-                       range(g.pg_size))
+                       range(g.pg_size), gc=True)
     return jnp.asarray(np.stack([vals[r] for r in range(g.pg_size)]))
 
 
@@ -381,6 +384,12 @@ def alltoall(x, group=None, sync_op=True):
     (nranks, ...) — row j is rank j's chunk for this rank."""
     g = _get_group(group)
     if _multiprocess():
+        # per-PROCESS semantics: x carries pg_size rows, one chunk per
+        # member process (multi-device hosts exchange per process, not
+        # per device — in-jit shard_map alltoall is the per-device path)
+        enforce(x.shape[0] == g.pg_size,
+                f"alltoall: leading dim {x.shape[0]} != process-group "
+                f"size {g.pg_size} (eager alltoall is per-process)")
         if _fast_world_path(g):
             gathered = _mp_utils().process_allgather(x)  # (np, nranks, ...)
         else:

@@ -505,8 +505,13 @@ def shape(x):
 
 from paddle_tpu.tensor.math_ops import *        # noqa: F401,F403,E402
 from paddle_tpu.tensor.manipulation_ops import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor.extra_ops import *  # noqa: F401,F403,E402
 from paddle_tpu.linalg import (  # noqa: F401,E402
     cholesky,
+    corrcoef,
+    cov,
+    cross,
+    vander,
     cholesky_solve,
     eig,
     eigvals,

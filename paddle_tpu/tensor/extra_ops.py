@@ -1,0 +1,390 @@
+"""Tensor-API long tail (VERDICT r2 #7) — the breadth users trip on when
+porting: set ops, window/sliding ops, masked scatter forms, complex views,
+batched matmul variants, statistics.
+
+Reference: python/paddle/tensor/{math,manipulation,linalg,stat}.py veneers
+over phi kernels (SURVEY.md §2.7 counts ~400 public tensor functions).
+Each op here is a jnp composition XLA fuses; ops with data-dependent
+output shapes (unique_consecutive, combinations' input) follow the same
+eager-outside-jit contract as `tensor.unique`.
+"""
+
+import itertools
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "as_strided", "baddbmm", "block_diag", "bucketize", "cartesian_prod",
+    "combinations", "cumulative_trapezoid", "diagonal_scatter", "fliplr",
+    "flipud", "frac_", "histogramdd", "hypot", "index_fill", "index_sample",
+    "is_complex", "is_floating_point", "is_integer", "isin", "logaddexp2",
+    "logit", "masked_scatter", "mm", "mode", "mv", "nanquantile", "pdist",
+    "pinverse", "polar", "positive", "ravel", "renorm", "select_scatter",
+    "sgn", "sinc", "slice_scatter", "tolist", "unique_consecutive",
+    "unfold", "vdot", "view_as_complex", "view_as_real",
+    "exp2", "float_power", "true_divide", "bitwise_invert", "gammaln",
+    "gammainc", "erfc", "xlogy", "aminmax", "broadcast_shapes", "crop",
+    "strided_slice",
+]
+
+
+# ---- views / predicates ----------------------------------------------------
+
+def is_complex(x):
+    return jnp.iscomplexobj(x)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def view_as_real(x):
+    """(..., ) complex → (..., 2) real."""
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def view_as_complex(x):
+    """(..., 2) real → (...,) complex."""
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def polar(abs_, angle):
+    return jax.lax.complex(abs_ * jnp.cos(angle), abs_ * jnp.sin(angle))
+
+
+def positive(x):
+    return +jnp.asarray(x)
+
+
+def ravel(x):
+    return jnp.ravel(x)
+
+
+def tolist(x):
+    return np.asarray(x).tolist()
+
+
+def sgn(x):
+    """Sign; for complex inputs x/|x| (0 stays 0) — the reference's sgn."""
+    x = jnp.asarray(x)
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def logaddexp2(x, y):
+    return jnp.logaddexp2(x, y)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jax.scipy.special.logit(x)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def frac_(x):
+    return x - jnp.trunc(x)
+
+
+# ---- matmul family ---------------------------------------------------------
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def vdot(x, y):
+    return jnp.vdot(x, y)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def pinverse(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+# ---- stacking / reshaping views -------------------------------------------
+
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+def flipud(x):
+    return jnp.flipud(x)
+
+
+def block_diag(*inputs):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+def cartesian_prod(*xs):
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1) \
+        if len(xs) > 1 else xs[0]
+
+
+def combinations(x, r=2, with_replacement=False):
+    """All r-combinations of a 1-D tensor (static length)."""
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), dtype=np.int32).reshape(-1, r)
+    return jnp.take(x, jnp.asarray(idx), axis=0)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Strided view via gather (shape/stride are static python ints)."""
+    flat = jnp.ravel(x)
+    idx = np.full(tuple(shape), offset, dtype=np.int64)
+    for d, (sz, st) in enumerate(zip(shape, stride)):
+        expand = [1] * len(shape)
+        expand[d] = sz
+        idx = idx + np.arange(sz, dtype=np.int64).reshape(expand) * st
+    return jnp.take(flat, jnp.asarray(idx))
+
+
+def unfold(x, axis, size, step):
+    """Sliding windows of `size` every `step` along `axis` (window dim
+    appended last — the reference's layout)."""
+    x = jnp.moveaxis(jnp.asarray(x), axis, -1)
+    n = x.shape[-1]
+    n_win = (n - size) // step + 1
+    starts = np.arange(n_win) * step
+    idx = starts[:, None] + np.arange(size)[None, :]      # (n_win, size)
+    out = jnp.take(x, jnp.asarray(idx), axis=-1)          # (..., n_win, size)
+    return jnp.moveaxis(out, -2, axis)
+
+
+# ---- scatter views ---------------------------------------------------------
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    x = jnp.asarray(x)
+    xm = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n, m = xm.shape[-2:]
+    rows = np.arange(max(n, m))
+    r = rows[(rows + max(0, offset) < m) & (rows - min(0, offset) < n)]
+    ii = r - min(0, offset)
+    jj = r + max(0, offset)
+    xm = xm.at[..., ii, jj].set(jnp.moveaxis(jnp.asarray(y), -1, -1))
+    return jnp.moveaxis(xm, (-2, -1), (axis1, axis2))
+
+
+def select_scatter(x, y, axis, index):
+    return jnp.asarray(x).at[(slice(None),) * axis + (index,)].set(y)
+
+
+def slice_scatter(x, y, axes, starts, ends, strides=None):
+    strides = strides or [1] * len(axes)
+    idx = [slice(None)] * jnp.asarray(x).ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return jnp.asarray(x).at[tuple(idx)].set(y)
+
+
+def index_fill(x, index, axis, value):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index)
+    return x.at[tuple(idx)].set(value)
+
+
+def index_sample(x, index):
+    """x (N, D), index (N, M) int → (N, M): per-row gather (reference
+    paddle.index_sample)."""
+    return jnp.take_along_axis(jnp.asarray(x), jnp.asarray(index), axis=1)
+
+
+def masked_scatter(x, mask, value):
+    """Fill True positions of `mask` with consecutive elements of
+    `value` (row-major), like the reference/torch masked_scatter."""
+    x = jnp.asarray(x)
+    mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
+    flat_m = mask.ravel()
+    src = jnp.asarray(value).ravel()
+    pos = jnp.cumsum(flat_m) - 1
+    gathered = jnp.take(src, jnp.clip(pos, 0, src.shape[0] - 1))
+    return jnp.where(flat_m, gathered, x.ravel()).reshape(x.shape)
+
+
+# ---- set / search ops ------------------------------------------------------
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(jnp.asarray(x), jnp.asarray(test_x), invert=invert)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Collapse consecutive duplicates (eager: data-dependent output
+    shape, same contract as tensor.unique)."""
+    xn = np.asarray(x)
+    if axis is None:
+        xn = xn.ravel()
+        axis = 0
+    moved = np.moveaxis(xn, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    if flat.shape[0] == 0:
+        keep = np.zeros(0, bool)
+    else:
+        keep = np.concatenate([[True], np.any(flat[1:] != flat[:-1],
+                                              axis=1)])
+    out = jnp.asarray(np.moveaxis(moved[keep], 0, axis))
+    res = (out,)
+    if return_inverse:
+        res += (jnp.asarray(np.cumsum(keep) - 1),)
+    if return_counts:
+        starts = np.flatnonzero(keep)
+        counts = np.diff(np.append(starts, flat.shape[0]))
+        res += (jnp.asarray(counts),)
+    return res if len(res) > 1 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(x),
+                           side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def mode(x, axis=-1, keepdim=False):
+    """(values, indices) of the most frequent element along `axis`; ties
+    break toward the smallest value (reference semantics)."""
+    x = jnp.asarray(x)
+    xs = jnp.sort(x, axis=axis)
+    # count occurrences of each sorted element: O(n^2) along axis — API
+    # parity for modest sizes (the reference kernel is O(n log n))
+    a = jnp.moveaxis(x, axis, -1)
+    s = jnp.moveaxis(xs, axis, -1)
+    cnt = jnp.sum(s[..., :, None] == a[..., None, :], axis=-1)
+    best = jnp.argmax(cnt, axis=-1)                  # first max = smallest
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(jnp.moveaxis(x, axis, -1) == vals[..., None], axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+# ---- statistics ------------------------------------------------------------
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(jnp.asarray(x, jnp.float32), q, axis=axis,
+                           keepdims=keepdim)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    y = jnp.asarray(y)
+    ym = jnp.moveaxis(y, axis, -1)
+    mids = (ym[..., 1:] + ym[..., :-1]) / 2.0
+    if x is not None:
+        xd = jnp.diff(jnp.moveaxis(jnp.asarray(x), axis, -1), axis=-1)
+        mids = mids * xd
+    else:
+        mids = mids * dx
+    return jnp.moveaxis(jnp.cumsum(mids, axis=-1), -1, axis)
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distances of (N, D) rows."""
+    n = x.shape[0]
+    ii, jj = np.triu_indices(n, k=1)
+    diff = x[jnp.asarray(ii)] - x[jnp.asarray(jj)]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def renorm(x, p, axis, max_norm):
+    """Scale each slice along `axis` whose p-norm exceeds max_norm down to
+    exactly max_norm."""
+    x = jnp.asarray(x)
+    xm = jnp.moveaxis(x, axis, 0).reshape(x.shape[axis], -1)
+    norms = jnp.sum(jnp.abs(xm) ** p, axis=1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm,
+                      max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return x * scale.reshape(shape).astype(x.dtype)
+
+
+# ---- elementwise stragglers -------------------------------------------------
+
+def exp2(x):
+    return jnp.exp2(x)
+
+
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+def true_divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+def bitwise_invert(x):
+    return jnp.invert(x)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammainc(a, x):
+    return jax.scipy.special.gammainc(a, x)
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+def aminmax(x, axis=None, keepdim=False):
+    return (jnp.min(x, axis=axis, keepdims=keepdim),
+            jnp.max(x, axis=axis, keepdims=keepdim))
+
+
+def broadcast_shapes(*shapes):
+    return jnp.broadcast_shapes(*shapes)
+
+
+def crop(x, shape, offsets=None):
+    """Static crop (reference paddle.crop): take `shape` starting at
+    `offsets` (zeros when omitted)."""
+    offsets = offsets or [0] * len(shape)
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return jnp.asarray(x)[idx]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * jnp.asarray(x).ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return jnp.asarray(x)[tuple(idx)]
