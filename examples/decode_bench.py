@@ -121,37 +121,33 @@ def main():
     # dispatch; measure the DEVICE clock via the xplane parser when
     # available (min-of-reps wall marginal as fallback), marginal between
     # the two decode lengths to cancel prefill + fixed costs
+    # wall reps run UNTRACED (the r2 methodology, clean fallback); one
+    # traced pair afterwards supplies the device-clock numbers
     reps = 3
     t_short, t_long = [], []
-    d_short, d_long = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        timed(n_short)
+        t_short.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        timed(ns.new_tokens)
+        t_long.append(time.perf_counter() - t0)
 
-    def run_traced(n, sink):
+    def device_time(n):
         import shutil
         d = "/tmp/decode_bench_prof"
         shutil.rmtree(d, ignore_errors=True)
-        try:
-            with jax.profiler.trace(d):
-                timed(n)
-        except Exception:
-            timed(n)        # profiler unavailable: plain run for the wall
-            return
-        try:                # parse failures must NOT re-run the decode
-            from paddle_tpu.profiler import xplane
-            dev = xplane.device_total_seconds(d, "jit_run")
-            if dev is not None:
-                sink.append(dev)
-        except Exception:
-            pass
+        with jax.profiler.trace(d):
+            timed(n)
+        from paddle_tpu.profiler import xplane
+        return xplane.device_total_seconds(d, "jit_run")
 
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        run_traced(n_short, d_short)
-        t_short.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run_traced(ns.new_tokens, d_long)
-        t_long.append(time.perf_counter() - t0)
-    if d_short and d_long:
-        dt = min(d_long) - min(d_short)
+    try:
+        d_short, d_long = device_time(n_short), device_time(ns.new_tokens)
+    except Exception:
+        d_short = d_long = None
+    if d_short is not None and d_long is not None:
+        dt = d_long - d_short
         timing = "device(xplane)"
     else:
         dt = min(t_long) - min(t_short)

@@ -360,8 +360,9 @@ class LlamaForCausalLM(CausalLMBase):
         cfg = self.cfg
         if _active_mesh(mp.MP_AXIS) is not None or cfg.head_dim % 2:
             return None
-        if "model.layers.0.self_attn.q_proj.weight" not in state:
-            return None     # quantized / non-standard state
+        int8 = "model.layers.0.self_attn.q_proj.weight_q" in state
+        if not int8 and "model.layers.0.self_attn.q_proj.weight" not in state:
+            return None     # non-standard state
         meta = {
             "num_heads": cfg.num_heads, "num_kv_heads": cfg.kv_heads,
             "head_dim": cfg.head_dim, "eps": cfg.rms_norm_eps,
@@ -374,14 +375,21 @@ class LlamaForCausalLM(CausalLMBase):
         params = fd.build_fused_params(state, cfg.num_layers)
         embed_w = state["model.embed_tokens.weight"]
         norm_w = state["model.norm.weight"]
-        head_w = (embed_w.T if cfg.tie_word_embeddings
-                  else state["lm_head.weight"])
 
         def embed(tok):                       # (b,) -> (b, h)
             return jnp.take(embed_w, tok, axis=0)
 
+        if cfg.tie_word_embeddings:
+            head_mm = lambda xn: jnp.dot(xn, embed_w.T)
+        elif int8 and "lm_head.weight_q" in state:
+            from paddle_tpu.quantization import weight_only_linear
+            head_mm = lambda xn: weight_only_linear(
+                xn, state["lm_head.weight_q"], state["lm_head.weight_scale"])
+        else:
+            head_mm = lambda xn: jnp.dot(xn, state["lm_head.weight"])
+
         def head(x):                          # (b, h) -> (b, vocab)
-            return jnp.dot(rms_norm(x, norm_w, cfg.rms_norm_eps), head_w)
+            return head_mm(rms_norm(x, norm_w, cfg.rms_norm_eps))
 
         return dict(meta, params=params, embed=embed, head=head)
 

@@ -137,7 +137,7 @@ class Dirac(Initializer):
     def __init__(self, groups=1):
         self.groups = groups
 
-    def __call__(self, key, shape, dtype):
+    def __call__(self, shape, dtype=jnp.float32):
         w = np.zeros(shape, np.float32)
         oc, ic = shape[0], shape[1]
         ocpg = oc // self.groups
@@ -155,8 +155,9 @@ class Orthogonal(Initializer):
     def __init__(self, gain=1.0):
         self.gain = gain
 
-    def __call__(self, key, shape, dtype):
+    def __call__(self, shape, dtype=jnp.float32):
+        k = _rng.next_rng_key("params")
         rows, cols = shape[0], int(np.prod(shape[1:]))
         q = jax.nn.initializers.orthogonal(self.gain, column_axis=-1)(
-            key, (rows, cols), jnp.float32)
+            k, (rows, cols), jnp.float32)
         return q.reshape(shape).astype(dtype)

@@ -156,17 +156,23 @@ class MaxUnPool3D(Layer):
 
 
 class LPPool1D(Layer):
-    """(Σ window x^p)^(1/p) (reference paddle.nn.LPPool1D)."""
+    """(Σ window x^p)^(1/p) (reference paddle.nn.LPPool1D). The window
+    SUM comes from reduce_window directly (avg_pool's exclusive counts
+    would mis-scale padded edge windows)."""
 
     def __init__(self, norm_type, kernel_size, stride=None, padding=0,
                  ceil_mode=False, data_format="NCL"):
         super().__init__()
+        if ceil_mode:
+            raise NotImplementedError("LPPool ceil_mode")
         self.p = float(norm_type)
-        self.args = (kernel_size, stride or kernel_size, padding, ceil_mode)
+        self.args = (kernel_size, stride or kernel_size, padding)
 
     def forward(self, x):
-        k, s, p, cm = self.args
-        sums = F.avg_pool1d(x ** self.p, k, s, p, ceil_mode=cm) * k
+        k, s, p = self.args
+        sums = jax.lax.reduce_window(
+            x ** self.p, 0.0, jax.lax.add, (1, 1, k), (1, 1, s),
+            ((0, 0), (0, 0), (p, p)))
         return sums ** (1.0 / self.p)
 
 
@@ -174,14 +180,20 @@ class LPPool2D(Layer):
     def __init__(self, norm_type, kernel_size, stride=None, padding=0,
                  ceil_mode=False, data_format="NCHW"):
         super().__init__()
+        if ceil_mode:
+            raise NotImplementedError("LPPool ceil_mode")
         self.p = float(norm_type)
         k = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
-        self.k = k
-        self.args = (kernel_size, stride or kernel_size, padding)
+        st = stride or k
+        st = (st,) * 2 if isinstance(st, int) else st
+        pd = (padding,) * 2 if isinstance(padding, int) else padding
+        self.k, self.s, self.pd = k, st, pd
 
     def forward(self, x):
-        k, s, p = self.args
-        sums = F.avg_pool2d(x ** self.p, k, s, p) * (self.k[0] * self.k[1])
+        k, s, p = self.k, self.s, self.pd
+        sums = jax.lax.reduce_window(
+            x ** self.p, 0.0, jax.lax.add, (1, 1) + tuple(k),
+            (1, 1) + tuple(s), ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
         return sums ** (1.0 / self.p)
 
 
@@ -604,9 +616,6 @@ class BeamSearchDecoder(Layer):
             scores, flat = jax.lax.top_k(total.reshape(batch_size, -1), k)
             beam = flat // vocab
             tok = flat % vocab
-            take = lambda a: jnp.take_along_axis(
-                a, beam[..., None].repeat(a.shape[-1], -1)
-                if a.ndim == 3 else beam, axis=1)
             seqs = jnp.take_along_axis(
                 seqs, beam[..., None], axis=1).at[:, :, t].set(tok)
             done = jnp.take_along_axis(done, beam, axis=1) | \

@@ -52,27 +52,46 @@ def build_fused_params(state: Dict[str, jax.Array], num_layers: int,
     wg (L,h,ffn), wu (L,h,ffn), wd (L,ffn,h)}. The qkv projections are
     fused along the output dim (q|k|v) the way fused_multi_transformer's
     qkv_weight is packed.
-    """
-    def layer(i, name):
-        return state[f"{prefix}{i}.{name}.weight"]
 
-    ln1, wqkv, wo, ln2, wg, wu, wd = [], [], [], [], [], [], []
+    Weight-only-int8 states (paddle_tpu.quantization — keys `weight_q` +
+    `weight_scale`) produce int8 weight stacks plus per-out-channel scale
+    rows {wqkv_s (L,1,dqkv), wo_s, wg_s, wu_s, wd_s} — the
+    fused_multi_transformer_int8 packing: the kernel streams int8 and
+    scales the matmul OUTPUTS.
+    """
+    int8 = f"{prefix}0.self_attn.q_proj.weight_q" in state
+
+    def layer(i, name):
+        if int8:
+            return (state[f"{prefix}{i}.{name}.weight_q"],
+                    state[f"{prefix}{i}.{name}.weight_scale"])
+        return state[f"{prefix}{i}.{name}.weight"], None
+
+    cols = {"ln1": [], "wqkv": [], "wo": [], "ln2": [], "wg": [], "wu": [],
+            "wd": []}
+    scales = {k: [] for k in ("wqkv", "wo", "wg", "wu", "wd")}
+
+    def put(key, w, sc):
+        cols[key].append(w)
+        if int8:
+            scales[key].append(sc)
+
     for i in range(num_layers):
-        ln1.append(layer(i, "input_layernorm"))
-        wqkv.append(jnp.concatenate([
-            layer(i, "self_attn.q_proj"),
-            layer(i, "self_attn.k_proj"),
-            layer(i, "self_attn.v_proj")], axis=1))
-        wo.append(layer(i, "self_attn.o_proj"))
-        ln2.append(layer(i, "post_attention_layernorm"))
-        wg.append(layer(i, "mlp.gate_proj"))
-        wu.append(layer(i, "mlp.up_proj"))
-        wd.append(layer(i, "mlp.down_proj"))
-    return {
-        "ln1": jnp.stack(ln1), "wqkv": jnp.stack(wqkv), "wo": jnp.stack(wo),
-        "ln2": jnp.stack(ln2), "wg": jnp.stack(wg), "wu": jnp.stack(wu),
-        "wd": jnp.stack(wd),
-    }
+        cols["ln1"].append(state[f"{prefix}{i}.input_layernorm.weight"])
+        qs = [layer(i, f"self_attn.{n}_proj") for n in ("q", "k", "v")]
+        put("wqkv", jnp.concatenate([w for w, _ in qs], axis=1),
+            jnp.concatenate([sc for _, sc in qs]) if int8 else None)
+        put("wo", *layer(i, "self_attn.o_proj"))
+        cols["ln2"].append(
+            state[f"{prefix}{i}.post_attention_layernorm.weight"])
+        put("wg", *layer(i, "mlp.gate_proj"))
+        put("wu", *layer(i, "mlp.up_proj"))
+        put("wd", *layer(i, "mlp.down_proj"))
+    out = {k: jnp.stack(v) for k, v in cols.items()}
+    if int8:
+        for k, v in scales.items():
+            out[f"{k}_s"] = jnp.stack(v).astype(jnp.float32)[:, None, :]
+    return out
 
 
 def _rms(x, w, eps):
@@ -118,14 +137,22 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
     dq = nh * hd
     dtype = x.dtype
     scale = 1.0 / math.sqrt(hd)
+    int8 = "wqkv_s" in params
     cos_b = cos.reshape(1, 1, hd).astype(jnp.float32)
     sin_b = sin.reshape(1, 1, hd).astype(jnp.float32)
+
+    def wdot(act, key, l):
+        w = params[key][l]
+        if int8:
+            y = jnp.dot(act, w.astype(act.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * params[f"{key}_s"][l]
+        return jnp.dot(act, w, preferred_element_type=jnp.float32)
 
     xf = x.astype(jnp.float32)
     for l in range(L):
         xn = _rms(xf, params["ln1"][l], eps)
-        qkv = jnp.dot(xn, params["wqkv"][l],
-                      preferred_element_type=jnp.float32)
+        qkv = wdot(xn, "wqkv", l)
         q = qkv[:, :dq].reshape(b, nh, hd)
         k = qkv[:, dq:dq + nkv * hd].reshape(b, nkv, hd)
         v = qkv[:, dq + nkv * hd:].reshape(b, nkv, hd)
@@ -147,14 +174,12 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
         attn = attn.reshape(b, dq).astype(dtype)
-        xf = xf + jnp.dot(attn, params["wo"][l],
-                          preferred_element_type=jnp.float32)
+        xf = xf + wdot(attn, "wo", l)
         xn2 = _rms(xf, params["ln2"][l], eps)
-        g = jnp.dot(xn2, params["wg"][l], preferred_element_type=jnp.float32)
-        u = jnp.dot(xn2, params["wu"][l], preferred_element_type=jnp.float32)
+        g = wdot(xn2, "wg", l)
+        u = wdot(xn2, "wu", l)
         act = (jax.nn.silu(g) * u).astype(dtype)
-        xf = xf + jnp.dot(act, params["wd"][l],
-                          preferred_element_type=jnp.float32)
+        xf = xf + wdot(act, "wd", l)
     return xf.astype(dtype), kv_cache
 
 
@@ -162,11 +187,18 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _pick_ffn_blocks(ffn: int, target: int = 3072):
-    """Smallest J with ffn % J == 0 and ffn // J <= target."""
+def _pick_ffn_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
+                     budget: int = 88 * 2 ** 20):
+    """Smallest J (ffn % J == 0) whose per-grid-step VMEM estimate —
+    double-buffered weight blocks (attention weights + one FFN column
+    block) on top of `fixed_bytes` of scratch — fits `budget`."""
     for j in range(1, ffn + 1):
-        if ffn % j == 0 and ffn // j <= target:
-            return j, ffn // j
+        if ffn % j:
+            continue
+        fblk = ffn // j
+        weights = fixed_bytes + 3 * fblk * h * wbytes
+        if 2 * weights + 8 * 2 ** 20 <= budget or fblk <= 128:
+            return j, fblk
     return ffn, 1
 
 
@@ -201,7 +233,10 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     dq = nh * hd
     dqkv = dq + 2 * dkv
     ffn = params["wg"].shape[2]
-    J, fblk = _pick_ffn_blocks(ffn)
+    int8 = "wqkv_s" in params
+    wbytes = 1 if int8 else 2
+    J, fblk = _pick_ffn_blocks(
+        ffn, h, fixed_bytes=(dqkv + nh * hd) * h * wbytes, wbytes=wbytes)
     if not chunk:
         chunk = 128
     ck = min(chunk, S)
@@ -210,12 +245,30 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     dtype = x.dtype
     scale = 1.0 / math.sqrt(hd)
 
-    def kernel(pos_ref, x_in_ref, ln1_ref, wqkv_ref,
-               wo_ref, ln2_ref, wg_ref, wu_ref, wd_ref, kv_in,
-               x_out_ref, kv_ref,
-               x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
-               wsem, rsem):
-        del kv_in  # aliased with kv_ref
+    def kernel(*refs):
+        (pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref, wg_ref,
+         wu_ref, wd_ref) = refs[:9]
+        i = 9
+        if int8:
+            sqkv_ref, so_ref, sg_ref, su_ref, sd_ref = refs[i:i + 5]
+            i += 5
+        kv_in = refs[i]                  # aliased with kv_ref
+        x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
+        (x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
+         wsem, rsem) = refs[i + 3:]
+        del kv_in
+
+        def wdot(act, wref, sref, rows=None):
+            """act @ w with weight-only-int8 dequant folded onto the
+            OUTPUT columns (per-out-channel scales) — the int8 stream
+            converts to bf16 on the VMEM->MXU path, never touching HBM
+            in bf16 (fused_multi_transformer_int8 semantics)."""
+            w = wref[...] if rows is None else wref[rows, :]
+            if int8:
+                y = jnp.dot(act, w.astype(act.dtype),
+                            preferred_element_type=jnp.float32)
+                return y if sref is None else y * sref[...]
+            return jnp.dot(act, w, preferred_element_type=jnp.float32)
         li = pl.program_id(0)
         j = pl.program_id(1)
         pos = pos_ref[0]
@@ -239,8 +292,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 rkb.start()
 
             xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
-            qkv = jnp.dot(xn, wqkv_ref[...],
-                          preferred_element_type=jnp.float32)
+            qkv = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
             # rope angles computed in-kernel from pos (NeoX convention:
             # freqs repeated over both halves) — no XLA-side cos/sin table
             half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
@@ -347,16 +399,19 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 bidx, pos + 1, 8)
 
             # o-proj without a lane-merge relayout: per-head partial
-            # matmuls against wo's row blocks (head = g*rep + r)
-            x = x_s[...]
+            # matmuls against wo's row blocks (head = g*rep + r); int8
+            # scales apply once to the accumulated output columns
+            oacc = jnp.zeros((b, h), jnp.float32)
             for g in range(nkv):
                 norm = accs[g] / ls[g][..., None]           # (b, rep, hd)
                 for r in range(rep):
                     hh = g * rep + r
-                    x = x + jnp.dot(
-                        norm[:, r, :].astype(dtype),
-                        wo_ref[hh * hd:(hh + 1) * hd, :],
-                        preferred_element_type=jnp.float32)
+                    oacc = oacc + wdot(
+                        norm[:, r, :].astype(dtype), wo_ref, None,
+                        rows=slice(hh * hd, (hh + 1) * hd))
+            if int8:
+                oacc = oacc * so_ref[...]
+            x = x_s[...] + oacc
             x_s[...] = x
             xn_s[...] = _rms(x, ln2_ref[...].reshape(h), eps).astype(dtype)
             acc_s[...] = jnp.zeros_like(acc_s)
@@ -386,11 +441,10 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                             kvch_s.at[0], rsem.at[0]).start()
 
             xn = xn_s[...]
-            g = jnp.dot(xn, wg_ref[...], preferred_element_type=jnp.float32)
-            u = jnp.dot(xn, wu_ref[...], preferred_element_type=jnp.float32)
+            g = wdot(xn, wg_ref, sg_ref if int8 else None)
+            u = wdot(xn, wu_ref, su_ref if int8 else None)
             act = (jax.nn.silu(g) * u).astype(dtype)
-            acc_s[...] += jnp.dot(act, wd_ref[...],
-                                  preferred_element_type=jnp.float32)
+            acc_s[...] += wdot(act, wd_ref, sd_ref if int8 else None)
 
             @pl.when(j == J)
             def _():
@@ -425,6 +479,17 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pl.BlockSpec((None, fblk, h),
                          lambda l, j: (lax.max(l - (j == 0), 0),
                                        jm(l, j), 0)),               # wd
+        ] + ([
+            pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # sqkv
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # so
+            pl.BlockSpec((None, 1, fblk),
+                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
+                                       jm(l, j))),                  # sg
+            pl.BlockSpec((None, 1, fblk),
+                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
+                                       jm(l, j))),                  # su
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # sd
+        ] if int8 else []) + [
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
         ],
         out_specs=[
@@ -446,7 +511,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pltpu.SemaphoreType.DMA((1,)),            # wsem
             pltpu.SemaphoreType.DMA((2,)),            # rsem
         ],
-        input_output_aliases={9: 1},
+        input_output_aliases={(14 if int8 else 9): 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             # v5e has 128 MiB VMEM; the default 16 MiB scoped limit can't
@@ -456,7 +521,10 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     )(jnp.asarray(pos, jnp.int32).reshape(1), x,
       params["ln1"][:, None], params["wqkv"],
       params["wo"], params["ln2"][:, None], params["wg"], params["wu"],
-      params["wd"], kv_cache)
+      params["wd"],
+      *((params["wqkv_s"], params["wo_s"], params["wg_s"],
+         params["wu_s"], params["wd_s"]) if int8 else ()),
+      kv_cache)
     return out[0], out[1]
 
 

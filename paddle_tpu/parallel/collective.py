@@ -210,9 +210,15 @@ def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
         np.save(buf, np.asarray(payload), allow_pickle=False)
         client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
                              base64.b64encode(buf.getvalue()).decode("ascii"))
-        if gc and seq >= 2:
+        # allgather-style tags prove consumption 2 generations back;
+        # one-way tags (broadcast/scatter/send) keep a ring of 8 — a
+        # reader lagging >8 collective calls violates the in-order
+        # contract and fails LOUDLY on the deleted key instead of the
+        # store growing without bound
+        back = 2 if gc else 8
+        if seq >= back:
             try:
-                client.key_value_delete(f"ptkv/{tag}/{seq - 2}/{me}")
+                client.key_value_delete(f"ptkv/{tag}/{seq - back}/{me}")
             except Exception:
                 pass
     out = {}

@@ -17,13 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "as_strided", "baddbmm", "block_diag", "bucketize", "cartesian_prod",
+    "as_strided", "baddbmm", "block_diag", "cartesian_prod",
     "combinations", "cumulative_trapezoid", "diagonal_scatter", "fliplr",
-    "flipud", "frac_", "histogramdd", "hypot", "index_fill", "index_sample",
+    "flipud", "frac_", "histogramdd", "index_sample",
     "is_complex", "is_floating_point", "is_integer", "isin", "logaddexp2",
-    "logit", "masked_scatter", "mm", "mode", "mv", "nanquantile", "pdist",
-    "pinverse", "polar", "positive", "ravel", "renorm", "select_scatter",
-    "sgn", "sinc", "slice_scatter", "tolist", "unique_consecutive",
+    "logit", "masked_scatter", "mm", "mode", "mv", "pdist",
+    "pinverse", "polar", "positive", "ravel", "renorm",
+    "sgn", "sinc", "tolist", "unique_consecutive",
     "unfold", "vdot", "view_as_complex", "view_as_real",
     "exp2", "float_power", "true_divide", "bitwise_invert", "gammaln",
     "gammainc", "erfc", "xlogy", "aminmax", "broadcast_shapes", "crop",
@@ -92,10 +92,6 @@ def logit(x, eps=None):
     if eps is not None:
         x = jnp.clip(x, eps, 1.0 - eps)
     return jax.scipy.special.logit(x)
-
-
-def hypot(x, y):
-    return jnp.hypot(x, y)
 
 
 def frac_(x):
@@ -190,25 +186,6 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
     return jnp.moveaxis(xm, (-2, -1), (axis1, axis2))
 
 
-def select_scatter(x, y, axis, index):
-    return jnp.asarray(x).at[(slice(None),) * axis + (index,)].set(y)
-
-
-def slice_scatter(x, y, axes, starts, ends, strides=None):
-    strides = strides or [1] * len(axes)
-    idx = [slice(None)] * jnp.asarray(x).ndim
-    for a, s, e, st in zip(axes, starts, ends, strides):
-        idx[a] = slice(s, e, st)
-    return jnp.asarray(x).at[tuple(idx)].set(y)
-
-
-def index_fill(x, index, axis, value):
-    x = jnp.asarray(x)
-    idx = [slice(None)] * x.ndim
-    idx[axis] = jnp.asarray(index)
-    return x.at[tuple(idx)].set(value)
-
-
 def index_sample(x, index):
     """x (N, D), index (N, M) int → (N, M): per-row gather (reference
     paddle.index_sample)."""
@@ -222,6 +199,12 @@ def masked_scatter(x, mask, value):
     mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
     flat_m = mask.ravel()
     src = jnp.asarray(value).ravel()
+    if not isinstance(flat_m, jax.core.Tracer):   # eager: enforce like ref
+        need = int(np.asarray(flat_m).sum())
+        if src.shape[0] < need:
+            raise ValueError(
+                f"masked_scatter: value has {src.shape[0]} elements but "
+                f"mask selects {need}")
     pos = jnp.cumsum(flat_m) - 1
     gathered = jnp.take(src, jnp.clip(pos, 0, src.shape[0] - 1))
     return jnp.where(flat_m, gathered, x.ravel()).reshape(x.shape)
@@ -259,13 +242,6 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     return res if len(res) > 1 else out
 
 
-def bucketize(x, sorted_sequence, out_int32=False, right=False):
-    side = "right" if right else "left"
-    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(x),
-                           side=side)
-    return out.astype(jnp.int32) if out_int32 else out
-
-
 def mode(x, axis=-1, keepdim=False):
     """(values, indices) of the most frequent element along `axis`; ties
     break toward the smallest value (reference semantics)."""
@@ -286,11 +262,6 @@ def mode(x, axis=-1, keepdim=False):
 
 
 # ---- statistics ------------------------------------------------------------
-
-def nanquantile(x, q, axis=None, keepdim=False):
-    return jnp.nanquantile(jnp.asarray(x, jnp.float32), q, axis=axis,
-                           keepdims=keepdim)
-
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
     return jnp.histogramdd(x, bins=bins, range=ranges, density=density,

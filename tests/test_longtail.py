@@ -68,7 +68,7 @@ def test_masked_scatter_and_scatter_views():
                          0, 1)
     np.testing.assert_array_equal(np.asarray(y)[1], [7, 8, 9])
     z = T.slice_scatter(jnp.zeros((4,)), jnp.asarray([5.0, 6.0]), [0],
-                        [1], [3])
+                        [1], [3], [1])
     np.testing.assert_array_equal(np.asarray(z), [0, 5, 6, 0])
     d = T.diagonal_scatter(jnp.zeros((3, 3)), jnp.asarray([1.0, 2.0]), 1)
     np.testing.assert_array_equal(np.asarray(d),

@@ -212,3 +212,31 @@ def test_rms_norm_pallas_parity():
     pal = rn._rms_norm_pallas(x, w, 1e-5)
     ref = rn._rms_norm_ref(x, w, 1e-5)
     assert_close(pal, ref, rtol=1e-2, atol=1e-2)
+
+
+def test_fused_decode_int8_generate_on_tpu():
+    """Int8 weights inside the fused kernel (fused_multi_transformer_int8
+    analog): greedy decode must track the unfused int8 scan decoder."""
+    import paddle_tpu
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.inference import generate
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.quantization import quantize_model, quantized_state
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256, num_layers=3,
+                      num_heads=4, num_kv_heads=2, intermediate_size=512,
+                      max_position_embeddings=512)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    quantize_model(m)
+    state = quantized_state(m)
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 9)))
+    out_fused = generate(m, prompt, max_new_tokens=16, temperature=0.0,
+                         state=state)
+    m._generate_jit_cache = {}
+    set_flags({"FLAGS_fused_decode": False, "FLAGS_pallas_strict": False})
+    out_ref = generate(m, prompt, max_new_tokens=16, temperature=0.0,
+                       state=state)
+    set_flags({"FLAGS_fused_decode": True})
+    match = (np.asarray(out_fused) == np.asarray(out_ref)).mean()
+    assert match >= 0.9, match    # int8 near-ties may flip a token
