@@ -10,6 +10,7 @@ import dataclasses
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu import nn
@@ -59,13 +60,27 @@ class GPTAttention(nn.Layer):
         self.head_dim = h // nh
         self.attn_dropout = cfg.attention_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, start_pos=0):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, s, self.num_heads, self.head_dim)
         k = k.reshape(b, s, self.num_heads, self.head_dim)
         v = v.reshape(b, s, self.num_heads, self.head_dim)
+        if cache is not None:
+            # decode: append at [start_pos, start_pos+s), attend the
+            # filled prefix (position-masked static buffers)
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], k.astype(cache["k"].dtype), start_pos, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], v.astype(cache["v"].dtype), start_pos, axis=1)
+            q_pos = start_pos + jnp.arange(s)[:, None]
+            k_pos = jnp.arange(k_cache.shape[1])[None, :]
+            mask = (k_pos <= q_pos)[None, None]
+            out = F.scaled_dot_product_attention(
+                q, k_cache, v_cache, attn_mask=mask, is_causal=False)
+            out = self.out_proj(out.reshape(b, s, h))
+            return out, {"k": k_cache, "v": v_cache}
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.attn_dropout,
             training=self.training)
@@ -85,7 +100,14 @@ class GPTBlock(nn.Layer):
                                     0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)))
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, start_pos=0):
+        if cache is not None:
+            attn, new_cache = self.attn(self.ln_1(x), cache=cache,
+                                        start_pos=start_pos)
+            x = x + attn
+            x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
+                                       approximate=True))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln_1(x)))
         x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
                                                 approximate=True)))
@@ -104,10 +126,16 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, start_pos=0):
         b, s = input_ids.shape
-        pos = jnp.arange(s)[None, :]
+        pos = (start_pos + jnp.arange(s))[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
+        if cache is not None:
+            new_cache = []
+            for i, block in enumerate(self.h):
+                x, c = block(x, cache=cache[i], start_pos=start_pos)
+                new_cache.append(c)
+            return self.ln_f(x), new_cache
         x = self.drop(x)
         for block in self.h:
             x = block(x)
@@ -125,13 +153,26 @@ class GPTPretrainModel(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
-        x = self.gpt(input_ids)
+    def forward(self, input_ids, cache=None, start_pos=0):
+        if cache is not None:
+            x, new_cache = self.gpt(input_ids, cache=cache,
+                                    start_pos=start_pos)
+        else:
+            x = self.gpt(input_ids)
         if self.cfg.tie_word_embeddings:
             logits = jnp.matmul(x, self.gpt.wte.weight.T)
         else:
             logits = self.lm_head(x)
+        if cache is not None:
+            return logits, new_cache
         return logits
+
+    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
+        cfg = self.cfg
+        shape = (batch_size, max_len, cfg.num_heads,
+                 cfg.hidden_size // cfg.num_heads)
+        return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for _ in range(cfg.num_layers)]
 
     def loss(self, logits, labels):
         return F.cross_entropy(logits.reshape(-1, logits.shape[-1]),

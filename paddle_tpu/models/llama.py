@@ -243,6 +243,13 @@ class CausalLMBase(nn.Layer):
     """Shared scaffolding for decoder-only LMs built on `.model` (with
     embed_tokens/layers/norm), `.lm_head` and `.loss_fn` attributes."""
 
+    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
+        """Preallocated KV cache: one {'k','v'} buffer pair per layer."""
+        cfg = self.cfg
+        shape = (batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for _ in range(cfg.num_layers)]
+
     def num_params(self):
         import numpy as np
         return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
@@ -340,18 +347,12 @@ class LlamaForCausalLM(CausalLMBase):
             return mp.constrain(logits, mp._last_dim_spec(mp.MP_AXIS))
         return self.lm_head(x)
 
-    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
-        """Preallocated KV cache: one {'k','v'} buffer pair per layer."""
-        cfg = self.cfg
-        shape = (batch_size, max_len, cfg.kv_heads, cfg.head_dim)
-        return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-                for _ in range(cfg.num_layers)]
-
     def fused_decode_plan(self, state, probe=False):
         """Plan for the fused decode-step path (ops.fused_decode — the
         fused_multi_transformer analog): stacked per-layer weights plus
         embed/head closures, or None when this config can't ride it
-        (active TP mesh, quantized weights, odd head_dim).
+        (active TP mesh, odd head_dim). Weight-only-int8 states build the
+        int8 variant (fused_multi_transformer_int8 analog).
 
         With probe=True only eligibility + static meta are computed (no
         device work) — generate() probes before jit and builds the real

@@ -82,13 +82,25 @@ class MixtralDecoderLayer(nn.Layer):
             self.shared_mlp = LlamaMLP(shared_cfg)
         self.cfg = cfg
 
-    def forward(self, x, cos=None, sin=None, attn_mask=None):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+    def forward(self, x, cos=None, sin=None, attn_mask=None, cache=None,
+                start_pos=0):
+        if cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x), cos,
+                                             sin, attn_mask, cache=cache,
+                                             start_pos=start_pos)
+            x = x + attn
+        else:
+            new_cache = None
+            x = x + self.self_attn(self.input_layernorm(x), cos, sin,
+                                   attn_mask)
         h = self.post_attention_layernorm(x)
         moe_out, aux = self.moe(h)
         if self.cfg.num_shared_experts:
             moe_out = moe_out + self.shared_mlp(h)
-        return x + moe_out, aux
+        out = x + moe_out
+        if cache is not None:
+            return (out, aux), new_cache
+        return out, aux
 
 
 class MixtralModel(nn.Layer):
@@ -103,12 +115,22 @@ class MixtralModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, start_pos=0):
         cfg = self.cfg
         s = input_ids.shape[1]
-        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base)
+        pos = start_pos + jnp.arange(s) if cache is not None else None
+        cos, sin = rope_ops.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_base,
+                                         position_ids=pos)
         x = self.embed_tokens(input_ids)
         aux_total = jnp.zeros((), jnp.float32)
+        if cache is not None:
+            new_cache = []
+            for i, layer in enumerate(self.layers):
+                (x, aux), c = layer(x, cos, sin, attn_mask, cache=cache[i],
+                                    start_pos=start_pos)
+                aux_total = aux_total + aux
+                new_cache.append(c)
+            return (self.norm(x), aux_total), new_cache
         for layer in self.layers:
             x, aux = layer(x, cos, sin, attn_mask)
             aux_total = aux_total + aux
@@ -132,7 +154,12 @@ class MixtralForCausalLM(CausalLMBase):
             has_bias=False, gather_output=False)
         self.loss_fn = mp.ParallelCrossEntropy()
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, start_pos=0):
+        if cache is not None:
+            (x, aux), new_cache = self.model(input_ids, attn_mask,
+                                             cache=cache, start_pos=start_pos)
+            # decode path: logits only (generate's contract)
+            return self.lm_head(x), new_cache
         x, aux = self.model(input_ids, attn_mask)
         return self.lm_head(x), self.cfg.aux_loss_weight * aux
 

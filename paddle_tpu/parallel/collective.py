@@ -210,15 +210,16 @@ def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
         np.save(buf, np.asarray(payload), allow_pickle=False)
         client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
                              base64.b64encode(buf.getvalue()).decode("ascii"))
-        # allgather-style tags prove consumption 2 generations back;
-        # one-way tags (broadcast/scatter/send) keep a ring of 8 — a
-        # reader lagging >8 collective calls violates the in-order
-        # contract and fails LOUDLY on the deleted key instead of the
-        # store growing without bound
-        back = 2 if gc else 8
-        if seq >= back:
+        # allgather-style tags (gc=True) prove consumption 2 generations
+        # back and GC safely. One-way tags (broadcast/scatter/send) have
+        # NO consumption evidence — a fire-and-forget sender may be
+        # arbitrarily far ahead of a legal in-order reader — so their
+        # keys are left in place: one entry per call leaks in the
+        # coordination service. Documented limitation; these veneers are
+        # control-plane (setup/debug), not per-step data plane.
+        if gc and seq >= 2:
             try:
-                client.key_value_delete(f"ptkv/{tag}/{seq - back}/{me}")
+                client.key_value_delete(f"ptkv/{tag}/{seq - 2}/{me}")
             except Exception:
                 pass
     out = {}

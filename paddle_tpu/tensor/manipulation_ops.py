@@ -212,7 +212,9 @@ def select_scatter(x, values, axis, index):
     return x.at[tuple(ix)].set(values)
 
 
-def slice_scatter(x, value, axes, starts, ends, strides):
+def slice_scatter(x, value, axes, starts, ends, strides=None):
+    x = jnp.asarray(x)
+    strides = strides if strides is not None else [1] * len(axes)
     ix = [slice(None)] * x.ndim
     for ax, st, en, sr in zip(axes, starts, ends, strides):
         ix[ax] = slice(st, en, sr)

@@ -104,3 +104,35 @@ def test_generate_caches_jitted_program():
     generate(model, prompt, max_new_tokens=6, temperature=0.0,
              cache_dtype=jnp.float32)
     assert len(model._generate_jit_cache) == 2   # new static shape, new entry
+
+
+def test_gpt_generate_greedy_replay():
+    """GPT decode path (round 3): cached generation must reproduce the
+    teacher-forced argmax at every position."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+
+    paddle_tpu.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    g = GPTPretrainModel(cfg)
+    g.eval()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 7)))
+    out = generate(g, prompt, max_new_tokens=10, temperature=0.0)
+    pred = np.asarray(jnp.argmax(g(out), -1))
+    assert (pred[:, 6:-1] == np.asarray(out)[:, 7:]).all()
+
+
+def test_mixtral_generate_greedy_replay():
+    """Mixtral decode path (round 3): MoE inference — per-token routing
+    through the cached decoder matches teacher forcing."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle_tpu.seed(0)
+    m = MixtralForCausalLM(MixtralConfig.tiny())
+    m.eval()
+    prompt = jnp.asarray(np.random.RandomState(1).randint(0, 256, (2, 7)))
+    out = generate(m, prompt, max_new_tokens=10, temperature=0.0)
+    logits, _aux = m(out)
+    pred = np.asarray(jnp.argmax(logits, -1))
+    assert (pred[:, 6:-1] == np.asarray(out)[:, 7:]).all()
