@@ -46,6 +46,14 @@ HBM_BW = {
 def build_model(name):
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
+    if name == "gpt2-345m":
+        from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+        cfg = GPTConfig.gpt2_medium()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        m = GPTPretrainModel(cfg).bfloat16()
+        m.eval()
+        return cfg, m
     if name == "llama-tiny":  # CPU smoke
         cfg = LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                           num_heads=4, num_kv_heads=4, intermediate_size=256,

@@ -115,14 +115,15 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                 finished = finished | (tok == eos)
                 key, ki = jax.random.split(key)
                 pos = prompt_len + i - 1
-                x = plan_t["embed"](tok)
+                x = plan_t["embed"](tok, pos)
                 cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
                 sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
                 x, kv = fused_decode_step(
                     x, plan_t["params"], kv, pos, cos, sin,
                     num_heads=plan_t["num_heads"],
                     num_kv_heads=plan_t["num_kv_heads"], eps=plan_t["eps"],
-                    rope_base=plan_t["rope_base"])
+                    rope_base=plan_t["rope_base"],
+                    arch=plan_t.get("arch", "llama"))
                 nxt = _sample_logits(plan_t["head"](x), ki, temperature,
                                      top_k, top_p)
                 nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)

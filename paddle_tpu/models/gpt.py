@@ -33,6 +33,12 @@ class GPTConfig:
     tie_word_embeddings: bool = True
 
     # gpt2-345m preset
+    @property
+    def num_kv_heads(self):
+        """MHA: kv heads == heads (llama-shaped accessors for shared
+        roofline/cache math)."""
+        return self.num_heads
+
     @classmethod
     def gpt2_medium(cls):
         return cls(hidden_size=1024, num_layers=24, num_heads=16)
@@ -173,6 +179,41 @@ class GPTPretrainModel(nn.Layer):
                  cfg.hidden_size // cfg.num_heads)
         return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
                 for _ in range(cfg.num_layers)]
+
+    def fused_decode_plan(self, state, probe=False):
+        """Fused decode-step plan, GPT block variant (ops.fused_decode
+        arch='gpt' — LayerNorm+bias, MHA, learned positions, GELU): the
+        architecture the reference's fused_multi_transformer serves."""
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        if hd % 2 or "gpt.h.0.attn.qkv_proj.weight" not in state:
+            return None
+        meta = {
+            "num_heads": cfg.num_heads, "num_kv_heads": cfg.num_heads,
+            "head_dim": hd, "eps": cfg.layer_norm_epsilon,
+            "rope_base": 10000.0, "arch": "gpt",
+        }
+        if probe:
+            return meta
+        from paddle_tpu.ops import fused_decode as fd
+        from paddle_tpu.nn.functional import layer_norm as _ln
+        params = fd.build_fused_params_gpt(state, cfg.num_layers)
+        wte = state["gpt.wte.weight"]
+        wpe = state["gpt.wpe.weight"]
+        lnf_w = state["gpt.ln_f.weight"]
+        lnf_b = state["gpt.ln_f.bias"]
+        head_w = (wte.T if cfg.tie_word_embeddings
+                  else state["lm_head.weight"])
+
+        def embed(tok, pos):                  # (b,), scalar -> (b, h)
+            return jnp.take(wte, tok, axis=0) + wpe[pos]
+
+        def head(x):
+            xn = _ln(x, (x.shape[-1],), lnf_w, lnf_b,
+                     cfg.layer_norm_epsilon)
+            return jnp.dot(xn, head_w)
+
+        return dict(meta, params=params, embed=embed, head=head)
 
     def loss(self, logits, labels):
         return F.cross_entropy(logits.reshape(-1, logits.shape[-1]),

@@ -377,7 +377,8 @@ class LlamaForCausalLM(CausalLMBase):
         embed_w = state["model.embed_tokens.weight"]
         norm_w = state["model.norm.weight"]
 
-        def embed(tok):                       # (b,) -> (b, h)
+        def embed(tok, pos):                  # (b,), scalar -> (b, h)
+            del pos                           # rope positions, not learned
             return jnp.take(embed_w, tok, axis=0)
 
         if cfg.tie_word_embeddings:

@@ -863,10 +863,15 @@ def _pool(x, kernel, stride, padding, nd, reducer, init_val, avg=False,
     pads = [(0, 0), (0, 0)]
     for i, (ki, si, pi) in enumerate(zip(kernel, stride, p)):
         hi = pi
-        if ceil_mode:  # extend high padding so the last partial window counts
-            rem = (x.shape[2 + i] + 2 * pi - ki) % si
-            if rem:
-                hi = pi + (si - rem)
+        if ceil_mode:
+            # last partial window counts, but no window may START in the
+            # right padding (reference pooling rule) — compute the exact
+            # output count and the (possibly negative) high pad for it
+            n = x.shape[2 + i]
+            out = -(-(n + 2 * pi - ki) // si) + 1
+            if (out - 1) * si >= n + pi:
+                out -= 1
+            hi = (out - 1) * si + ki - n - pi
         pads.append((pi, hi))
     y = lax.reduce_window(x, init_val, reducer, window, strides, pads)
     if avg:

@@ -155,53 +155,30 @@ class MaxUnPool3D(Layer):
         return flat.reshape(n, c, od, oh, ow)
 
 
-def _ceil_pad(n, k, s, p):
-    """Extra high-side padding so reduce_window yields ceil-mode output."""
-    out = -(-(n + 2 * p - k) // s) + 1
-    return max(0, (out - 1) * s + k - (n + 2 * p))
-
-
 class LPPool1D(Layer):
     """(Σ window x^p)^(1/p) (reference paddle.nn.LPPool1D). The window
-    SUM comes from reduce_window directly (avg_pool's exclusive counts
-    would mis-scale padded edge windows)."""
+    SUM (and ceil_mode window math) comes from F._pool — avg_pool's
+    exclusive counts would mis-scale padded edge windows."""
 
     def __init__(self, norm_type, kernel_size, stride=None, padding=0,
                  ceil_mode=False, data_format="NCL"):
         super().__init__()
         self.p = float(norm_type)
+        self.nd = 1
         self.args = (kernel_size, stride or kernel_size, padding, ceil_mode)
 
     def forward(self, x):
         k, s, p, cm = self.args
-        hi = p + (_ceil_pad(x.shape[-1], k, s, p) if cm else 0)
-        sums = jax.lax.reduce_window(
-            x ** self.p, 0.0, jax.lax.add, (1, 1, k), (1, 1, s),
-            ((0, 0), (0, 0), (p, hi)))
+        sums = F._pool(x ** self.p, k, s, p, self.nd, jax.lax.add, 0.0,
+                       ceil_mode=cm)
         return sums ** (1.0 / self.p)
 
 
-class LPPool2D(Layer):
+class LPPool2D(LPPool1D):
     def __init__(self, norm_type, kernel_size, stride=None, padding=0,
                  ceil_mode=False, data_format="NCHW"):
-        super().__init__()
-        self.p = float(norm_type)
-        k = (kernel_size,) * 2 if isinstance(kernel_size, int) else kernel_size
-        st = stride or k
-        st = (st,) * 2 if isinstance(st, int) else st
-        pd = (padding,) * 2 if isinstance(padding, int) else padding
-        self.k, self.s, self.pd = k, st, pd
-        self.cm = ceil_mode
-
-    def forward(self, x):
-        k, s, p = self.k, self.s, self.pd
-        hi = [p[i] + (_ceil_pad(x.shape[2 + i], k[i], s[i], p[i])
-                      if self.cm else 0) for i in range(2)]
-        sums = jax.lax.reduce_window(
-            x ** self.p, 0.0, jax.lax.add, (1, 1) + tuple(k),
-            (1, 1) + tuple(s), ((0, 0), (0, 0), (p[0], hi[0]),
-                                (p[1], hi[1])))
-        return sums ** (1.0 / self.p)
+        super().__init__(norm_type, kernel_size, stride, padding, ceil_mode)
+        self.nd = 2
 
 
 def _fractional_starts(n_in, n_out, u):

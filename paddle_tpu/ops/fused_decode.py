@@ -25,8 +25,11 @@ TPU-native design: ONE `pallas_call` for the entire stack per decode step.
   and one token's cache append — which IS the decode roofline.
 
 The stack covers the Llama block (RMSNorm / GQA / RoPE / SwiGLU, no
-biases). `fused_decode_reference` is the jnp twin used for numerics tests
-and as the non-TPU fallback; `examples/decode_bench.py` measures the win.
+biases) and, via `arch="gpt"`, the GPT block (LayerNorm+bias / MHA / no
+rope / GELU) — the architecture the reference's fused_multi_transformer
+itself serves. `fused_decode_reference` is the jnp twin used for numerics
+tests and as the non-TPU fallback; `examples/decode_bench.py` measures
+the win.
 """
 
 import functools
@@ -94,6 +97,40 @@ def build_fused_params(state: Dict[str, jax.Array], num_layers: int,
     return out
 
 
+def build_fused_params_gpt(state: Dict[str, jax.Array], num_layers: int,
+                           prefix: str = "gpt.h.") -> Dict[str, jax.Array]:
+    """GPT-block stacks: LayerNorm scale+bias, fused qkv (weight already
+    packed 3h), biases on every projection, single GELU FFN."""
+    g = lambda i, n: state[f"{prefix}{i}.{n}"]
+    out = {
+        "ln1": jnp.stack([g(i, "ln_1.weight") for i in range(num_layers)]),
+        "ln1_b": jnp.stack([g(i, "ln_1.bias") for i in range(num_layers)]),
+        "wqkv": jnp.stack([g(i, "attn.qkv_proj.weight")
+                           for i in range(num_layers)]),
+        "bqkv": jnp.stack([g(i, "attn.qkv_proj.bias")
+                           for i in range(num_layers)]),
+        "wo": jnp.stack([g(i, "attn.out_proj.weight")
+                         for i in range(num_layers)]),
+        "bo": jnp.stack([g(i, "attn.out_proj.bias")
+                         for i in range(num_layers)]),
+        "ln2": jnp.stack([g(i, "ln_2.weight") for i in range(num_layers)]),
+        "ln2_b": jnp.stack([g(i, "ln_2.bias") for i in range(num_layers)]),
+        "wg": jnp.stack([g(i, "fc_in.weight") for i in range(num_layers)]),
+        "bg": jnp.stack([g(i, "fc_in.bias") for i in range(num_layers)]),
+        "wd": jnp.stack([g(i, "fc_out.weight") for i in range(num_layers)]),
+        "bd": jnp.stack([g(i, "fc_out.bias") for i in range(num_layers)]),
+    }
+    return out
+
+
+def _layernorm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    return (y.astype(w.dtype) * w + b)
+
+
 def _rms(x, w, eps):
     """fp32 rms-normalize, cast to w.dtype path of ops.rms_norm."""
     xf = x.astype(jnp.float32)
@@ -117,7 +154,7 @@ def _rope1(x, cos, sin):
 
 def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
                            num_heads: int, num_kv_heads: int,
-                           eps: float = 1e-5):
+                           eps: float = 1e-5, arch: str = "llama"):
     """One decode step through the whole stack; pure jnp.
 
     x (b, h); the KV cache is stored COMBINED and FLAT as
@@ -149,15 +186,22 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
             return y * params[f"{key}_s"][l]
         return jnp.dot(act, w, preferred_element_type=jnp.float32)
 
+    gpt = arch == "gpt"
     xf = x.astype(jnp.float32)
     for l in range(L):
-        xn = _rms(xf, params["ln1"][l], eps)
+        if gpt:
+            xn = _layernorm(xf, params["ln1"][l], params["ln1_b"][l], eps)
+        else:
+            xn = _rms(xf, params["ln1"][l], eps)
         qkv = wdot(xn, "wqkv", l)
+        if gpt:
+            qkv = qkv + params["bqkv"][l]
         q = qkv[:, :dq].reshape(b, nh, hd)
         k = qkv[:, dq:dq + nkv * hd].reshape(b, nkv, hd)
         v = qkv[:, dq + nkv * hd:].reshape(b, nkv, hd)
-        q = _rope1(q, cos_b, sin_b)
-        k = _rope1(k, cos_b, sin_b)
+        if not gpt:
+            q = _rope1(q, cos_b, sin_b)
+            k = _rope1(k, cos_b, sin_b)
         kv_cache = lax.dynamic_update_slice(
             kv_cache, jnp.concatenate(
                 [k.reshape(b, dkv), v.reshape(b, dkv)],
@@ -174,12 +218,21 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
         attn = attn.reshape(b, dq).astype(dtype)
-        xf = xf + wdot(attn, "wo", l)
-        xn2 = _rms(xf, params["ln2"][l], eps)
-        g = wdot(xn2, "wg", l)
-        u = wdot(xn2, "wu", l)
-        act = (jax.nn.silu(g) * u).astype(dtype)
-        xf = xf + wdot(act, "wd", l)
+        o = wdot(attn, "wo", l)
+        if gpt:
+            o = o + params["bo"][l]
+        xf = xf + o
+        if gpt:
+            xn2 = _layernorm(xf, params["ln2"][l], params["ln2_b"][l], eps)
+            g = wdot(xn2, "wg", l) + params["bg"][l]
+            act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            xf = xf + wdot(act, "wd", l) + params["bd"][l]
+        else:
+            xn2 = _rms(xf, params["ln2"][l], eps)
+            g = wdot(xn2, "wg", l)
+            u = wdot(xn2, "wu", l)
+            act = (jax.nn.silu(g) * u).astype(dtype)
+            xf = xf + wdot(act, "wd", l)
     return xf.astype(dtype), kv_cache
 
 
@@ -205,7 +258,8 @@ def _pick_ffn_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
 def _fused_decode_pallas(x, params, kv_cache, pos, *,
                          num_heads: int, num_kv_heads: int, head_dim: int,
                          rope_base: float = 10000.0,
-                         eps: float = 1e-5, chunk: int = 0):
+                         eps: float = 1e-5, chunk: int = 0,
+                         arch: str = "llama"):
     # NOTE: not jit-wrapped — always invoked inside the caller's jit (the
     # generate() scan); a nested jit around a pallas_call trips XLA's
     # closed_call lowering cache.
@@ -234,6 +288,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     dqkv = dq + 2 * dkv
     ffn = params["wg"].shape[2]
     int8 = "wqkv_s" in params
+    gpt = arch == "gpt"
     wbytes = 1 if int8 else 2
     J, fblk = _pick_ffn_blocks(
         ffn, h, fixed_bytes=(dqkv + nh * hd) * h * wbytes, wbytes=wbytes)
@@ -246,9 +301,19 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     scale = 1.0 / math.sqrt(hd)
 
     def kernel(*refs):
-        (pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref, wg_ref,
-         wu_ref, wd_ref) = refs[:9]
-        i = 9
+        if gpt:       # no gate weight: single GELU FFN matmul
+            (pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref,
+             wg_ref, wd_ref) = refs[:8]
+            wu_ref = None
+            i = 8
+        else:
+            (pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref,
+             wg_ref, wu_ref, wd_ref) = refs[:9]
+            i = 9
+        if gpt:
+            (ln1b_ref, ln2b_ref, bqkv_ref, bo_ref, bg_ref,
+             bd_ref) = refs[i:i + 6]
+            i += 6
         if int8:
             sqkv_ref, so_ref, sg_ref, su_ref, sd_ref = refs[i:i + 5]
             i += 5
@@ -291,18 +356,26 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             def _():
                 rkb.start()
 
-            xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
+            if gpt:
+                xn = _layernorm(x_s[...], ln1_ref[...].reshape(h),
+                                ln1b_ref[...].reshape(h), eps)
+            else:
+                xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
             qkv = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
-            # rope angles computed in-kernel from pos (NeoX convention:
-            # freqs repeated over both halves) — no XLA-side cos/sin table
-            half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
-                    % (hd // 2)).astype(jnp.float32)
-            inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
-            ang = pos.astype(jnp.float32) * inv_freq
-            cos_b = jnp.cos(ang)
-            sin_b = jnp.sin(ang)
-            rope2 = lambda t: (t * cos_b + jnp.concatenate(
-                [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
+            if gpt:
+                qkv = qkv + bqkv_ref[...]
+                rope2 = lambda t: t
+            else:
+                # rope angles computed in-kernel from pos (NeoX convention:
+                # freqs repeated over both halves) — no XLA cos/sin table
+                half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+                        % (hd // 2)).astype(jnp.float32)
+                inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
+                ang = pos.astype(jnp.float32) * inv_freq
+                cos_b = jnp.cos(ang)
+                sin_b = jnp.sin(ang)
+                rope2 = lambda t: (t * cos_b + jnp.concatenate(
+                    [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
             # heads via lane slices (no lane reshapes): q into a 3D f32
             # scratch; new k/v staged FLAT (b, dkv) f32 for the RMW merge
             for g in range(nh):
@@ -411,9 +484,17 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                         rows=slice(hh * hd, (hh + 1) * hd))
             if int8:
                 oacc = oacc * so_ref[...]
+            if gpt:
+                oacc = oacc + bo_ref[...]
             x = x_s[...] + oacc
             x_s[...] = x
-            xn_s[...] = _rms(x, ln2_ref[...].reshape(h), eps).astype(dtype)
+            if gpt:
+                xn_s[...] = _layernorm(x, ln2_ref[...].reshape(h),
+                                       ln2b_ref[...].reshape(h),
+                                       eps).astype(dtype)
+            else:
+                xn_s[...] = _rms(x, ln2_ref[...].reshape(h),
+                                 eps).astype(dtype)
             acc_s[...] = jnp.zeros_like(acc_s)
 
         @pl.when(j > 0)
@@ -442,9 +523,18 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
 
             xn = xn_s[...]
             g = wdot(xn, wg_ref, sg_ref if int8 else None)
-            u = wdot(xn, wu_ref, su_ref if int8 else None)
-            act = (jax.nn.silu(g) * u).astype(dtype)
+            if gpt:
+                g = g + bg_ref[...]
+                act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            else:
+                u = wdot(xn, wu_ref, su_ref if int8 else None)
+                act = (jax.nn.silu(g) * u).astype(dtype)
             acc_s[...] += wdot(act, wd_ref, sd_ref if int8 else None)
+
+            if gpt:
+                @pl.when(j == J)
+                def _():
+                    acc_s[...] += jnp.broadcast_to(bd_ref[...], acc_s.shape)
 
             @pl.when(j == J)
             def _():
@@ -473,13 +563,24 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pl.BlockSpec((None, h, fblk),
                          lambda l, j: (lax.max(l - (j == 0), 0), 0,
                                        jm(l, j))),                  # wg
+        ] + ([] if gpt else [
             pl.BlockSpec((None, h, fblk),
                          lambda l, j: (lax.max(l - (j == 0), 0), 0,
                                        jm(l, j))),                  # wu
+        ]) + [
             pl.BlockSpec((None, fblk, h),
                          lambda l, j: (lax.max(l - (j == 0), 0),
                                        jm(l, j), 0)),               # wd
         ] + ([
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln1_b
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln2_b
+            pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # bqkv
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bo
+            pl.BlockSpec((None, 1, fblk),
+                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
+                                       jm(l, j))),                  # bg
+            pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bd
+        ] if gpt else []) + ([
             pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # sqkv
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # so
             pl.BlockSpec((None, 1, fblk),
@@ -511,7 +612,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pltpu.SemaphoreType.DMA((1,)),            # wsem
             pltpu.SemaphoreType.DMA((2,)),            # rsem
         ],
-        input_output_aliases={(14 if int8 else 9): 1},
+        input_output_aliases={(9 - gpt + 6 * gpt + 5 * int8): 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             # v5e has 128 MiB VMEM; the default 16 MiB scoped limit can't
@@ -520,8 +621,12 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
         name="fused_decode_step",
     )(jnp.asarray(pos, jnp.int32).reshape(1), x,
       params["ln1"][:, None], params["wqkv"],
-      params["wo"], params["ln2"][:, None], params["wg"], params["wu"],
+      params["wo"], params["ln2"][:, None], params["wg"],
+      *(() if gpt else (params["wu"],)),
       params["wd"],
+      *((params["ln1_b"][:, None], params["ln2_b"][:, None],
+         params["bqkv"][:, None], params["bo"][:, None],
+         params["bg"][:, None], params["bd"][:, None]) if gpt else ()),
       *((params["wqkv_s"], params["wo_s"], params["wg_s"],
          params["wu_s"], params["wd_s"]) if int8 else ()),
       kv_cache)
@@ -534,7 +639,7 @@ _fallback_logged = False
 
 def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                       num_heads: int, num_kv_heads: int, eps: float = 1e-5,
-                      rope_base: float = 10000.0):
+                      rope_base: float = 10000.0, arch: str = "llama"):
     """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
 
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
@@ -548,7 +653,7 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                 x, params, kv_cache, pos,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,
                 head_dim=dkv // num_kv_heads,
-                rope_base=rope_base, eps=eps)
+                rope_base=rope_base, eps=eps, arch=arch)
         except Exception as e:  # pragma: no cover - hardware-dependent
             from paddle_tpu.core.flags import flag
             if flag("FLAGS_pallas_strict"):
@@ -563,4 +668,4 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                     type(e).__name__, e)
     return fused_decode_reference(
         x, params, kv_cache, pos, cos, sin,
-        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps)
+        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps, arch=arch)

@@ -68,7 +68,7 @@ def test_reference_step_matches_layered_decode(nkv):
         [c["k"].reshape(b, S, -1), c["v"].reshape(b, S, -1)], axis=-1)
         for c in cache])
     cos, sin = rope_cos_sin(S, cfg.head_dim, base=cfg.rope_base)
-    x = plan["embed"](tok)
+    x = plan["embed"](tok, prompt)
     x, kv = fd.fused_decode_reference(
         x, plan["params"], kv, prompt, cos[prompt:prompt + 1],
         sin[prompt:prompt + 1], num_heads=cfg.num_heads,
@@ -105,3 +105,22 @@ def test_plan_gates_on_quantized_state():
     bad = {k: v for k, v in state.items()
            if "q_proj" not in k}          # missing keys -> no plan
     assert m.fused_decode_plan(bad) is None
+
+
+def test_gpt_fused_reference_matches_unfused():
+    """arch='gpt' jnp twin == the layered GPT decode, token for token."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+
+    paddle_tpu.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=3,
+                    num_heads=2, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    g = GPTPretrainModel(cfg)
+    g.eval()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 7)))
+    set_flags({"FLAGS_fused_decode": False})
+    out_ref = generate(g, prompt, max_new_tokens=12, temperature=0.0)
+    g._generate_jit_cache = {}
+    set_flags({"FLAGS_fused_decode": True})
+    out_fused = generate(g, prompt, max_new_tokens=12, temperature=0.0)
+    assert np.asarray(out_ref).tolist() == np.asarray(out_fused).tolist()

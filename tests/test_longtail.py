@@ -313,3 +313,11 @@ def test_cumulative_trapezoid_with_x_axis0():
     yn, xn = np.asarray(y), np.asarray(x)
     ref = np.cumsum((yn[1:] + yn[:-1]) / 2 * np.diff(xn, axis=0), axis=0)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_lp_pool_ceil_mode_window_count():
+    """ceil_mode counts the last partial window but no window may start
+    in the right padding (k=1, s=3, n=5 -> 2 outputs, not 3)."""
+    x = jnp.asarray(np.arange(1.0, 6.0).reshape(1, 1, 5))
+    out = nn.LPPool1D(1.0, 1, stride=3, ceil_mode=True)(x)
+    np.testing.assert_allclose(np.asarray(out), [[[1.0, 4.0]]])

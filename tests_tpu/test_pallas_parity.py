@@ -240,3 +240,27 @@ def test_fused_decode_int8_generate_on_tpu():
     set_flags({"FLAGS_fused_decode": True})
     match = (np.asarray(out_fused) == np.asarray(out_ref)).mean()
     assert match >= 0.9, match    # int8 near-ties may flip a token
+
+
+def test_fused_decode_gpt_arch_on_tpu():
+    """arch='gpt' kernel branch (LayerNorm+bias / MHA / no rope / GELU):
+    greedy decode must match the layered scan decoder."""
+    import paddle_tpu
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.inference import generate
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+
+    paddle_tpu.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=3,
+                    num_heads=2, max_position_embeddings=512,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    g = GPTPretrainModel(cfg).bfloat16()
+    g.eval()
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 9)))
+    out_fused = generate(g, prompt, max_new_tokens=16, temperature=0.0)
+    g._generate_jit_cache = {}
+    set_flags({"FLAGS_fused_decode": False, "FLAGS_pallas_strict": False})
+    out_ref = generate(g, prompt, max_new_tokens=16, temperature=0.0)
+    set_flags({"FLAGS_fused_decode": True})
+    match = (np.asarray(out_fused) == np.asarray(out_ref)).mean()
+    assert match >= 0.95, match
