@@ -28,6 +28,10 @@ __all__ = [
     "exp2", "float_power", "true_divide", "bitwise_invert", "gammaln",
     "gammainc", "erfc", "xlogy", "aminmax", "broadcast_shapes", "crop",
     "strided_slice",
+    "angle", "assign", "clone", "rank", "increment", "scale", "softsign",
+    "logspace", "histc", "unstack", "view", "view_as", "swapdims",
+    "shard_index", "reduce_as", "multigammaln", "lu_solve",
+    "standard_normal", "bernoulli", "poisson", "multinomial",
 ]
 
 
@@ -359,3 +363,154 @@ def strided_slice(x, axes, starts, ends, strides):
     for a, s, e, st in zip(axes, starts, ends, strides):
         idx[a] = slice(s, e, st)
     return jnp.asarray(x)[tuple(idx)]
+
+
+# ---- round-3 second batch: real paddle APIs still missing ------------------
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def assign(x, output=None):
+    """Functional assign (returns a copy; paddle's in-place form has no
+    meaning for immutable jax arrays — callers rebind)."""
+    return jnp.array(jnp.asarray(x), copy=True)
+
+
+clone = assign
+
+
+def rank(x):
+    return jnp.asarray(jnp.asarray(x).ndim)
+
+
+def increment(x, value=1.0):
+    return jnp.asarray(x) + value
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    x = jnp.asarray(x)
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        out = getattr(jax.nn, act)(out)
+    return out
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=dtype)
+
+
+def histc(x, bins=100, min=0.0, max=0.0):
+    x = jnp.asarray(x).ravel()
+    if min == 0.0 and max == 0.0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    return jnp.histogram(x, bins=bins, range=(lo, hi))[0]
+
+
+def unstack(x, axis=0, num=None):
+    x = jnp.asarray(x)
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+def view(x, shape_or_dtype):
+    """Reshape view, or bitcast view when given a dtype (paddle.view:
+    width-changing bitcasts fold into / split from the LAST dim)."""
+    x = jnp.asarray(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(shape_or_dtype)
+    out = jax.lax.bitcast_convert_type(x, shape_or_dtype)
+    if out.ndim == x.ndim + 1:        # narrowing: fold the new axis
+        return out.reshape(x.shape[:-1] + (-1,))
+    return out
+
+
+def view_as(x, other):
+    return jnp.asarray(x).reshape(jnp.asarray(other).shape)
+
+
+def swapdims(x, axis1, axis2):
+    return jnp.swapaxes(jnp.asarray(x), axis1, axis2)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference paddle.shard_index)."""
+    x = jnp.asarray(x)
+    per = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * per, (shard_id + 1) * per
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+def reduce_as(x, target):
+    """Sum-reduce x down to target's shape (reference paddle.reduce_as)."""
+    x = jnp.asarray(x)
+    t = jnp.asarray(target)
+    lead = x.ndim - t.ndim
+    axes = tuple(range(lead)) + tuple(
+        lead + i for i, (sx, st) in enumerate(zip(x.shape[lead:], t.shape))
+        if st == 1 and sx != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(t.shape)
+
+
+def multigammaln(x, p):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def lu_solve(b, lu_data, lu_pivots):
+    """Solve A x = b from lu()'s factorization (1-based pivots)."""
+    return jax.scipy.linalg.lu_solve(
+        (jnp.asarray(lu_data), jnp.asarray(lu_pivots) - 1), jnp.asarray(b))
+
+
+# random-family: SAME "default" stream and default-dtype handling as
+# tensor.rand/randn (rng_guard frames under jit work identically)
+def _next_key():
+    from paddle_tpu.core import rng as _rng
+    return _rng.next_rng_key()
+
+
+def standard_normal(shape, dtype=None):
+    from paddle_tpu.core.dtype import get_default_dtype, to_jax_dtype
+    return jax.random.normal(
+        _next_key(), tuple(shape),
+        dtype=to_jax_dtype(dtype) if dtype else get_default_dtype())
+
+
+def bernoulli(x):
+    x = jnp.asarray(x)
+    return jax.random.bernoulli(_next_key(), x).astype(x.dtype)
+
+
+def poisson(x):
+    x = jnp.asarray(x)
+    return jax.random.poisson(_next_key(), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    x = jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(
+            _next_key(), logits, axis=-1,
+            shape=(num_samples,) + x.shape[:-1]).T \
+            if x.ndim > 1 else jax.random.categorical(
+                _next_key(), logits, shape=(num_samples,))
+    if not isinstance(x, jax.core.Tracer):   # eager: enforce like ref
+        nz = int(np.asarray((x > 0).sum(-1).min()))
+        if num_samples > nz:
+            raise ValueError(
+                f"multinomial(replacement=False): num_samples "
+                f"{num_samples} exceeds the {nz} nonzero-weight "
+                "categories")
+    # without replacement: Gumbel top-k
+    g = jax.random.gumbel(_next_key(), x.shape)
+    return jax.lax.top_k(logits + g, num_samples)[1]

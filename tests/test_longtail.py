@@ -321,3 +321,43 @@ def test_lp_pool_ceil_mode_window_count():
     x = jnp.asarray(np.arange(1.0, 6.0).reshape(1, 1, 5))
     out = nn.LPPool1D(1.0, 1, stride=3, ceil_mode=True)(x)
     np.testing.assert_allclose(np.asarray(out), [[[1.0, 4.0]]])
+
+
+def test_second_batch_tensor_ops():
+    paddle_tpu.seed(0)
+    # shard_index
+    ids = jnp.asarray([1, 5, 9, 14])
+    out = T.shard_index(ids, index_num=16, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(np.asarray(out), [-1, -1, 1, 6])
+    # reduce_as sums broadcast dims
+    x = jnp.asarray(np.arange(12.0).reshape(3, 4))
+    t = jnp.zeros((1, 4))
+    np.testing.assert_allclose(np.asarray(T.reduce_as(x, t)),
+                               np.asarray(x).sum(0, keepdims=True))
+    # lu_solve round-trips linalg.lu
+    a = jnp.asarray(np.random.RandomState(0).randn(4, 4).astype(np.float64)
+                    + 4 * np.eye(4))
+    b = jnp.asarray(np.random.RandomState(1).randn(4, 2).astype(np.float64))
+    lu_data, piv = L.lu(a)
+    xs = T.lu_solve(b, lu_data, piv)
+    np.testing.assert_allclose(np.asarray(a @ xs), np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
+    # view dtype bitcast + shape view
+    v = T.view(jnp.asarray([1.0], jnp.float32), "int32")
+    assert v.dtype == jnp.int32
+    assert T.view(jnp.zeros((2, 6)), (3, 4)).shape == (3, 4)
+    # scale/increment/unstack/histc
+    np.testing.assert_allclose(
+        np.asarray(T.scale(jnp.asarray([2.0]), scale=3.0, bias=1.0)), [7.0])
+    parts = T.unstack(jnp.zeros((3, 2)), axis=0)
+    assert len(parts) == 3 and parts[0].shape == (2,)
+    h = T.histc(jnp.asarray([0.1, 0.2, 0.9]), bins=2, min=0.0, max=1.0)
+    np.testing.assert_array_equal(np.asarray(h), [2, 1])
+    # random family shapes + determinism under seed
+    paddle_tpu.seed(7)
+    r1 = T.standard_normal((4,))
+    paddle_tpu.seed(7)
+    r2 = T.standard_normal((4,))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    m = T.multinomial(jnp.asarray([0.1, 0.1, 0.8]), num_samples=2)
+    assert m.shape[-1] == 2 and len(set(np.asarray(m).tolist())) == 2
