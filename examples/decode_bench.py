@@ -15,9 +15,9 @@ achieved decode tokens/s and compares against that roofline:
     bytes/step  =  param_bytes  +  B · kv_bytes(cache_len)
     roofline tok/s  =  B · HBM_BW / bytes_per_step
 
-Run: python examples/decode_bench.py [--model llama-1b] [--batch 8]
-Prints one JSON line; the driver's bench.py embeds the headline decode
-number as an extra key.
+Run: python examples/decode_bench.py [--model llama-1b|gpt2-345m]
+[--batch 8] [--int8]. Prints one JSON line; SCALE.md records the
+measured table (fused decode-step kernel, device-clock timing).
 """
 
 import argparse
