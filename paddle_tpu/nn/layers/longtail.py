@@ -312,9 +312,13 @@ class SpectralNorm(Layer):
             u = u / (jnp.linalg.norm(u) + self.eps)
         # persist the iteration (reference updates in place each forward
         # so the estimate converges across steps; under functional_call
-        # the update applies to the eager buffers only)
-        self._buffers["weight_u"] = jax.lax.stop_gradient(u)
-        self._buffers["weight_v"] = jax.lax.stop_gradient(v)
+        # the update applies to the eager buffers only). Under jit/grad the
+        # values are tracers — storing those on the eager module would leak
+        # them (UnexpectedTracerError on the next eager use), so persist
+        # only concrete values.
+        if not isinstance(u, jax.core.Tracer):
+            self._buffers["weight_u"] = jax.lax.stop_gradient(u)
+            self._buffers["weight_v"] = jax.lax.stop_gradient(v)
         sigma = u @ mat @ v
         return weight / sigma
 

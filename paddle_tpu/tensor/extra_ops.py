@@ -426,6 +426,16 @@ def view(x, shape_or_dtype):
     x = jnp.asarray(x)
     if isinstance(shape_or_dtype, (list, tuple)):
         return x.reshape(shape_or_dtype)
+    src = jnp.dtype(x.dtype).itemsize
+    dst = jnp.dtype(shape_or_dtype).itemsize
+    if dst > src:                     # widening: lax requires the last dim
+        ratio = dst // src            # grouped as (..., n//ratio, ratio)
+        n = x.shape[-1]
+        if n % ratio:
+            raise ValueError(
+                f"view: last dim {n} not divisible by the width ratio "
+                f"{ratio} for {x.dtype} -> {jnp.dtype(shape_or_dtype).name}")
+        x = x.reshape(x.shape[:-1] + (n // ratio, ratio))
     out = jax.lax.bitcast_convert_type(x, shape_or_dtype)
     if out.ndim == x.ndim + 1:        # narrowing: fold the new axis
         return out.reshape(x.shape[:-1] + (-1,))
@@ -499,11 +509,13 @@ def multinomial(x, num_samples=1, replacement=False):
     x = jnp.asarray(x)
     logits = jnp.log(jnp.maximum(x, 1e-30))
     if replacement:
+        if x.ndim > 1:
+            out = jax.random.categorical(
+                _next_key(), logits, axis=-1,
+                shape=(num_samples,) + x.shape[:-1])
+            return jnp.moveaxis(out, 0, -1)   # samples axis last, any rank
         return jax.random.categorical(
-            _next_key(), logits, axis=-1,
-            shape=(num_samples,) + x.shape[:-1]).T \
-            if x.ndim > 1 else jax.random.categorical(
-                _next_key(), logits, shape=(num_samples,))
+            _next_key(), logits, shape=(num_samples,))
     if not isinstance(x, jax.core.Tracer):   # eager: enforce like ref
         nz = int(np.asarray((x > 0).sum(-1).min()))
         if num_samples > nz:

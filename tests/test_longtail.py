@@ -361,3 +361,43 @@ def test_second_batch_tensor_ops():
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
     m = T.multinomial(jnp.asarray([0.1, 0.1, 0.8]), num_samples=2)
     assert m.shape[-1] == 2 and len(set(np.asarray(m).tolist())) == 2
+
+
+def test_view_widening_bitcast():
+    # f16 (2, 6) -> f32 folds pairs: shape (2, 3), values roundtrip
+    x = jnp.asarray(rs.randn(2, 6).astype(np.float16))
+    wide = T.view(x, jnp.float32)
+    assert wide.shape == (2, 3)
+    back = T.view(wide, jnp.float16)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    # int8 -> int32 (ratio 4)
+    i = jnp.arange(8, dtype=jnp.int8)
+    assert T.view(i, jnp.int32).shape == (2,)
+    with pytest.raises(ValueError):
+        T.view(jnp.zeros((3,), jnp.float16), jnp.float32)
+
+
+def test_multinomial_replacement_batched_layout():
+    paddle_tpu.seed(0)
+    # batch (2, 3) over 4 categories; each row's mass on one category
+    w = np.zeros((2, 3, 4), np.float32)
+    hot = np.array([[0, 1, 2], [3, 2, 1]])
+    for b in range(2):
+        for r in range(3):
+            w[b, r, hot[b, r]] = 1.0
+    out = T.multinomial(jnp.asarray(w), num_samples=5, replacement=True)
+    assert out.shape == (2, 3, 5)          # samples axis LAST, batch intact
+    np.testing.assert_array_equal(
+        np.asarray(out), np.repeat(hot[..., None], 5, axis=-1))
+
+
+def test_spectral_norm_under_jit_no_tracer_leak():
+    paddle_tpu.seed(0)
+    sn = nn.SpectralNorm((6, 4), power_iters=2)
+    w = jnp.asarray(rs.randn(6, 4).astype(np.float32))
+    jax.jit(sn)(w)                          # traced forward
+    assert not isinstance(sn.weight_u, jax.core.Tracer)
+    sn(w)                                   # eager use must not raise
+    u0 = np.asarray(sn.weight_u).copy()
+    sn(w)                                   # eager persistence still works
+    assert not np.array_equal(u0, np.asarray(sn.weight_u))
