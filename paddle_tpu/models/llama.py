@@ -49,6 +49,17 @@ class LlamaConfig:
     context_parallel: Optional[str] = None
     # per-layer activation recompute in the no-cache (training) forward
     recompute: bool = False
+    # reference recompute_granularity (fleet recompute): what gets
+    # RECOMPUTED in backward. 'full' = the whole layer (boundaries only
+    # saved — max memory saving, ~fwd/3 extra FLOPs); 'full_attn' = the
+    # attention block (projection/FFN matmul outputs saved); 'core_attn' =
+    # only softmax(qk)v (q/k/v saved too — min recompute: the flash
+    # kernel's fwd replay for its LSE residual is the only matmul re-run)
+    recompute_granularity: str = "full"
+    # train_loss(): compute the final norm→unembed→CE in this many
+    # sequence chunks under remat (the full (b, s, vocab) logits tensor
+    # never materializes); 1 = plain head+loss
+    loss_seq_chunks: int = 1
 
     @property
     def kv_heads(self):
@@ -69,20 +80,15 @@ class LlamaConfig:
         return cls()
 
     @classmethod
-    def llama_65b(cls):
-        """Llama-65B shape (BASELINE config #2 north-star scale)."""
-        return cls(hidden_size=8192, intermediate_size=22016, num_layers=80,
-                   num_heads=64, max_position_embeddings=2048)
-
-    @classmethod
     def llama2_13b(cls):
         return cls(hidden_size=5120, intermediate_size=13824, num_layers=40,
                    num_heads=40)
 
     @classmethod
     def llama_65b(cls):
+        """Llama-65B shape (BASELINE config #2 north-star scale)."""
         return cls(hidden_size=8192, intermediate_size=22016, num_layers=80,
-                   num_heads=64)
+                   num_heads=64, max_position_embeddings=2048)
 
     @classmethod
     def llama2_70b(cls):
@@ -151,9 +157,15 @@ class LlamaAttention(nn.Layer):
             out = context_parallel_attention(q, k, v, axis="sep",
                                              mode=cfg.context_parallel)
         else:
+            # named for the recompute_granularity save policies
+            from jax.ad_checkpoint import checkpoint_name
+            q = checkpoint_name(q, "attn_qkv")
+            k = checkpoint_name(k, "attn_qkv")
+            v = checkpoint_name(v, "attn_qkv")
             # always causal; an attn_mask (e.g. padding) composes with it
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=True)
+            out = checkpoint_name(out, "attn_out")
         return self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
 
 
@@ -174,7 +186,10 @@ class LlamaMLP(nn.Layer):
             has_bias=False, input_is_parallel=True)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        from jax.ad_checkpoint import checkpoint_name
+        g = checkpoint_name(self.gate_proj(x), "ffn_gate")
+        u = checkpoint_name(self.up_proj(x), "ffn_up")
+        return self.down_proj(F.silu(g) * u)
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -227,12 +242,32 @@ class LlamaModel(nn.Layer):
             return self.norm(x), new_cache
         if cfg.recompute:
             # per-layer activation recompute (reference: fleet per-layer
-            # recompute, fleet/meta_parallel recompute_hybrid): backward
-            # rematerializes each block from its input; only the layer
-            # boundaries stay live
+            # recompute, fleet/meta_parallel recompute_hybrid). The
+            # granularity maps to a named-save policy: 'full' saves only
+            # layer boundaries; 'full_attn'/'core_attn' additionally save
+            # the big matmul outputs so backward re-runs only the cheap
+            # elementwise ops (+ the attention core for 'full_attn').
+            from jax.ad_checkpoint import checkpoint_policies as cp
+            gran = cfg.recompute_granularity
+            # attn_out is deliberately NOT saved: the flash kernel's
+            # backward replays its forward for the LSE residual anyway,
+            # which reproduces the output — saving it would spend
+            # b·s·h bytes/layer for nothing
+            if gran == "full":
+                policy = None
+            elif gran == "full_attn":
+                policy = cp.save_only_these_names("ffn_gate", "ffn_up")
+            elif gran == "core_attn":
+                policy = cp.save_only_these_names(
+                    "attn_qkv", "ffn_gate", "ffn_up")
+            else:
+                raise ValueError(
+                    f"unknown recompute_granularity {gran!r}; expected "
+                    "'full', 'full_attn' or 'core_attn'")
             for layer in self.layers:
                 x = jax.checkpoint(
-                    lambda t, _l=layer: _l(t, cos, sin, attn_mask))(x)
+                    lambda t, _l=layer: _l(t, cos, sin, attn_mask),
+                    policy=policy)(x)
         else:
             for layer in self.layers:
                 x = layer(x, cos, sin, attn_mask)
@@ -253,6 +288,55 @@ class CausalLMBase(nn.Layer):
     def num_params(self):
         import numpy as np
         return sum(int(np.prod(p.shape)) for _, p in self.named_parameters())
+
+    def train_loss(self, input_ids, labels, attn_mask=None):
+        """Fused forward + LM loss. With ``cfg.loss_seq_chunks > 1`` the
+        final norm→unembed→cross-entropy runs in sequence chunks under
+        remat, so the full (b, s, vocab) logits tensor never exists — the
+        TPU analog of the reference's fused head/loss kernels
+        (fused_linear_param_grad_add + _c_softmax_with_cross_entropy):
+        at 32k vocab the logits are the single largest training
+        activation (0.5-1 GiB at b4 s2048), and chunking trades them for
+        a per-chunk lm_head replay in backward (~1% of step FLOPs)."""
+        chunks = getattr(self.cfg, "loss_seq_chunks", 1)
+        x = self.model(input_ids, attn_mask)
+        aux = jnp.zeros((), jnp.float32)
+        if isinstance(x, tuple):      # MoE bodies return (hidden, aux)
+            x, aux = x
+            aux = getattr(self.cfg, "aux_loss_weight", 1.0) * aux
+        if chunks <= 1:
+            return self.loss_fn(self._unembed(x), labels,
+                                reduction="mean") + aux
+        b, s, h = x.shape
+        if s % chunks:
+            raise ValueError(
+                f"loss_seq_chunks={chunks} does not divide seq {s}")
+        sc = s // chunks
+        xc = jnp.moveaxis(x.reshape(b, chunks, sc, h), 1, 0)
+        lc = jnp.moveaxis(labels.reshape(b, chunks, sc), 1, 0)
+        ignore = getattr(self.loss_fn, "ignore_index", -100)
+
+        @jax.checkpoint
+        def chunk_sums(x_c, l_c):
+            nll = self.loss_fn(self._unembed(x_c), l_c, reduction="none")
+            return jnp.sum(nll), jnp.sum(l_c != ignore)
+
+        def body(carry, xs):
+            loss_sum, cnt = carry
+            a, n = chunk_sums(*xs)
+            return (loss_sum + a, cnt + n), None
+
+        (loss_sum, cnt), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (xc, lc))
+        return loss_sum / jnp.maximum(cnt, 1) + aux
+
+    def _unembed(self, x):
+        if getattr(self.cfg, "tie_word_embeddings", False):
+            from paddle_tpu.parallel import mp_layers as _mp
+            logits = jnp.matmul(x, self.model.embed_tokens.weight.T)
+            return _mp.constrain(logits, _mp._last_dim_spec(_mp.MP_AXIS))
+        return self.lm_head(x)
 
     def _pipeline_block_apply(self, template):
         """(one_block_state, h) -> h, built over `template`. Subclasses with
@@ -339,13 +423,7 @@ class LlamaForCausalLM(CausalLMBase):
                                       start_pos=start_pos)
             return self._unembed(x), new_cache
         x = self.model(input_ids, attn_mask)
-        return self._unembed(x)
-
-    def _unembed(self, x):
-        if self.cfg.tie_word_embeddings:
-            logits = jnp.matmul(x, self.model.embed_tokens.weight.T)
-            return mp.constrain(logits, mp._last_dim_spec(mp.MP_AXIS))
-        return self.lm_head(x)
+        return self._unembed(x)    # _unembed: CausalLMBase
 
     def fused_decode_plan(self, state, probe=False):
         """Plan for the fused decode-step path (ops.fused_decode — the

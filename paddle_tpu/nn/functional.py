@@ -323,23 +323,80 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
 
 # ---- losses ----------------------------------------------------------------
 
+@jax.custom_vjp
+def _token_nll(logits, label):
+    """-log softmax(logits)[label] over the LAST axis, per token.
+
+    Memory-lean at LM scale: residuals are the ORIGINAL-dtype logits plus
+    the (…,) fp32 lse — autodiff of log_softmax/logsumexp instead keeps a
+    full-vocab fp32 tensor alive ((b, s, V) ≈ 1 GiB at V=32k b4 s2048).
+    The backward's softmax-minus-onehot is one elementwise fusion emitting
+    grads in the logits dtype."""
+    return _token_nll_fwd(logits, label)[0]
+
+
+def _token_nll_fwd(logits, label):
+    cdt = jnp.promote_types(logits.dtype, jnp.float32)
+    # both consumers of the fp32 cast are REDUCTIONS, so XLA fuses the
+    # cast into their loops instead of materializing a full-vocab fp32
+    # tensor; `picked` is a one-hot masked sum rather than a gather (a
+    # gather on the class axis trips the SPMD partitioner when the logits
+    # are vocab-sharded — ParallelCrossEntropy's mp path)
+    lse = jax.scipy.special.logsumexp(logits.astype(cdt), axis=-1)
+    oh = (jnp.arange(logits.shape[-1], dtype=label.dtype)
+          == label[..., None])
+    picked = jnp.sum(jnp.where(oh, logits.astype(cdt), 0), axis=-1)
+    return lse - picked, (logits, label, lse)
+
+
+def _token_nll_bwd(res, g):
+    logits, label, lse = res
+    cdt = jnp.promote_types(logits.dtype, jnp.float32)
+    p = jnp.exp(logits.astype(cdt) - lse[..., None])
+    oh = (jnp.arange(logits.shape[-1], dtype=label.dtype)
+          == label[..., None])
+    dz = (p - oh) * g[..., None]
+    return dz.astype(logits.dtype), None
+
+
+_token_nll.defvjp(_token_nll_fwd, _token_nll_bwd)
+
+
 def cross_entropy(logits, label, reduction="mean", soft_label=False,
                   ignore_index=-100, axis=-1, label_smoothing=0.0):
     cdt = jnp.promote_types(logits.dtype, jnp.float32)
-    logp = jax.nn.log_softmax(logits.astype(cdt), axis=axis)
     if soft_label:
+        logp = jax.nn.log_softmax(logits.astype(cdt), axis=axis)
         loss = -jnp.sum(label * logp, axis=axis)
     else:
         label = label.astype(jnp.int32)
-        oh = jax.nn.one_hot(label, logits.shape[axis], axis=axis, dtype=cdt)
+        ax = axis % logits.ndim
+        # reference softmax_with_cross_entropy convention: hard labels may
+        # carry a singleton at the class axis; the loss keeps that dim
+        keep_axis = label.ndim == logits.ndim and label.shape[ax] == 1
+        if keep_axis:
+            label = jnp.squeeze(label, ax)
         if label_smoothing > 0.0:
-            n = logits.shape[axis]
-            oh = oh * (1.0 - label_smoothing) + label_smoothing / n
-        loss = -jnp.sum(oh * logp, axis=axis)
+            z = logits.astype(cdt)
+            lse = jax.scipy.special.logsumexp(z, axis=ax)
+            oh = (jax.lax.broadcasted_iota(label.dtype, z.shape, ax)
+                  == jnp.expand_dims(label, ax))
+            picked = jnp.sum(jnp.where(oh, z, 0), axis=ax)
+            # -sum(oh·logp), oh = (1-ls)·onehot + ls/n
+            n = z.shape[ax]
+            mean_nll = lse - jnp.sum(z, axis=ax) / n
+            loss = ((1.0 - label_smoothing) * (lse - picked)
+                    + label_smoothing * mean_nll)
+        else:
+            z = logits if ax == logits.ndim - 1 else jnp.moveaxis(
+                logits, ax, -1)
+            loss = _token_nll(z, label)
         valid = (label != ignore_index)
         loss = jnp.where(valid, loss, 0.0)
         if reduction == "mean":
             return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        if keep_axis:
+            loss = jnp.expand_dims(loss, ax)
     if reduction == "mean":
         return jnp.mean(loss)
     if reduction == "sum":

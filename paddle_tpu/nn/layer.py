@@ -335,15 +335,19 @@ def _bind_state(layer: Layer, state: Dict[str, jax.Array]):
 
 def functional_call(layer: Layer, state: Dict[str, jax.Array], *args,
                     rngs: Optional[Dict[str, jax.Array]] = None,
-                    mutable: bool = False, **kwargs):
+                    mutable: bool = False, method: Optional[str] = None,
+                    **kwargs):
     """Run ``layer(*args)`` with `state` bound in — a pure function of `state`.
 
     With ``mutable=True`` returns ``(out, new_buffers)`` where `new_buffers`
     is the post-call value of every buffer (e.g. batchnorm running stats).
+    ``method`` calls a named method instead of ``forward`` (e.g. a model's
+    fused ``train_loss``).
     """
     with _bind_state(layer, state):
         with rng_mod.rng_guard(rngs or {}):
-            out = layer(*args, **kwargs)
+            fn = layer if method is None else getattr(layer, method)
+            out = fn(*args, **kwargs)
             if mutable:
                 new_buffers = {n: b for n, b in layer.named_buffers()
                                if b is not None}

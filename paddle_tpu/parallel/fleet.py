@@ -228,6 +228,12 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
             "full": cp.nothing_saveable,
             "nothing_saveable": cp.nothing_saveable,
             "dots_saveable": cp.dots_saveable,
+            # reference recompute_granularity values — the models name
+            # their matmul outputs (attn_qkv/ffn_gate/ffn_up); attn_out
+            # is not saved (the flash bwd replays its fwd regardless)
+            "full_attn": cp.save_only_these_names("ffn_gate", "ffn_up"),
+            "core_attn": cp.save_only_these_names(
+                "attn_qkv", "ffn_gate", "ffn_up"),
         }.get(strategy.recompute_configs.policy, cp.nothing_saveable)
 
     def forward_loss(state, batch, rngs):

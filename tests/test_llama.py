@@ -81,6 +81,70 @@ def test_mp_sharded_matches_dense():
     np.testing.assert_allclose(sharded_loss, ref_loss, rtol=2e-5)
 
 
+def test_recompute_granularity_grads_match():
+    """recompute_granularity (reference fleet recompute) must not change
+    the math: loss + grads identical across full / full_attn / core_attn."""
+    results = {}
+    for gran in ("full", "full_attn", "core_attn"):
+        cfg = LlamaConfig.tiny()
+        cfg.recompute = True
+        cfg.recompute_granularity = gran
+        paddle_tpu.seed(0)
+        model = LlamaForCausalLM(cfg)
+        x, y = _batch(cfg)
+
+        def loss_fn(s):
+            return model.loss(functional_call(model, s, x), y)
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(
+            model.trainable_state())
+        results[gran] = (float(loss), grads)
+    l0, g0 = results["full"]
+    for gran in ("full_attn", "core_attn"):
+        l, g = results[gran]
+        np.testing.assert_allclose(l, l0, rtol=1e-6)
+        for k in g0:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(g0[k]),
+                                       rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_train_loss_chunked_matches_plain():
+    """train_loss with loss_seq_chunks must equal the plain forward+loss
+    (same valid-token mean), and so must its grads."""
+    cfg = LlamaConfig.tiny()
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    x, y = _batch(cfg)
+    state = model.trainable_state()
+
+    ref = float(model.loss(model(x), y))
+
+    def chunked(s):
+        return functional_call(model, s, x, y, method="train_loss")
+
+    cfg.loss_seq_chunks = 4
+    loss4, g4 = jax.jit(jax.value_and_grad(chunked))(state)
+    np.testing.assert_allclose(float(loss4), ref, rtol=2e-5)
+
+    cfg.loss_seq_chunks = 1
+    loss1, g1 = jax.jit(jax.value_and_grad(chunked))(state)
+    np.testing.assert_allclose(float(loss4), float(loss1), rtol=2e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g4[k]), np.asarray(g1[k]),
+                                   rtol=5e-3, atol=5e-5, err_msg=k)
+
+
+def test_recompute_granularity_unknown_raises():
+    cfg = LlamaConfig.tiny()
+    cfg.recompute = True
+    cfg.recompute_granularity = "bogus"
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    x, _ = _batch(cfg)
+    with pytest.raises(ValueError, match="recompute_granularity"):
+        model(x)
+
+
 def test_param_count_7b_config():
     cfg = LlamaConfig.llama2_7b()
     # analytic param count for the 7B config (no instantiation)
