@@ -68,6 +68,20 @@ def build_model(name):
                           num_heads=32, num_kv_heads=4,
                           intermediate_size=5632,
                           max_position_embeddings=2048)
+    elif name == "mixtral-1b":
+        # the moe_bench shape (0.93 B total / 0.31 B activated): 12L ×
+        # 8 experts top-2 — decodes through the fused MoE kernel, which
+        # streams only the routed experts' weights per token
+        from paddle_tpu.models.mixtral import (MixtralConfig,
+                                               MixtralForCausalLM)
+        cfg = MixtralConfig(vocab_size=32000, hidden_size=1024,
+                            intermediate_size=2816, num_layers=12,
+                            num_heads=16, num_kv_heads=8,
+                            max_position_embeddings=2048,
+                            num_experts=8, top_k=2)
+        m = MixtralForCausalLM(cfg).bfloat16()
+        m.eval()
+        return cfg, m
     else:
         raise SystemExit(f"unknown model {name}")
     return cfg, LlamaForCausalLM(cfg).bfloat16()
@@ -81,7 +95,9 @@ def kv_bytes_per_token(cfg, dtype_bytes=2):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
-    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="default 8 (1 for mixtral-1b: the fused MoE "
+                    "kernel's no-drop gate caps batch at 2)")
     ap.add_argument("--prompt_len", type=int, default=128)
     ap.add_argument("--new_tokens", type=int, default=256)
     ap.add_argument("--int8", action="store_true",
@@ -95,12 +111,26 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     name = ns.model or ("llama-345m" if on_tpu else "llama-tiny")
+    if ns.batch is None:
+        ns.batch = 1 if name == "mixtral-1b" else 8
     if not on_tpu:
         ns.batch, ns.prompt_len, ns.new_tokens = 2, 8, 16
+
+    # a Pallas regression must FAIL the bench, not silently re-ride XLA
+    paddle_tpu.set_flags({"FLAGS_pallas_strict": True})
 
     paddle_tpu.seed(0)
     cfg, model = build_model(name)
     n_params = model.num_params()
+    if name == "mixtral-1b":
+        # the streaming roofline below describes the fused MoE kernel;
+        # refuse to silently measure the all-experts scan fallback
+        # (FLAGS_pallas_strict can't catch this: no kernel failure occurs)
+        plan = model.fused_decode_plan(model.trainable_state(), probe=True)
+        if plan is None or ns.batch > plan["max_batch"]:
+            raise SystemExit(
+                f"mixtral-1b fused decode needs batch <= "
+                f"{plan and plan['max_batch']}; got {ns.batch}")
     if ns.int8:
         from paddle_tpu.quantization import quantize_model, quantized_state
         quantize_model(model)
@@ -167,11 +197,21 @@ def main():
 
     # roofline: average cache length over the decode window. int8
     # quantizes every linear INCLUDING lm_head; only the embedding table
-    # (one vocab×hidden gather source) stays bf16.
+    # (one vocab×hidden gather source) stays bf16. MoE: the fused kernel
+    # streams only b·top_k routed experts per layer per step — the
+    # roofline's weight bytes count exactly what the kernel must read.
     avg_len = ns.prompt_len + ns.new_tokens / 2
     embed_params = cfg.vocab_size * cfg.hidden_size
-    param_bytes = ((n_params - embed_params) + 2 * embed_params) if ns.int8 \
-        else 2 * n_params
+    if name == "mixtral-1b":
+        expert_params = 3 * cfg.hidden_size * cfg.intermediate_size
+        dense_params = n_params - cfg.num_layers * cfg.num_experts * expert_params
+        streamed = (dense_params + cfg.num_layers * min(
+            ns.batch * cfg.top_k, cfg.num_experts) * expert_params)
+        param_bytes = 2 * streamed
+    elif ns.int8:
+        param_bytes = (n_params - embed_params) + 2 * embed_params
+    else:
+        param_bytes = 2 * n_params
     step_bytes = param_bytes + ns.batch * kv_bytes_per_token(cfg) * avg_len
     bw = HBM_BW.get(dev.device_kind, 819e9 if on_tpu else 50e9)
     roofline_tok_s = ns.batch * bw / step_bytes

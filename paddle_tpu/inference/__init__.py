@@ -72,6 +72,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     plan = (model.fused_decode_plan(state, probe=True)
             if flag("FLAGS_fused_decode")
             and hasattr(model, "fused_decode_plan") else None)
+    if plan is not None and b > plan.get("max_batch", b):
+        plan = None     # e.g. MoE no-drop bound b·top_k ≤ capacity
     if plan is not None:
         total = -(-total // 128) * 128
     cache = model.init_cache(b, total, dtype=cache_dtype)
@@ -123,7 +125,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                     num_heads=plan_t["num_heads"],
                     num_kv_heads=plan_t["num_kv_heads"], eps=plan_t["eps"],
                     rope_base=plan_t["rope_base"],
-                    arch=plan_t.get("arch", "llama"))
+                    arch=plan_t.get("arch", "llama"),
+                    top_k=plan_t.get("top_k", 2))
                 nxt = _sample_logits(plan_t["head"](x), ki, temperature,
                                      top_k, top_p)
                 nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)

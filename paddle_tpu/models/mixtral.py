@@ -39,6 +39,7 @@ class MixtralConfig(LlamaConfig):
     moe_gate: str = "gshard"          # 'gshard' (top-k) | 'switch' (top-1)
     moe_dispatch: str = "scatter"     # 'scatter'|'sort'|'einsum'|'alltoall'
     moe_dropless: bool = False        # sort + ragged_dot, no capacity drops
+    ep_axes: tuple = ("dp",)          # mesh axes the expert dim shards over
 
     @classmethod
     def tiny(cls, vocab_size=256):
@@ -74,7 +75,8 @@ class MixtralDecoderLayer(nn.Layer):
                             gate=cfg.moe_gate,
                             initializer_range=cfg.initializer_range,
                             dispatch_mode=cfg.moe_dispatch,
-                            dropless=cfg.moe_dropless)
+                            dropless=cfg.moe_dropless,
+                            ep_axes=cfg.ep_axes)
         if cfg.num_shared_experts:
             shared_cfg = dataclasses.replace(
                 cfg, intermediate_size=cfg.intermediate_size
@@ -166,6 +168,55 @@ class MixtralForCausalLM(CausalLMBase):
     def loss(self, outputs, labels):
         logits, aux = outputs
         return self.loss_fn(logits, labels, reduction="mean") + aux
+
+    def fused_decode_plan(self, state, probe=False):
+        """Fused MoE decode plan (ops.fused_decode arch="moe" — the
+        reference's fused MoE inference analog: fused_multi_transformer +
+        global_scatter). Eligibility: no active TP mesh, even head_dim,
+        E % 8 == 0, no shared experts, standard dispatch; `max_batch`
+        bounds b so b·top_k ≤ routing capacity (no token ever dropped —
+        the kernel streams exactly top_k experts per row)."""
+        from paddle_tpu.parallel.mp_layers import _active_mesh
+        from paddle_tpu.parallel import mp_layers as mp_mod
+        cfg = self.cfg
+        if (_active_mesh(mp_mod.MP_AXIS) is not None or cfg.head_dim % 2
+                or cfg.num_experts % 8 or cfg.num_shared_experts
+                or cfg.moe_dropless):
+            return None
+        if "model.layers.0.self_attn.q_proj.weight" not in state:
+            return None     # non-standard / quantized state
+        gate = self.model.layers[0].moe.gate
+        max_batch = 0
+        for b in range(1, 65):
+            if b * gate.top_k <= gate.capacity(b):
+                max_batch = b
+            else:
+                break
+        if max_batch == 0:
+            return None
+        meta = {
+            "num_heads": cfg.num_heads, "num_kv_heads": cfg.kv_heads,
+            "head_dim": cfg.head_dim, "eps": cfg.rms_norm_eps,
+            "rope_base": cfg.rope_base, "arch": "moe",
+            "top_k": gate.top_k, "max_batch": max_batch,
+        }
+        if probe:
+            return meta
+        from paddle_tpu.ops import fused_decode as fd
+        from paddle_tpu.ops.rms_norm import rms_norm
+        params = fd.build_fused_params_moe(state, cfg.num_layers)
+        embed_w = state["model.embed_tokens.weight"]
+        norm_w = state["model.norm.weight"]
+        head_w = state["lm_head.weight"]
+
+        def embed(tok, pos):
+            del pos
+            return jnp.take(embed_w, tok, axis=0)
+
+        def head(x):
+            return jnp.dot(rms_norm(x, norm_w, cfg.rms_norm_eps), head_w)
+
+        return dict(meta, params=params, embed=embed, head=head)
 
     def _pipeline_block_apply(self, template):
         from paddle_tpu.nn.layer import functional_call

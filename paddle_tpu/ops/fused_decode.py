@@ -42,6 +42,48 @@ from jax import lax
 
 NEG_INF = -1e30
 
+# Per-generation VMEM capacity (MiB). The runtime exposes no VMEM
+# attribute, so `device_kind` is the spec handle; unknown kinds fall back
+# to the v5e value. Every public generation to date ships 128 MiB/core —
+# the table is the extension point for one that differs, and
+# FLAGS_vmem_mib the per-deployment escape hatch.
+_VMEM_MIB_BY_KIND = {
+    "TPU v4": 128,
+    "TPU v5 lite": 128,     # v5e
+    "TPU v5e": 128,
+    "TPU v5": 128,          # v5p
+    "TPU v5p": 128,
+    "TPU v6 lite": 128,     # v6e / trillium
+}
+_VMEM_MIB_FALLBACK = 128
+
+
+def _vmem_mib() -> int:
+    """VMEM capacity of device 0 in MiB (flag override > kind table >
+    v5e fallback)."""
+    from paddle_tpu.core.flags import flag
+    override = flag("FLAGS_vmem_mib")
+    if override:
+        return int(override)
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return _VMEM_MIB_FALLBACK
+    return _VMEM_MIB_BY_KIND.get(kind, _VMEM_MIB_FALLBACK)
+
+
+def _vmem_budget_bytes() -> int:
+    """Planning budget for double-buffered weight blocks: capacity minus
+    40 MiB of headroom (KV chunks, scratch, Mosaic's own reservations —
+    the margin probed on v5e where 88 of 128 MiB plans reliably)."""
+    return max(48, _vmem_mib() - 40) * 2 ** 20
+
+
+def _vmem_limit_bytes() -> int:
+    """Scoped-VMEM limit passed to Mosaic: capacity minus 28 MiB (100 of
+    128 MiB is the probed reliable ceiling on v5e)."""
+    return max(64, _vmem_mib() - 28) * 2 ** 20
+
 
 # ---------------------------------------------------------------------------
 # Stacked parameter pytree
@@ -123,6 +165,34 @@ def build_fused_params_gpt(state: Dict[str, jax.Array], num_layers: int,
     return out
 
 
+def build_fused_params_moe(state: Dict[str, jax.Array], num_layers: int,
+                           prefix: str = "model.layers.") -> Dict[str, jax.Array]:
+    """Mixtral-block stacks: llama attention (ln1/wqkv/wo) + MoE FFN.
+
+    Returns {ln1 (L,h), wqkv (L,h,dqkv), wo (L,dq,h), ln2 (L,h),
+    gate (L,E,h) — the router projection TRANSPOSED so its lane dim is h
+    (HBM lane dims want 128-multiples; E is typically 8), weg/weu
+    (L,E,h,f), wed (L,E,f,h)}. The expert stacks stay in HBM; the kernel
+    streams only the routed experts' weights per token (the TPU-native
+    analog of the reference's fused MoE inference path —
+    fused_multi_transformer + global_scatter composition)."""
+    cols = {"ln1": [], "wqkv": [], "wo": [], "ln2": [], "gate": [],
+            "weg": [], "weu": [], "wed": []}
+    for i in range(num_layers):
+        cols["ln1"].append(state[f"{prefix}{i}.input_layernorm.weight"])
+        cols["wqkv"].append(jnp.concatenate(
+            [state[f"{prefix}{i}.self_attn.{n}_proj.weight"]
+             for n in ("q", "k", "v")], axis=1))
+        cols["wo"].append(state[f"{prefix}{i}.self_attn.o_proj.weight"])
+        cols["ln2"].append(
+            state[f"{prefix}{i}.post_attention_layernorm.weight"])
+        cols["gate"].append(state[f"{prefix}{i}.moe.gate.proj.weight"].T)
+        cols["weg"].append(state[f"{prefix}{i}.moe.experts.w_gate"])
+        cols["weu"].append(state[f"{prefix}{i}.moe.experts.w_up"])
+        cols["wed"].append(state[f"{prefix}{i}.moe.experts.w_down"])
+    return {k: jnp.stack(v) for k, v in cols.items()}
+
+
 def _layernorm(x, w, b, eps):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
@@ -154,7 +224,8 @@ def _rope1(x, cos, sin):
 
 def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
                            num_heads: int, num_kv_heads: int,
-                           eps: float = 1e-5, arch: str = "llama"):
+                           eps: float = 1e-5, arch: str = "llama",
+                           top_k: int = 2):
     """One decode step through the whole stack; pure jnp.
 
     x (b, h); the KV cache is stored COMBINED and FLAT as
@@ -227,6 +298,29 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
             g = wdot(xn2, "wg", l) + params["bg"][l]
             act = jax.nn.gelu(g, approximate=True).astype(dtype)
             xf = xf + wdot(act, "wd", l) + params["bd"][l]
+        elif arch == "moe":
+            # router math matches nn.layers.moe topk_routing: fp32 softmax
+            # over the full expert set from the bf16 post-norm activations,
+            # top-k renormalized. No-drop condition (b·k ≤ capacity) is
+            # the fused path's eligibility gate, so `keep` is vacuous.
+            xn2 = _rms(xf, params["ln2"][l], eps).astype(dtype)
+            logits = jnp.dot(xn2.astype(jnp.float32),
+                             params["gate"][l].astype(jnp.float32).T)
+            probs = jax.nn.softmax(logits, axis=-1)
+            vals, idx = lax.top_k(probs, top_k)            # (b, k)
+            vals = vals / jnp.maximum(
+                jnp.sum(vals, axis=-1, keepdims=True), 1e-9)
+            wg_sel = jnp.take(params["weg"][l], idx, axis=0)  # (b,k,h,f)
+            wu_sel = jnp.take(params["weu"][l], idx, axis=0)
+            wd_sel = jnp.take(params["wed"][l], idx, axis=0)  # (b,k,f,h)
+            g = jnp.einsum("bh,bkhf->bkf", xn2, wg_sel,
+                           preferred_element_type=jnp.float32)
+            u = jnp.einsum("bh,bkhf->bkf", xn2, wu_sel,
+                           preferred_element_type=jnp.float32)
+            act = (jax.nn.silu(g) * u).astype(dtype)
+            d = jnp.einsum("bkf,bkfh->bkh", act, wd_sel,
+                           preferred_element_type=jnp.float32)
+            xf = xf + jnp.einsum("bk,bkh->bh", vals, d)
         else:
             xn2 = _rms(xf, params["ln2"][l], eps)
             g = wdot(xn2, "wg", l)
@@ -241,10 +335,13 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
 # ---------------------------------------------------------------------------
 
 def _pick_ffn_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
-                     budget: int = 88 * 2 ** 20):
+                     budget: Optional[int] = None):
     """Smallest J (ffn % J == 0) whose per-grid-step VMEM estimate —
     double-buffered weight blocks (attention weights + one FFN column
-    block) on top of `fixed_bytes` of scratch — fits `budget`."""
+    block) on top of `fixed_bytes` of scratch — fits `budget` (default:
+    derived from the device generation's VMEM, _vmem_budget_bytes)."""
+    if budget is None:
+        budget = _vmem_budget_bytes()
     for j in range(1, ffn + 1):
         if ffn % j:
             continue
@@ -615,9 +712,10 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
         input_output_aliases={(9 - gpt + 6 * gpt + 5 * int8): 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
-            # v5e has 128 MiB VMEM; the default 16 MiB scoped limit can't
-            # hold a layer's double-buffered weights + KV chunks
-            vmem_limit_bytes=100 * 1024 * 1024),
+            # the default 16 MiB scoped limit can't hold a layer's
+            # double-buffered weights + KV chunks; raise to the device
+            # generation's capacity minus headroom
+            vmem_limit_bytes=_vmem_limit_bytes()),
         name="fused_decode_step",
     )(jnp.asarray(pos, jnp.int32).reshape(1), x,
       params["ln1"][:, None], params["wqkv"],
@@ -634,21 +732,408 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
 
 
 
+def _pick_expert_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
+                        budget: Optional[int] = None):
+    """Smallest J (ffn % J == 0, block a 128-lane multiple — expert-weight
+    DMAs slice the lane dim) whose double-buffered expert blocks fit the
+    VMEM budget on top of `fixed_bytes`."""
+    if budget is None:
+        budget = _vmem_budget_bytes()
+    best = None
+    for j in range(1, ffn // 128 + 1):
+        if ffn % j or (ffn // j) % 128:
+            continue
+        fblk = ffn // j
+        need = fixed_bytes + 2 * 3 * fblk * h * wbytes + 8 * 2 ** 20
+        if best is None:
+            best = (j, fblk)          # largest valid block as fallback
+        if need <= budget:
+            return j, fblk
+    if best is None:
+        raise ValueError(f"expert ffn {ffn} has no 128-multiple block")
+    return best
+
+
+def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
+                             num_heads: int, num_kv_heads: int,
+                             head_dim: int, top_k: int,
+                             rope_base: float = 10000.0,
+                             eps: float = 1e-5, chunk: int = 0):
+    """Fused MoE decode step: llama attention block + top-k expert FFN with
+    DATA-DEPENDENT weight streaming.
+
+    The llama/gpt kernel streams its FFN weights through Mosaic-pipelined
+    BlockSpecs — impossible here because which expert's weights are needed
+    is decided by the router *inside* the kernel. Instead the expert
+    stacks stay in HBM (`pl.ANY`) and the kernel hand-rolls a
+    double-buffered async-copy pipeline over b·top_k slots per layer,
+    fetching ONLY the routed experts' weights — decode is
+    weight-bandwidth-bound, so per-token traffic drops from E experts to
+    top_k (the TPU-native analog of the reference's fused MoE inference:
+    fused_multi_transformer + global_scatter, SURVEY §2.2 fusion + §2.6
+    EP).
+
+    Grid (L, 1 + b·k·J): phase 0 = attention + router (argmax top-k into
+    SMEM so the DMA engine can address expert slices); phases 1.. = one
+    (row, choice, ffn-block) expert matmul each, weights for step t+1 in
+    flight during step t. Requires b·top_k ≤ routing capacity (no-drop —
+    the eligibility gate) and E % 8 == 0.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, b, S, dkv2 = kv_cache.shape
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = head_dim
+    assert hd == dkv // nkv
+    rep = nh // nkv
+    h = x.shape[1]
+    dq = nh * hd
+    dqkv = dq + 2 * dkv
+    E = params["gate"].shape[1]
+    ffn = params["weg"].shape[3]
+    k = top_k
+    nslots = b * k
+    wbytes = 2
+    # attention weights ride the Mosaic pipeline (double-buffered), expert
+    # blocks ride the manual pipeline — both count against VMEM
+    attn_fixed = 2 * (dqkv + dq + E) * h * wbytes
+    J, fblk = _pick_expert_blocks(ffn, h, fixed_bytes=attn_fixed,
+                                  wbytes=wbytes)
+    nsteps = nslots * J
+    if not chunk:
+        chunk = 128
+    ck = min(chunk, S)
+    assert S % ck == 0, f"cache len {S} not a multiple of chunk {ck}"
+    assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
+    assert E % 8 == 0, f"num_experts {E} must be a multiple of 8"
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    def kernel(pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref,
+               gate_ref, weg_ref, weu_ref, wed_ref, kv_in,
+               x_out_ref, kv_ref,
+               x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
+               wsem, rsem, eid_s, egw_s, ewg_s, ewu_s, ewd_s, esem):
+        del kv_in
+        li = pl.program_id(0)
+        t = pl.program_id(1)
+        pos = pos_ref[0]
+
+        def expert_copies(u, buf):
+            """The three async copies streaming step-u's expert block."""
+            s = u // J
+            jj = u % J
+            r = s // k
+            c = s % k
+            eid = eid_s[r, c]
+            if J == 1:
+                src_g = weg_ref.at[li, eid]
+                src_u = weu_ref.at[li, eid]
+                src_d = wed_ref.at[li, eid]
+            else:
+                src_g = weg_ref.at[li, eid, :, pl.ds(jj * fblk, fblk)]
+                src_u = weu_ref.at[li, eid, :, pl.ds(jj * fblk, fblk)]
+                src_d = wed_ref.at[li, eid, pl.ds(jj * fblk, fblk), :]
+            return (
+                pltpu.make_async_copy(src_g, ewg_s.at[buf], esem.at[buf, 0]),
+                pltpu.make_async_copy(src_u, ewu_s.at[buf], esem.at[buf, 1]),
+                pltpu.make_async_copy(src_d, ewd_s.at[buf], esem.at[buf, 2]),
+            )
+
+        @pl.when(t == 0)
+        def attention_phase():
+            @pl.when(li == 0)
+            def _():
+                x_s[...] = x_in_ref[...].astype(jnp.float32)
+
+            blk = (pos // 8) * 8
+            off = pos - blk
+            rkb = pltpu.make_async_copy(
+                kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s, wsem.at[0])
+
+            @pl.when(li == 0)
+            def _():
+                rkb.start()
+
+            xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
+            qkv = jnp.dot(xn, wqkv_ref[...],
+                          preferred_element_type=jnp.float32)
+            half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+                    % (hd // 2)).astype(jnp.float32)
+            inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
+            ang = pos.astype(jnp.float32) * inv_freq
+            cos_b = jnp.cos(ang)
+            sin_b = jnp.sin(ang)
+            rope2 = lambda v: (v * cos_b + jnp.concatenate(
+                [-v[:, hd // 2:], v[:, :hd // 2]], axis=-1) * sin_b)
+            for g in range(nh):
+                q_s[:, g, :] = rope2(qkv[:, g * hd:(g + 1) * hd])
+            for g in range(nkv):
+                kv32_s[:, g * hd:(g + 1) * hd] = rope2(
+                    qkv[:, dq + g * hd:dq + (g + 1) * hd])
+                kv32_s[:, dkv + g * hd:dkv + (g + 1) * hd] = \
+                    qkv[:, dq + dkv + g * hd:dq + dkv + (g + 1) * hd]
+
+            def chunk_copy(c, slot):
+                return pltpu.make_async_copy(
+                    kv_ref.at[li, :, pl.ds(c * ck, ck)],
+                    kvch_s.at[slot], rsem.at[slot])
+
+            def merge(carry, kmat, vmat, idx, limit, width):
+                ms, ls, accs = carry
+                ms2, ls2, accs2 = [], [], []
+                for g in range(nkv):
+                    kg = kmat(g)
+                    vg = vmat(g)
+                    qg = q_s[:, g * rep:(g + 1) * rep, :] * scale
+                    sc = lax.dot_general(
+                        qg, kg, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    sc = jnp.where(idx < limit, sc, NEG_INF)
+                    m_new = jnp.maximum(ms[g], jnp.max(sc, axis=-1))
+                    alpha = jnp.exp(ms[g] - m_new)
+                    pp = jnp.exp(sc - m_new[..., None])
+                    acc = accs[g] * alpha[..., None] + lax.dot_general(
+                        pp, vg, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32)
+                    ms2.append(m_new)
+                    ls2.append(ls[g] * alpha + jnp.sum(pp, axis=-1))
+                    accs2.append(acc)
+                return ms2, ls2, accs2
+
+            nc = (blk + ck - 1) // ck
+
+            @pl.when((li == 0) & (nc > 0))
+            def _():
+                chunk_copy(0, 0).start()
+
+            def body(c, carry):
+                slot = lax.rem(c, 2)
+
+                @pl.when(c + 1 < nc)
+                def _():
+                    chunk_copy(c + 1, lax.rem(c + 1, 2)).start()
+
+                chunk_copy(c, slot).wait()
+                idx = c * ck + lax.broadcasted_iota(
+                    jnp.int32, (1, 1, ck), 2)
+                return merge(
+                    carry,
+                    lambda g: kvch_s[slot, :, :,
+                                     g * hd:(g + 1) * hd].astype(
+                        jnp.float32),
+                    lambda g: kvch_s[slot, :, :,
+                                     dkv + g * hd:dkv + (g + 1) * hd].astype(
+                        jnp.float32),
+                    idx, blk, ck)
+
+            m0 = [jnp.full((b, rep), NEG_INF, jnp.float32)
+                  for _ in range(nkv)]
+            l0 = [jnp.zeros((b, rep), jnp.float32) for _ in range(nkv)]
+            a0 = [jnp.zeros((b, rep, hd), jnp.float32) for _ in range(nkv)]
+            carry = lax.fori_loop(0, nc, body, (m0, l0, a0))
+
+            rkb.wait()
+            sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
+            kvblk_s[...] = jnp.where(
+                sel, kv32_s[...][:, None, :],
+                kvblk_s[...].astype(jnp.float32)).astype(kv_cache.dtype)
+            wkb = pltpu.make_async_copy(
+                kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
+            wkb.start()
+            bidx = blk + lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
+            ms, ls, accs = merge(
+                carry,
+                lambda g: kvblk_s[:, :, g * hd:(g + 1) * hd].astype(
+                    jnp.float32),
+                lambda g: kvblk_s[:, :,
+                                  dkv + g * hd:dkv + (g + 1) * hd].astype(
+                    jnp.float32),
+                bidx, pos + 1, 8)
+
+            oacc = jnp.zeros((b, h), jnp.float32)
+            for g in range(nkv):
+                norm = accs[g] / ls[g][..., None]
+                for r in range(rep):
+                    hh = g * rep + r
+                    oacc = oacc + jnp.dot(
+                        norm[:, r, :].astype(dtype),
+                        wo_ref[hh * hd:(hh + 1) * hd, :],
+                        preferred_element_type=jnp.float32)
+            xr = x_s[...] + oacc
+            x_s[...] = xr
+            xn2 = _rms(xr, ln2_ref[...].reshape(h), eps).astype(dtype)
+            xn_s[...] = xn2
+
+            # ---- router (fp32, matches nn.layers.moe topk_routing):
+            # softmax over E, sequential argmax top-k (= lax.top_k's
+            # lowest-index tie-breaking), renormalized weights. Ids land
+            # in SMEM so the expert-weight DMAs can address them.
+            logits = lax.dot_general(
+                xn2.astype(jnp.float32), gate_ref[...].astype(jnp.float32),
+                (((1,), (1,)), ((), ())))                   # (b, E)
+            mx = jnp.max(logits, axis=-1, keepdims=True)
+            ex = jnp.exp(logits - mx)
+            probs = ex / jnp.sum(ex, axis=-1, keepdims=True)
+            cur = probs
+            vals = []
+            eidx = lax.broadcasted_iota(jnp.int32, (b, E), 1)
+            for c in range(k):
+                v_c = jnp.max(cur, axis=-1)                 # (b,)
+                a_c = jnp.argmax(cur, axis=-1).astype(jnp.int32)
+                vals.append(v_c)
+                for r in range(b):
+                    eid_s[r, c] = a_c[r]
+                cur = jnp.where(eidx == a_c[:, None], NEG_INF, cur)
+            tot = vals[0]
+            for c in range(1, k):
+                tot = tot + vals[c]
+            tot = jnp.maximum(tot, 1e-9)
+            for c in range(k):
+                egw_s[:, c] = vals[c] / tot
+            acc_s[...] = jnp.zeros_like(acc_s)
+            for cp in expert_copies(0, 0):
+                cp.start()
+
+        @pl.when(t > 0)
+        def ffn_phase():
+            u = t - 1
+            buf = lax.rem(u, 2)
+
+            @pl.when(t == 1)
+            def prefetch_next_layer():
+                blk = (pos // 8) * 8
+                pltpu.make_async_copy(
+                    kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)],
+                    wsem.at[0]).wait()
+
+                @pl.when(li + 1 < L)
+                def _():
+                    pltpu.make_async_copy(
+                        kv_ref.at[li + 1, :, pl.ds(blk, 8)], kvblk_s,
+                        wsem.at[0]).start()
+
+                    @pl.when(blk > 0)
+                    def _():
+                        pltpu.make_async_copy(
+                            kv_ref.at[li + 1, :, pl.ds(0, ck)],
+                            kvch_s.at[0], rsem.at[0]).start()
+
+            for cp in expert_copies(u, buf):
+                cp.wait()
+
+            @pl.when(u + 1 < nsteps)
+            def _():
+                for cp in expert_copies(u + 1, 1 - buf):
+                    cp.start()
+
+            s = u // J
+            r = s // k
+            c = s % k
+            xn = xn_s[...]
+            g = jnp.dot(xn, ewg_s[buf],
+                        preferred_element_type=jnp.float32)
+            uu = jnp.dot(xn, ewu_s[buf],
+                         preferred_element_type=jnp.float32)
+            act = (jax.nn.silu(g) * uu).astype(dtype)
+            d = jnp.dot(act, ewd_s[buf],
+                        preferred_element_type=jnp.float32)   # (b, h)
+            # select row r's contribution weighted by its gate value —
+            # all-rows matmul + mask avoids dynamic scratch indexing
+            # (b ≤ capacity/k is small; decode is bandwidth-bound)
+            rmask = lax.broadcasted_iota(jnp.int32, (b, k), 0) == r
+            cmask = lax.broadcasted_iota(jnp.int32, (b, k), 1) == c
+            wsel = jnp.sum(jnp.where(rmask & cmask, egw_s[...], 0.0))
+            rowmask = lax.broadcasted_iota(jnp.int32, (b, 1), 0) == r
+            acc_s[...] += jnp.where(rowmask, d * wsel, 0.0)
+
+            @pl.when(t == nsteps)
+            def _():
+                xr = x_s[...] + acc_s[...]
+                x_s[...] = xr
+                x_out_ref[...] = xr.astype(dtype)
+
+    grid = (L, 1 + nsteps)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # pos
+            pl.BlockSpec((b, h), lambda l, t: (0, 0)),             # x
+            pl.BlockSpec((None, 1, h), lambda l, t: (l, 0, 0)),    # ln1
+            pl.BlockSpec((None, h, dqkv), lambda l, t: (l, 0, 0)),  # wqkv
+            pl.BlockSpec((None, dq, h), lambda l, t: (l, 0, 0)),   # wo
+            pl.BlockSpec((None, 1, h), lambda l, t: (l, 0, 0)),    # ln2
+            pl.BlockSpec((None, E, h), lambda l, t: (l, 0, 0)),    # gate
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # weg
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # weu
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # wed
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
+        ],
+        out_specs=[
+            pl.BlockSpec((b, h), lambda l, t: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h), dtype),
+            jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),          # x_s
+            pltpu.VMEM((b, h), dtype),                # xn_s
+            pltpu.VMEM((b, h), jnp.float32),          # acc_s
+            pltpu.VMEM((b, nh, hd), jnp.float32),     # q_s
+            pltpu.VMEM((b, 2 * dkv), jnp.float32),    # kv32_s
+            pltpu.VMEM((b, 8, 2 * dkv), kv_cache.dtype),   # kvblk_s
+            pltpu.VMEM((2, b, ck, 2 * dkv), kv_cache.dtype),  # kvch_s
+            pltpu.SemaphoreType.DMA((1,)),            # wsem
+            pltpu.SemaphoreType.DMA((2,)),            # rsem
+            pltpu.SMEM((b, k), jnp.int32),            # eid_s
+            pltpu.VMEM((b, k), jnp.float32),          # egw_s
+            pltpu.VMEM((2, h, fblk), dtype),          # ewg_s
+            pltpu.VMEM((2, h, fblk), dtype),          # ewu_s
+            pltpu.VMEM((2, fblk, h), dtype),          # ewd_s
+            pltpu.SemaphoreType.DMA((2, 3)),          # esem
+        ],
+        input_output_aliases={10: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=_vmem_limit_bytes()),
+        name="fused_decode_moe_step",
+    )(jnp.asarray(pos, jnp.int32).reshape(1), x,
+      params["ln1"][:, None], params["wqkv"], params["wo"],
+      params["ln2"][:, None], params["gate"],
+      params["weg"], params["weu"], params["wed"],
+      kv_cache)
+    return out[0], out[1]
+
+
 _fallback_logged = False
 
 
 def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                       num_heads: int, num_kv_heads: int, eps: float = 1e-5,
-                      rope_base: float = 10000.0, arch: str = "llama"):
+                      rope_base: float = 10000.0, arch: str = "llama",
+                      top_k: int = 2):
     """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
 
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
     be traced (it is the scan counter inside `inference.generate`).
+    `top_k` applies to arch="moe" only.
     """
     from paddle_tpu.ops import use_pallas
     dkv = kv_cache.shape[-1] // 2
     if use_pallas() and dkv % 128 == 0 and kv_cache.shape[2] % 128 == 0:
         try:
+            if arch == "moe":
+                return _fused_decode_moe_pallas(
+                    x, params, kv_cache, pos,
+                    num_heads=num_heads, num_kv_heads=num_kv_heads,
+                    head_dim=dkv // num_kv_heads, top_k=top_k,
+                    rope_base=rope_base, eps=eps)
             return _fused_decode_pallas(
                 x, params, kv_cache, pos,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,
@@ -668,4 +1153,5 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                     type(e).__name__, e)
     return fused_decode_reference(
         x, params, kv_cache, pos, cos, sin,
-        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps, arch=arch)
+        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps, arch=arch,
+        top_k=top_k)

@@ -136,3 +136,59 @@ def test_mixtral_generate_greedy_replay():
     logits, _aux = m(out)
     pred = np.asarray(jnp.argmax(logits, -1))
     assert (pred[:, 6:-1] == np.asarray(out)[:, 7:]).all()
+
+
+def test_mixtral_fused_plan_matches_layered():
+    """arch="moe" fused decode (reference twin on CPU): greedy tokens
+    from the fused plan path equal the layered scan path, and the
+    no-drop max_batch gate routes oversized batches to the scan path."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle_tpu.seed(0)
+    cfg = MixtralConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_position_embeddings=256, num_experts=8, top_k=2)
+    m = MixtralForCausalLM(cfg)
+    m.eval()
+    state = m.trainable_state()
+    plan = m.fused_decode_plan(state, probe=True)
+    assert plan is not None and plan["arch"] == "moe"
+    assert plan["max_batch"] >= 2
+
+    prompt = jnp.asarray(np.random.RandomState(2).randint(0, 256, (2, 5)))
+    out_fused = generate(m, prompt, max_new_tokens=8, temperature=0.0)
+    assert (2, 5, 8, 0.0, 0, 1.0, -1, "bfloat16", False, True) \
+        in m._generate_jit_cache   # plan really active
+    paddle_tpu.set_flags({"FLAGS_fused_decode": False})
+    try:
+        m._generate_jit_cache.clear()
+        out_layered = generate(m, prompt, max_new_tokens=8, temperature=0.0)
+    finally:
+        paddle_tpu.set_flags({"FLAGS_fused_decode": True})
+    np.testing.assert_array_equal(np.asarray(out_fused),
+                                  np.asarray(out_layered))
+
+    # ineligible configs fall back cleanly
+    cfg4 = MixtralConfig.tiny()          # num_experts=4 → E % 8 != 0
+    m4 = MixtralForCausalLM(cfg4)
+    assert m4.fused_decode_plan(m4.trainable_state(), probe=True) is None
+
+
+def test_mixtral_train_loss_chunked():
+    """CausalLMBase.train_loss handles MoE (hidden, aux) bodies, chunked
+    and unchunked, matching forward+loss."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    from paddle_tpu.nn.layer import functional_call
+
+    paddle_tpu.seed(0)
+    cfg = MixtralConfig.tiny()
+    m = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 256, (2, 17)))
+    x, y = ids[:, :-1], ids[:, 1:]
+    ref = float(m.loss(m(x), y))
+    state = m.trainable_state()
+    got1 = float(functional_call(m, state, x, y, method="train_loss"))
+    cfg.loss_seq_chunks = 4
+    got4 = float(functional_call(m, state, x, y, method="train_loss"))
+    np.testing.assert_allclose(got1, ref, rtol=2e-5)
+    np.testing.assert_allclose(got4, ref, rtol=2e-5)
