@@ -37,6 +37,10 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
+    # a Pallas regression must FAIL the bench, not silently re-ride XLA
+    # (no-op off-TPU: the kernels only dispatch on the TPU backend)
+    paddle_tpu.set_flags({"FLAGS_pallas_strict": True})
+
     paddle_tpu.seed(0)
     cfg = GPTConfig.gpt2_medium()
     cfg.hidden_dropout_prob = 0.0

@@ -52,6 +52,9 @@ def main():
     if not on_tpu:
         ns.layers, ns.hidden, ns.ffn, ns.seq, ns.steps = 2, 128, 256, 128, 2
 
+    # a Pallas regression must FAIL the bench, not silently re-ride XLA
+    paddle_tpu.set_flags({"FLAGS_pallas_strict": True})
+
     paddle_tpu.seed(0)
     cfg = MixtralConfig(
         vocab_size=32000 if on_tpu else 512, hidden_size=ns.hidden,
