@@ -61,7 +61,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
     ap.add_argument("--batch", type=int, default=None,
-                    help="default 4 (2 for llama-1b3: the full_attn save "
+                    help="default 4 (2 for llama-1b3: the core_attn save "
                     "set + 1.36B state only fits 16 GiB at b2)")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=10)
@@ -73,10 +73,10 @@ def main():
     ap.add_argument("--granularity", default=None,
                     choices=["full", "full_attn", "core_attn"],
                     help="recompute_granularity (reference fleet "
-                    "recompute): default full_attn for the 1B configs "
-                    "(FFN matmul outputs saved, attention block re-run; "
-                    "core_attn needs more than v5e's 16 GiB), full "
-                    "elsewhere")
+                    "recompute): default core_attn for the 1B configs "
+                    "(q/k/v + FFN matmul outputs saved — fits v5e now "
+                    "that multi_precision=False keeps bf16 moments), "
+                    "full elsewhere")
     ns = ap.parse_args()
 
     import paddle_tpu
@@ -99,11 +99,9 @@ def main():
     if ns.granularity is not None:
         cfg.recompute_granularity = ns.granularity
     elif name in ("llama-1b", "llama-1b3"):
-        # selective remat earns ~8 MFU points at 1B scale (43.3 → 52.2%
-        # measured at 1.1B); the saved matmul outputs need the
-        # no-scan-double-buffer memory layout. core_attn (qkv saved too)
-        # exceeds 16 GiB at these shapes — full_attn is the v5e sweet spot
-        cfg.recompute_granularity = "full_attn"
+        # selective remat + bf16 moments: 1.1B 43.3 → 57.1% measured; the
+        # saved matmul outputs need the no-scan-double-buffer layout
+        cfg.recompute_granularity = "core_attn"
         ns.per_step_dispatch = True
     if name in ("llama-1b", "llama-1b3"):
         cfg.loss_seq_chunks = 4   # never materialize (b, s, 32000) logits

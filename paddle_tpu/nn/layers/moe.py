@@ -387,16 +387,24 @@ class MoELayer(Layer):
                 "hybrid mesh); use 'scatter' for single-mesh-free runs")
         mesh = hcg.mesh
         ep = self.experts.ep_axes
-        if len(ep) != 1:
-            raise NotImplementedError("alltoall dispatch supports one EP axis")
-        axis = ep[0]
+        if not ep:
+            raise ValueError(
+                "dispatch_mode='alltoall' needs ep_axes (experts replicated "
+                "with ep_axes=() have no axis to exchange over — use "
+                "'scatter' or 'sort')")
+        # multiple EP axes act as ONE flattened axis (row-major over the
+        # tuple — the same convention shard_map uses for a dim sharded
+        # over an axis tuple, so the exchange and the sharding agree)
+        axis = ep if len(ep) > 1 else ep[0]
         mp_axis = self.experts.mp_axis
         mp_deg = mesh.shape.get(mp_axis, 1)
-        pdim = mesh.shape[axis]
+        pdim = 1
+        for a in ep:
+            pdim *= mesh.shape[a]
         e = self.num_experts
         if e % pdim or xt.shape[0] % pdim:
             raise ValueError(
-                f"the '{axis}' axis size {pdim} must divide both "
+                f"the EP axes {ep} (size {pdim}) must divide both "
                 f"num_experts {e} and the token count {xt.shape[0]}")
         e_loc = e // pdim
         gate_w = self.gate.proj.weight

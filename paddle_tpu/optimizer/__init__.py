@@ -58,6 +58,17 @@ class Optimizer:
         self._step_count = 0
         self._eager_state = None
 
+    def _slot_zeros(self, params, fill=0.0):
+        """Accumulator init honoring multi_precision: fp32 slots under the
+        AMP-O2 contract (default), PARAM-dtype slots with
+        multi_precision=False — the reference's pure-low-precision mode.
+        fp32 slots halve to bf16 this way: at 1B params that is ~7.5 GB
+        less optimizer read+write traffic per step AND ~4.4 GB less HBM."""
+        dt = lambda p: jnp.float32 if self.multi_precision else p.dtype
+        if fill:
+            return _tree_map(lambda p: jnp.full(p.shape, fill, dt(p)), params)
+        return _tree_map(lambda p: jnp.zeros(p.shape, dt(p)), params)
+
     def _decay_grads(self, grads, params):
         """Add the decay term to grads: L2 (default) or L1 when the
         weight_decay was a paddle_tpu.regularizer.L1Decay. Honors
@@ -116,7 +127,17 @@ class Optimizer:
         gf = _to_f32(grads)
         new_work, new_slots = self._apply(gf, work, state, lr, step_)
         new_state = dict(state)
-        new_state.update(new_slots)
+        # accumulator math runs in fp32; store back in the slot's own dtype
+        # (bf16 under multi_precision=False — see _slot_zeros)
+        for slot, tree in new_slots.items():
+            old = state.get(slot)
+            if old is not None and jax.tree_util.tree_structure(
+                    old) == jax.tree_util.tree_structure(tree):
+                tree = _tree_map(
+                    lambda n, o: n.astype(o.dtype)
+                    if hasattr(n, "astype") and n.dtype != o.dtype else n,
+                    tree, old)
+            new_state[slot] = tree
         new_state["step"] = state["step"] + 1
         if masters:
             new_state["master"] = {k: new_work[k] for k in masters}
@@ -194,7 +215,7 @@ class Momentum(Optimizer):
         self.use_nesterov = use_nesterov
 
     def _init_slots(self, params):
-        return {"velocity": _tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+        return {"velocity": self._slot_zeros(params)}
 
     def _apply(self, grads, params, state, lr, step):
         grads = self._decay_grads(grads, params)
@@ -219,8 +240,8 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def _init_slots(self, params):
-        z = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return {"moment1": _tree_map(z, params), "moment2": _tree_map(z, params)}
+        return {"moment1": self._slot_zeros(params),
+                "moment2": self._slot_zeros(params)}
 
     def _apply(self, grads, params, state, lr, step):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
@@ -276,8 +297,8 @@ class Lamb(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def _init_slots(self, params):
-        z = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return {"moment1": _tree_map(z, params), "moment2": _tree_map(z, params)}
+        return {"moment1": self._slot_zeros(params),
+                "moment2": self._slot_zeros(params)}
 
     def _apply(self, grads, params, state, lr, step):
         b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
@@ -309,9 +330,8 @@ class Adagrad(Optimizer):
         self.initial_accumulator_value = initial_accumulator_value
 
     def _init_slots(self, params):
-        return {"moment": _tree_map(
-            lambda p: jnp.full(p.shape, self.initial_accumulator_value,
-                               jnp.float32), params)}
+        return {"moment": self._slot_zeros(
+            params, fill=self.initial_accumulator_value)}
 
     def _apply(self, grads, params, state, lr, step):
         grads = self._decay_grads(grads, params)
@@ -331,11 +351,10 @@ class RMSProp(Optimizer):
         self.momentum, self.centered = momentum, centered
 
     def _init_slots(self, params):
-        z = lambda p: jnp.zeros(p.shape, jnp.float32)
-        slots = {"mean_square": _tree_map(z, params),
-                 "velocity": _tree_map(z, params)}
+        slots = {"mean_square": self._slot_zeros(params),
+                 "velocity": self._slot_zeros(params)}
         if self.centered:
-            slots["mean_grad"] = _tree_map(z, params)
+            slots["mean_grad"] = self._slot_zeros(params)
         return slots
 
     def _apply(self, grads, params, state, lr, step):
@@ -368,9 +387,8 @@ class Adadelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def _init_slots(self, params):
-        z = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return {"avg_sq_grad": _tree_map(z, params),
-                "avg_sq_update": _tree_map(z, params)}
+        return {"avg_sq_grad": self._slot_zeros(params),
+                "avg_sq_update": self._slot_zeros(params)}
 
     def _apply(self, grads, params, state, lr, step):
         rho, eps = self.rho, self.epsilon

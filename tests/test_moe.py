@@ -291,3 +291,44 @@ def test_alltoall_dispatch_matches_per_shard_local():
                                        rtol=5e-4, atol=1e-5, err_msg=k)
     finally:
         set_hybrid_communicate_group(None)
+
+
+def test_alltoall_multi_axis_ep():
+    """EP spanning TWO mesh axes (dp × sharding): the all_to_all treats
+    the tuple as one flattened axis; result must equal the single-axis
+    run with the same total EP degree, fwd and grads (VERDICT r3 #5)."""
+    from paddle_tpu.parallel import fleet
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+    def run(ep_axes, dp_degree, sharding_degree):
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": dp_degree,
+                            "sharding_degree": sharding_degree,
+                            "mp_degree": 1,
+                            "pp_degree": 8 // (dp_degree * sharding_degree)}
+        fleet.init(is_collective=True, strategy=s)
+        try:
+            paddle_tpu.seed(0)
+            layer = MoELayer(hidden_size=16, ffn_size=32, num_experts=4,
+                             top_k=2, dispatch_mode="alltoall",
+                             ep_axes=ep_axes)
+            state = layer.trainable_state()
+            x = jnp.asarray(np.random.RandomState(0)
+                            .standard_normal((2, 8, 16)).astype(np.float32))
+
+            def loss(st):
+                o, a = functional_call(layer, st, x)
+                return (o * o).sum() + a
+
+            l, g = jax.value_and_grad(loss)(state)
+            return float(l), jax.tree.map(np.asarray, g)
+        finally:
+            set_hybrid_communicate_group(None)
+
+    l_two, g_two = run(("dp", "sharding"), dp_degree=2, sharding_degree=2)
+    l_one, g_one = run(("dp",), dp_degree=4, sharding_degree=1)
+    np.testing.assert_allclose(l_two, l_one, rtol=1e-5)
+    for k in g_one:
+        np.testing.assert_allclose(g_two[k], g_one[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)

@@ -119,11 +119,14 @@ def test_pipeline_zero2_matches_single_device():
         opt = AdamW(learning_rate=1e-3)
         step_fn, init_fn = make_pipeline_train_step(model, opt, strategy=s)
         state, opt_state = init_fn()
-        # moments really live sharded: some opt leaf's sharding names the axis
+        # moments really live sharded: some opt leaf's PartitionSpec names
+        # the axis (str(leaf.sharding) would match any NamedSharding on
+        # this mesh — the spec is the actual placement)
         sharded_leaves = [
             v for tree in opt_state.values() if isinstance(tree, dict)
             for v in tree.values()
-            if "sharding" in str(getattr(v, "sharding", ""))]
+            if "sharding" in str(getattr(getattr(v, "sharding", None),
+                                         "spec", ""))]
         assert sharded_leaves, "no optimizer-state leaf sharded over 'sharding'"
         state, opt_state, loss0 = step_fn(state, opt_state,
                                           {"input": x, "labels": y})
