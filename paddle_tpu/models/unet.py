@@ -217,6 +217,9 @@ class UNetModel(nn.Layer):
     def forward(self, x, timesteps, context=None):
         cfg = self.cfg
         temb = timestep_embedding(timesteps, cfg.model_channels)
+        # the sinusoidal table is fp32; follow the model's compute dtype
+        # (bf16 inference would otherwise poison the conv inputs to fp32)
+        temb = temb.astype(self.time_mlp1.weight.dtype)
         temb = self.time_mlp2(F.silu(self.time_mlp1(temb)))
 
         h = self.conv_in(x)

@@ -152,25 +152,61 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             f"explicitly for cross-attention (sq={q.shape[1]}, "
             f"sk={k.shape[1]})")
     # Pallas path: TPU, seq dims multiples of 128 and long enough to beat
-    # XLA. Documented exclusions routed to XLA by design: attention dropout
-    # (modern LLM pretraining runs attn dropout 0) and arbitrary dense
-    # masks (the structured forms — causal/kv_lens/segments — are in the
-    # kernels).
-    if (use_pallas() and dropout_p == 0.0 and attn_mask is None
-            and _pallas_seq_ok(q.shape[1], k.shape[1])
-            and q.shape[-1] in (64, 128, 256)):
-        try:
-            return _flash_call(q, k, v, is_causal, scale, kv_lens,
-                               seg_q, seg_k)
-        except Exception as e:
-            from paddle_tpu.core.flags import flag
-            if flag("FLAGS_pallas_strict"):
-                raise
-            _log_fallback("forward", e)
+    # XLA. Shapes the kernel can't take directly may still ride it via
+    # _pad_for_kernel (odd head dims, short cross-KV). Documented
+    # exclusions routed to XLA by design: attention dropout (modern LLM
+    # pretraining runs attn dropout 0) and arbitrary dense masks (the
+    # structured forms — causal/kv_lens/segments — are in the kernels).
+    if use_pallas() and dropout_p == 0.0 and attn_mask is None:
+        padded = _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k)
+        if padded is not None:
+            qp, kp, vp, scale_p, klp, skp, hd = padded
+            try:
+                out = _flash_call(qp, kp, vp, is_causal, scale_p, klp,
+                                  seg_q, skp)
+                return out if out.shape[-1] == hd else out[..., :hd]
+            except Exception as e:
+                from paddle_tpu.core.flags import flag
+                if flag("FLAGS_pallas_strict"):
+                    raise
+                _log_fallback("forward", e)
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           scale=scale, dropout_p=dropout_p,
                           training=training, kv_lens=kv_lens,
                           seg_q=seg_q, seg_k=seg_k)
+
+
+def _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k):
+    """Kernel-eligible (q, k, v, scale, kv_lens, seg_k, orig_hd), padding
+    where needed — or None when the shape can't ride the kernel.
+
+    Odd head_dims (SD-1.5's 40/80/160) zero-pad to the next supported lane
+    width — exact: zero q/k lanes add 0 to every score and the v pad lanes
+    are sliced away by the caller. Short cross-attention KV (e.g. 77 text
+    tokens) pads to the next 128 block with kv_lens masking (pad seg ids
+    get -1, matching no query segment). Causal with a padded KV is
+    excluded (the bottom-right alignment would shift)."""
+    hd = q.shape[-1]
+    sk = k.shape[1]
+    hd_t = hd if hd in (64, 128, 256) else next(
+        (t for t in (64, 128, 256) if t >= hd), None)
+    sk_t = -(-sk // 128) * 128
+    if (hd_t is None or not _pallas_seq_ok(q.shape[1], sk_t)
+            or (is_causal and sk_t != sk)):
+        return None
+    if hd_t == hd and sk_t == sk:
+        return q, k, v, scale, kv_lens, seg_k, hd
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    if sk_t != sk:
+        kv_lens = (jnp.full((q.shape[0],), sk, jnp.int32)
+                   if kv_lens is None else jnp.minimum(kv_lens, sk))
+        if seg_k is not None:
+            seg_k = jnp.pad(seg_k, ((0, 0), (0, sk_t - sk)),
+                            constant_values=-1)
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, hd_t - hd)))
+    pad_kv = ((0, 0), (0, sk_t - sk), (0, 0), (0, hd_t - hd))
+    return q, jnp.pad(k, pad_kv), jnp.pad(v, pad_kv), scale, kv_lens, \
+        seg_k, hd
 
 
 # ---- Pallas kernels (internal layout (b, h, s, d)) -------------------------
