@@ -161,14 +161,16 @@ class Engine:
         self.optimizer = optimizer
         self.strategy = strategy
         self._step_fn = None
+        self._init_fn = None
         self._state = None
         self._opt_state = None
         self._mesh = mesh
         self._history = []
 
-    def _ensure_built(self):
+    def _build_step(self):
+        """Build the jitted hybrid step (no state materialization)."""
         if self._step_fn is not None:
-            return
+            return None
         from paddle_tpu.parallel import fleet
         from paddle_tpu.parallel.strategy import DistributedStrategy
         from paddle_tpu.parallel.topology import (
@@ -183,9 +185,22 @@ class Engine:
         hcg = get_hybrid_communicate_group()
         if hcg.get_pipe_parallel_world_size() > 1:
             loss_fn = None       # pipeline head computes the loss
-        self._step_fn, init_fn = fleet.make_train_step(
+        self._step_fn, self._init_fn = fleet.make_train_step(
             self.model, self.optimizer, loss_fn, strategy=self.strategy)
-        self._state, self._opt_state = init_fn()
+        return self._init_fn
+
+    def _ensure_built(self):
+        self._build_step()
+        if self._state is None:   # not gated on _build_step's return —
+            # the step may have been built state-free via lower() first
+            self._state, self._opt_state = self._init_fn()
+
+    def lower(self, batch_shape, seq_len, **kw):
+        """AOT-lower the semi-auto program from abstract shapes — the
+        scale-report path (SCALE.md): Engine.fit's built program without
+        materializing a single parameter buffer."""
+        self._build_step()
+        return self._step_fn.lower(batch_shape, seq_len, **kw)
 
     @staticmethod
     def _as_batch(batch):

@@ -79,6 +79,93 @@ class FileHeartbeatStore(HeartbeatStore):
             pass
 
 
+class CoordinationServiceStore(HeartbeatStore):
+    """Heartbeats over a coordination-service KV (the TCPStore/etcd
+    analog) — no shared filesystem required (VERDICT r3 #8: clusters
+    without a shared dir).
+
+    Two modes:
+    * ``CoordinationServiceStore.connect(address, rank, world)`` — the
+      launcher-side mode: rank 0 HOSTS the service on `address`, every
+      launcher connects a client. Mirrors the reference's etcd being
+      infra-level, outside the trainers.
+    * ``CoordinationServiceStore(client=...)`` / ``.from_jax()`` — reuse
+      an existing client (inside a training process after
+      `jax.distributed.initialize`, the job's own coordination service).
+    """
+
+    def __init__(self, client, prefix: str = "pt_elastic", service=None):
+        self._client = client
+        self._prefix = prefix
+        self._service = service        # kept alive on the hosting rank
+
+    @classmethod
+    def connect(cls, address: str, rank: int, world_size: int,
+                prefix: str = "pt_elastic", timeout_s: float = 60.0):
+        from jax._src.lib import _jax
+        service = None
+        if rank == 0:
+            service = _jax.get_distributed_runtime_service(
+                address, world_size)
+        # a peer launcher dying is the NORMAL event elastic mode exists
+        # for — the default client callbacks would terminate THIS process
+        # on a peer's missed heartbeat / service error, defeating the
+        # whole recovery loop. Log instead; the ElasticManager TTL watch
+        # owns the reaction.
+        client = _jax.get_distributed_runtime_client(
+            address, rank, init_timeout=int(timeout_s),
+            shutdown_on_destruction=False,
+            missed_heartbeat_callback=lambda *a:
+                logger.warning("elastic KV heartbeat event: %s", a))
+        client.connect()
+        return cls(client, prefix=prefix, service=service)
+
+    @classmethod
+    def from_jax(cls, prefix: str = "pt_elastic"):
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "CoordinationServiceStore.from_jax needs "
+                "jax.distributed.initialize (init_parallel_env) first")
+        return cls(client, prefix=prefix)
+
+    def put(self, member, payload):
+        self._client.key_value_set(f"{self._prefix}/{member}",
+                                   json.dumps(payload), allow_overwrite=True)
+
+    def members(self):
+        out = {}
+        try:
+            items = self._client.key_value_dir_get(self._prefix)
+        except Exception as e:
+            # empty prefix reads as NOT_FOUND on some versions — that is
+            # genuinely "no members". Anything else (RPC hiccup, service
+            # error) must NOT read as an empty world: the watcher would
+            # declare every peer dead and kill a healthy job.
+            if "NOT_FOUND" in str(e) or "not found" in str(e).lower():
+                return out
+            raise
+        for key, val in items:
+            try:
+                out[key.rsplit("/", 1)[-1]] = json.loads(val)
+            except ValueError:
+                continue
+        return out
+
+    def remove(self, member):
+        try:
+            self._client.key_value_delete(f"{self._prefix}/{member}")
+        except Exception:
+            pass
+
+    def close(self):
+        try:
+            self._client.shutdown()
+        finally:
+            self._service = None
+
+
 class ElasticManager:
     """Register + heartbeat this host; watch for lost/joined peers.
 

@@ -143,6 +143,29 @@ def get_hybrid_communicate_group_():
 
 # ---- the compiled hybrid train step ---------------------------------------
 
+def abstract_train_state(state0, pspecs, ospecs, optimizer, mesh,
+                         scaler=None):
+    """(abstract_state, abstract_opt) ShapeDtypeStructs with shardings —
+    the shared AOT-lowering substrate of this module's and the pipeline
+    engine's `step_fn.lower` hooks (one copy: an opt-state layout change
+    must not silently diverge the two feasibility reports)."""
+    abstract_state = {k: jax.ShapeDtypeStruct(
+        v.shape, v.dtype, sharding=NamedSharding(mesh, pspecs[k]))
+        for k, v in state0.items()}
+    abstract_opt = jax.eval_shape(optimizer.init_state, abstract_state)
+    if scaler is not None:
+        abstract_opt["scaler"] = jax.eval_shape(scaler.init_state)
+
+    def shard_slot(tree):
+        if isinstance(tree, dict):
+            return {k: jax.ShapeDtypeStruct(
+                v.shape, v.dtype,
+                sharding=NamedSharding(mesh, ospecs.get(k, P())))
+                for k, v in tree.items()}
+        return tree
+    return abstract_state, {slot: shard_slot(t)
+                            for slot, t in abstract_opt.items()}
+
 def make_train_step(model: Layer, optimizer, loss_fn: Callable,
                     strategy: Optional[DistributedStrategy] = None,
                     hcg: Optional[HybridCommunicateGroup] = None,
@@ -353,5 +376,25 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
             rngs = {name: rng_mod.global_key() for name in rng_streams}
         batch = jax.tree_util.tree_map(_place_batch_leaf, batch)
         return jit_step(state, opt_state, batch, rngs)
+
+    def lower(batch_shape, seq_len, ids_dtype=jnp.int32):
+        """AOT-lower the compiled step from abstract shapes (no real
+        buffers) — .compile().memory_analysis() gives the per-device
+        accounting for feasibility reports (SCALE.md), mirroring the
+        pipeline engine's hook."""
+        abstract_state, abstract_opt = abstract_train_state(
+            state0, pspecs, ospecs, optimizer, mesh, scaler=scaler)
+        bsh = NamedSharding(mesh, P(bspec[0], None))
+        abstract_batch = {
+            "input": jax.ShapeDtypeStruct((batch_shape, seq_len), ids_dtype,
+                                          sharding=bsh),
+            "labels": jax.ShapeDtypeStruct((batch_shape, seq_len), ids_dtype,
+                                           sharding=bsh)}
+        abstract_rngs = {name: jax.eval_shape(
+            lambda: jax.random.PRNGKey(0)) for name in rng_streams}
+        return jit_step.lower(abstract_state, abstract_opt, abstract_batch,
+                              abstract_rngs)
+
+    step_fn.lower = lower
 
     return step_fn, init_fn

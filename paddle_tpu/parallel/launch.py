@@ -54,22 +54,29 @@ def _spawn_target(func, rank, nprocs, args):
 
 def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
            max_restarts=0, log_dir=None, elastic_dir=None,
-           heartbeat_interval=2.0, elastic_world_timeout=300.0):
+           heartbeat_interval=2.0, elastic_world_timeout=300.0,
+           elastic_master=None):
     """Run the training script once per host with restart-on-failure
     (elastic_level ≈ max_restarts; recovery is resume-from-checkpoint).
 
-    With `elastic_dir` (a directory all hosts share), this node heartbeats
-    an ElasticManager registry and a watch thread kills the child when a
-    peer host's heartbeat lapses — the relaunch then resumes from the last
+    With `elastic_dir` (a directory all hosts share) OR `elastic_master`
+    (host:port — node 0's launcher hosts a coordination-service KV there,
+    no shared filesystem needed), this node heartbeats an ElasticManager
+    registry and a watch thread kills the child when a peer host's
+    heartbeat lapses — the relaunch then resumes from the last
     checkpoint, the reference ElasticManager's recovery contract
-    (SURVEY.md §5-failure, fleet/elastic/manager.py)."""
+    (SURVEY.md §5-failure, fleet/elastic/manager.py; etcd analog)."""
     mgr = None
     membership_changed = [False]
     proc_holder = [None]
-    if elastic_dir:
-        from paddle_tpu.parallel.elastic import (ElasticManager,
+    if elastic_dir or elastic_master:
+        from paddle_tpu.parallel.elastic import (CoordinationServiceStore,
+                                                 ElasticManager,
                                                  FileHeartbeatStore)
-        mgr = ElasticManager(FileHeartbeatStore(elastic_dir), rank=node_rank,
+        store = (CoordinationServiceStore.connect(
+            elastic_master, node_rank, nnodes) if elastic_master
+            else FileHeartbeatStore(elastic_dir))
+        mgr = ElasticManager(store, rank=node_rank,
                              world_size=nnodes,
                              heartbeat_interval=heartbeat_interval).start()
 
@@ -120,6 +127,12 @@ def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
     finally:
         if mgr is not None:
             mgr.stop()
+            # NO collective client.shutdown() here: launchers exit at
+            # different times (success, restart budget, give-up), so the
+            # shutdown barrier would block and then poison the service for
+            # survivors. The client is constructed non-fatal
+            # (shutdown_on_destruction=False, logging heartbeat callback),
+            # so simply dropping it is safe.
 
 
 def main(argv=None):
@@ -132,12 +145,17 @@ def main(argv=None):
     ap.add_argument("--log_dir", default=None)
     ap.add_argument("--elastic_dir", default=None,
                     help="shared dir for membership heartbeats (etcd analog)")
+    ap.add_argument("--elastic_master", default=None,
+                    help="host:port for a coordination-service heartbeat "
+                    "KV hosted by node 0's launcher (storeless elastic — "
+                    "no shared dir needed)")
     ap.add_argument("--heartbeat_interval", type=float, default=2.0)
     ap.add_argument("script", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
     sys.exit(launch(ns.script, ns.nnodes, ns.node_rank, ns.master,
                     ns.max_restarts, ns.log_dir, elastic_dir=ns.elastic_dir,
-                    heartbeat_interval=ns.heartbeat_interval))
+                    heartbeat_interval=ns.heartbeat_interval,
+                    elastic_master=ns.elastic_master))
 
 
 if __name__ == "__main__":

@@ -646,20 +646,9 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
             raise ValueError(
                 f"batch {batch_shape} not divisible by n_micro={n_micro}")
         mb = batch_shape // n_micro
-        abstract_state = {k: jax.ShapeDtypeStruct(
-            v.shape, v.dtype, sharding=NamedSharding(mesh, pspecs[k]))
-            for k, v in state0.items()}
-        abstract_opt = jax.eval_shape(optimizer.init_state, abstract_state)
-
-        def shard_slot(tree):
-            if isinstance(tree, dict):
-                return {k: jax.ShapeDtypeStruct(
-                    v.shape, v.dtype,
-                    sharding=NamedSharding(mesh, ospecs.get(k, P())))
-                    for k, v in tree.items()}
-            return tree
-        abstract_opt = {slot: shard_slot(t) for slot, t in
-                        abstract_opt.items()}
+        from paddle_tpu.parallel.fleet import abstract_train_state
+        abstract_state, abstract_opt = abstract_train_state(
+            state0, pspecs, ospecs, optimizer, mesh)
         dp_total = 1
         for a in dp_axes:
             dp_total *= mesh.shape[a]
