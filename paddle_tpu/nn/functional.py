@@ -462,19 +462,22 @@ def kl_div(input, label, reduction="mean"):
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, scale=None,
                                  kv_lens=None, segment_ids=None,
-                                 kv_segment_ids=None):
+                                 kv_segment_ids=None, window_size=None,
+                                 alibi_slopes=None):
     """q/k/v: (batch, seq, heads, head_dim) — the reference's layout.
 
     Dispatches to the Pallas flash kernel on TPU when profitable
     (paddle_tpu.ops.flash_attention), else the XLA softmax path. Supports
     cross-attention (sq != sk) and the structured-mask extensions
-    `kv_lens` / `segment_ids` (see ops.flash_attention).
+    `kv_lens` / `segment_ids` / `window_size` / `alibi_slopes` (see
+    ops.flash_attention).
     """
     from paddle_tpu.ops import flash_attention as fa
     return fa.scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
         training=training, scale=scale, kv_lens=kv_lens,
-        segment_ids=segment_ids, kv_segment_ids=kv_segment_ids)
+        segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+        window_size=window_size, alibi_slopes=alibi_slopes)
 
 
 # ---- misc ------------------------------------------------------------------

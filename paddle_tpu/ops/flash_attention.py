@@ -16,10 +16,15 @@ the (s, s) matrix is never materialized) covers, on TPU:
   kernel takes via cu_seqlens),
 * segment ids (`segment_ids` / `kv_segment_ids` — packed-sequence masking,
   the TPU-native equivalent of flash_attn_unpadded's varlen batches),
+* causal sliding windows (`window_size` — Mistral-style, with k-block
+  skipping on both ends) and ALiBi (`alibi_slopes` — per-head linear
+  bias applied inside the online softmax),
+* odd head dims / short cross-KV via zero-padding (`_pad_for_kernel`),
 
 forward and backward. Documented exclusions that ride the XLA einsum path:
-attention dropout and arbitrary dense masks. Kernels compute internally in
-(b, h, s, d) so the trailing block dims meet TPU tiling (8, 128).
+attention dropout and arbitrary dense masks (every structured form above
+is in the kernels). Kernels compute internally in (b, h, s, d) so the
+trailing block dims meet TPU tiling (8, 128).
 """
 
 import functools
@@ -58,12 +63,19 @@ def _repeat_kv(k, n_rep):
         b, s, h * n_rep, d)
 
 
-def _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k):
+def _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k,
+                     window=None):
     """Dense (b, 1, sq, sk) or (1, 1, sq, sk) bool mask for the XLA path."""
     masks = []
     if is_causal:
         masks.append(jnp.tril(jnp.ones((sq, sk), bool),
                               k=sk - sq)[None, None])
+    if window is not None:
+        # sliding window (bottom-right aligned): q row i sees the last
+        # `window` keys up to i + (sk - sq)
+        dist = ((jnp.arange(sq)[:, None] + (sk - sq))
+                - jnp.arange(sk)[None, :])
+        masks.append((dist < window)[None, None])
     if kv_lens is not None:
         masks.append((jnp.arange(sk)[None, :] <
                       kv_lens[:, None])[:, None, None, :])
@@ -80,7 +92,7 @@ def _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k):
 
 def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
                    dropout_p=0.0, training=True, kv_lens=None,
-                   seg_q=None, seg_k=None):
+                   seg_q=None, seg_k=None, window=None, alibi_slopes=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     n_rep = h // k.shape[2]
@@ -92,7 +104,14 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.promote_types(
                             q.dtype, jnp.float32)) * scale
-    structured = _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k)
+    if alibi_slopes is not None:
+        dist = (jnp.arange(sk)[None, :]
+                - (jnp.arange(sq)[:, None] + (sk - sq)))
+        scores = scores + (alibi_slopes.astype(scores.dtype)[None, :, None,
+                                                             None]
+                           * dist.astype(scores.dtype)[None, None])
+    structured = _structured_mask(sq, sk, is_causal, kv_lens, seg_q, seg_k,
+                                  window=window)
     if structured is not None:
         scores = jnp.where(structured, scores, NEG_INF)
     if attn_mask is not None:
@@ -118,26 +137,32 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, scale=None,
 
 def flash_attention(q, k, v, dropout=0.0, causal=False, attn_mask=None,
                     training=True, scale=None, kv_lens=None,
-                    segment_ids=None, kv_segment_ids=None):
+                    segment_ids=None, kv_segment_ids=None,
+                    window_size=None, alibi_slopes=None):
     """paddle.nn.functional.flash_attention parity. Returns (out, None)."""
     out = scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=dropout, is_causal=causal,
         training=training, scale=scale, kv_lens=kv_lens,
-        segment_ids=segment_ids, kv_segment_ids=kv_segment_ids)
+        segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+        window_size=window_size, alibi_slopes=alibi_slopes)
     return out, None
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, scale=None,
                                  kv_lens=None, segment_ids=None,
-                                 kv_segment_ids=None):
+                                 kv_segment_ids=None, window_size=None,
+                                 alibi_slopes=None):
     """Attention with the fused-kernel dispatch.
 
     TPU-native extensions beyond the reference veneer: `kv_lens` (b,) valid
     KV lengths (padding mask), `segment_ids` (b, sq) / `kv_segment_ids`
-    (b, sk) packed-sequence masks (attention only within equal ids). Both
-    run inside the Pallas kernels; on other backends they lower to dense
-    masks on the XLA path.
+    (b, sk) packed-sequence masks (attention only within equal ids),
+    `window_size` (int — causal sliding window, Mistral-style: each query
+    sees the last `window_size` keys) and `alibi_slopes` ((num_heads,)
+    fp32 — ALiBi linear bias, score += slope·(k_pos − q_pos)). All run
+    inside the Pallas kernels forward AND backward; on other backends
+    they lower to dense masks/bias on the XLA path.
     """
     from paddle_tpu.ops import use_pallas
     seg_q = segment_ids
@@ -151,6 +176,28 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             "segment_ids alone requires sq == sk; pass kv_segment_ids "
             f"explicitly for cross-attention (sq={q.shape[1]}, "
             f"sk={k.shape[1]})")
+    if window_size is not None:
+        window_size = int(window_size)
+        if not is_causal:
+            raise ValueError("window_size requires is_causal=True "
+                             "(causal sliding window)")
+        if window_size < 1:
+            raise ValueError(f"window_size must be >= 1, got {window_size}")
+    if alibi_slopes is not None:
+        if not is_causal:
+            raise ValueError(
+                "alibi_slopes requires is_causal=True (the ALiBi bias is "
+                "defined over causal distances; a non-causal form would "
+                "reward distant FUTURE keys)")
+        # slopes are fixed constants in the ALiBi formulation (a geometric
+        # head schedule, not learned) — stop_gradient keeps the Pallas and
+        # XLA paths consistent (the kernels do not compute dL/dslopes)
+        alibi_slopes = jax.lax.stop_gradient(
+            jnp.asarray(alibi_slopes, jnp.float32))
+        if alibi_slopes.shape != (q.shape[2],):
+            raise ValueError(
+                f"alibi_slopes must be (num_heads,)=({q.shape[2]},), got "
+                f"{alibi_slopes.shape}")
     # Pallas path: TPU, seq dims multiples of 128 and long enough to beat
     # XLA. Shapes the kernel can't take directly may still ride it via
     # _pad_for_kernel (odd head dims, short cross-KV). Documented
@@ -163,7 +210,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             qp, kp, vp, scale_p, klp, skp, hd = padded
             try:
                 out = _flash_call(qp, kp, vp, is_causal, scale_p, klp,
-                                  seg_q, skp)
+                                  seg_q, skp, window=window_size,
+                                  alibi_slopes=alibi_slopes)
                 return out if out.shape[-1] == hd else out[..., :hd]
             except Exception as e:
                 from paddle_tpu.core.flags import flag
@@ -173,7 +221,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           scale=scale, dropout_p=dropout_p,
                           training=training, kv_lens=kv_lens,
-                          seg_q=seg_q, seg_k=seg_k)
+                          seg_q=seg_q, seg_k=seg_k, window=window_size,
+                          alibi_slopes=alibi_slopes)
 
 
 def _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k):
@@ -228,22 +277,37 @@ def _causal_nk(qi, blk_q, blk_k, off, sk):
 
 
 def _block_mask(s_blk, qi, ki, blk_q, blk_k, off, is_causal,
-                kvlen_b, segq_blk, segk_ref):
+                kvlen_b, segq_blk, segk_ref, window=None, alibi=None):
     """Apply the structured masks to one (blk_q, blk_k) score block.
 
     kvlen_b: scalar valid length or None; segq_blk: (blk_q, 1) ids or
-    None; segk_ref: callable ki -> (1, blk_k) ids."""
+    None; segk_ref: callable ki -> (1, blk_k) ids; window: static int
+    sliding-window width (causal: q row i sees the last `window` keys up
+    to i + off); alibi: this head's ALiBi slope (traced fp32 scalar) —
+    score += slope · (k_pos − q_pos − off), the standard ≤ 0 linear bias."""
     k_pos = ki * blk_k + lax.broadcasted_iota(
         jnp.int32, (blk_q, blk_k), 1)
-    if is_causal:
+    if is_causal or window is not None or alibi is not None:
         q_pos = qi * blk_q + lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 0)
+    if alibi is not None:
+        s_blk = s_blk + alibi * (k_pos - q_pos - off).astype(jnp.float32)
+    if is_causal:
         s_blk = jnp.where(q_pos + off >= k_pos, s_blk, NEG_INF)
+    if window is not None:
+        s_blk = jnp.where(q_pos + off - k_pos < window, s_blk, NEG_INF)
     if kvlen_b is not None:
         s_blk = jnp.where(k_pos < kvlen_b, s_blk, NEG_INF)
     if segq_blk is not None:
         s_blk = jnp.where(segq_blk == segk_ref(ki), s_blk, NEG_INF)
     return s_blk
+
+
+def _window_k0(qi, blk_q, blk_k, off, window):
+    """First k-block a sliding-window q-block can see (block skipping):
+    q row q_pos attends k in (q_pos + off − window, q_pos + off]."""
+    lo = qi * blk_q + off - window + 1          # first visible k col
+    return jnp.clip(lo // blk_k, 0, None)
 
 
 def _seg_specs():
@@ -261,19 +325,23 @@ def _seg_specs():
     return spec
 
 
-def _build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, extra):
-    """Shared operand assembly: [q, k, v, (lens), (segq, segk)] + extra."""
+def _build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, extra,
+                    alibi_slopes=None):
+    """Shared operand assembly:
+    [q, k, v, (lens), (segq, segk), (alibi)] + extra."""
     ops = [qt, kt, vt]
     if kv_lens is not None:
         ops.append(kv_lens.astype(jnp.int32))
     if seg_q is not None:
         ops.append(seg_q.astype(jnp.int32)[:, None])   # (b, 1, sq)
         ops.append(seg_k.astype(jnp.int32)[:, None])   # (b, 1, sk)
+    if alibi_slopes is not None:
+        ops.append(alibi_slopes.astype(jnp.float32))   # (h,)
     return ops + extra
 
 
 def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
-                 seg_k=None):
+                 seg_k=None, window=None, alibi_slopes=None):
     """qt (b,h,sq,d), kt/vt (b,h,sk,d) → (out (b,h,sq,d), lse (b,h,sq))."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -286,6 +354,7 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
     grid = (b, h, sq // blk_q)
     has_len = kv_lens is not None
     has_seg = seg_q is not None
+    has_alibi = alibi_slopes is not None
 
     def kernel(*refs):
         i = 3
@@ -294,6 +363,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         segq_ref = refs[i] if has_seg else None
         segk_ref = refs[i + 1] if has_seg else None
         i += 2 * has_seg
+        slopes_ref = refs[i] if has_alibi else None
+        i += has_alibi
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         o_ref, lse_ref = refs[i], refs[i + 1]
 
@@ -301,6 +372,7 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32) * sc  # (blk_q, d)
         kvlen_b = lens_ref[bi] if has_len else None
+        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
         segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
                     if has_seg else None)          # (blk_q, 1)
         seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
@@ -312,7 +384,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = qv @ kv.T  # (blk_q, blk_k)
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
-                                is_causal, kvlen_b, segq_blk, seg_at)
+                                is_causal, kvlen_b, segq_blk, seg_at,
+                                window=window, alibi=alibi)
             m_cur = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
             alpha = jnp.exp(m_prev - m_cur)
             # rows with no valid entry yet keep m at NEG_INF — their p
@@ -330,7 +403,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             else sk // blk_k
         if has_len:   # skip k-blocks entirely past the valid length
             n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
-        acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+        k0 = _window_k0(qi, blk_q, blk_k, off, window) if window else 0
+        acc, m, l = lax.fori_loop(k0, n_k, body, (acc0, m0, l0))
         lsafe = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc / lsafe[:, None]).astype(o_ref.dtype)
         # TPU tiling wants 2-D trailing blocks: replicate lse across lanes
@@ -347,6 +421,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
     if has_seg:
         spec = _seg_specs()
         in_specs += [spec(blk_q, sq), spec(None, sk)]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     out, lse = pl.pallas_call(
         kernel,
@@ -362,12 +438,14 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
             jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
         ],
-    )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, []))
+    )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, [],
+                       alibi_slopes=alibi_slopes))
     return out, lse
 
 
 def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
-                   kv_lens=None, seg_q=None, seg_k=None):
+                   kv_lens=None, seg_q=None, seg_k=None, window=None,
+                   alibi_slopes=None):
     """dq: loop over k-blocks for each q-block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -380,6 +458,7 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     grid = (b, h, sq // blk_q)
     has_len = kv_lens is not None
     has_seg = seg_q is not None
+    has_alibi = alibi_slopes is not None
 
     def kernel(*refs):
         i = 3
@@ -388,6 +467,8 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         segq_ref = refs[i] if has_seg else None
         segk_ref = refs[i + 1] if has_seg else None
         i += 2 * has_seg
+        slopes_ref = refs[i] if has_alibi else None
+        i += has_alibi
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         do_ref, lse_ref, dl_ref, dq_ref = refs[i:i + 4]
 
@@ -398,6 +479,7 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         lse_q = lse_ref[...][:, 0]                    # (blk_q,)
         delta_q = dl_ref[...][:, 0]                   # (blk_q,)
         kvlen_b = lens_ref[bi] if has_len else None
+        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
         segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
                     if has_seg else None)
         seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
@@ -408,7 +490,8 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             vv = v_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
             s_blk = (qv @ kv.T) * sc
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
-                                is_causal, kvlen_b, segq_blk, seg_at)
+                                is_causal, kvlen_b, segq_blk, seg_at,
+                                window=window, alibi=alibi)
             p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
                           jnp.exp(s_blk - lse_q[:, None]))
             dp = do @ vv.T                            # (blk_q, blk_k)
@@ -419,7 +502,9 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             else sk // blk_k
         if has_len:
             n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
-        dq = lax.fori_loop(0, n_k, body, jnp.zeros((blk_q, d), jnp.float32))
+        k0 = _window_k0(qi, blk_q, blk_k, off, window) if window else 0
+        dq = lax.fori_loop(k0, n_k, body,
+                           jnp.zeros((blk_q, d), jnp.float32))
         dq_ref[...] = dq.astype(dq_ref.dtype)
 
     kfull = lambda: pl.BlockSpec((None, None, sk, d),
@@ -434,6 +519,8 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     if has_seg:
         spec = _seg_specs()
         in_specs += [spec(blk_q, sq), spec(None, sk)]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     in_specs += [qblk(), row(), row()]
     return pl.pallas_call(
         kernel,
@@ -442,11 +529,12 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         out_specs=qblk(),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
     )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
-                       [dot, lse, delta]))
+                       [dot, lse, delta], alibi_slopes=alibi_slopes))
 
 
 def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
-                    kv_lens=None, seg_q=None, seg_k=None):
+                    kv_lens=None, seg_q=None, seg_k=None, window=None,
+                    alibi_slopes=None):
     """dk, dv: loop over q-blocks for each k-block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -459,6 +547,7 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     grid = (b, h, sk // blk_k)
     has_len = kv_lens is not None
     has_seg = seg_q is not None
+    has_alibi = alibi_slopes is not None
 
     def kernel(*refs):
         i = 3
@@ -467,6 +556,8 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         segq_ref = refs[i] if has_seg else None
         segk_ref = refs[i + 1] if has_seg else None
         i += 2 * has_seg
+        slopes_ref = refs[i] if has_alibi else None
+        i += has_alibi
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs[i:i + 5]
 
@@ -475,6 +566,7 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         kv = k_ref[...].astype(jnp.float32)           # (blk_k, d)
         vv = v_ref[...].astype(jnp.float32)
         kvlen_b = lens_ref[bi] if has_len else None
+        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
         # k-side ids for THIS block, as (1, blk_k); q-side read per block
         segk_blk = segk_ref[...] if has_seg else None
         seg_at = (lambda _ki: segk_blk) if has_seg else None
@@ -490,7 +582,8 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
                 segq_ref[:, pl.ds(qi * blk_q, blk_q)], (1, 0))
                 if has_seg else None)
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
-                                is_causal, kvlen_b, segq_blk, seg_at)
+                                is_causal, kvlen_b, segq_blk, seg_at,
+                                window=window, alibi=alibi)
             p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
                           jnp.exp(s_blk - lse_q[:, None]))
             dv_acc = dv_acc + p.T @ do
@@ -505,7 +598,14 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             q0 = jnp.clip((ki * blk_k - off) // blk_q, 0, n_q)
         else:
             q0 = 0
-        dk, dv = lax.fori_loop(q0, n_q, body,
+        q_hi = n_q
+        if window is not None:
+            # sliding window: q rows past k_pos + window - 1 - off can't
+            # see this k-block (loose block bound; the mask is exact)
+            q_hi = jnp.clip(
+                (ki * blk_k + blk_k - 1 + window - off) // blk_q + 1,
+                0, n_q)
+        dk, dv = lax.fori_loop(q0, q_hi, body,
                                (jnp.zeros((blk_k, d), jnp.float32),
                                 jnp.zeros((blk_k, d), jnp.float32)))
         dk_ref[...] = dk.astype(dk_ref.dtype)
@@ -523,6 +623,8 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     if has_seg:
         spec = _seg_specs()
         in_specs += [spec(None, sq), spec(blk_k, sk)]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     in_specs += [qfull(), frow(), frow()]
     return pl.pallas_call(
         kernel,
@@ -532,7 +634,7 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype)],
     )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
-                       [dot, lse, delta]))
+                       [dot, lse, delta], alibi_slopes=alibi_slopes))
 
 
 @functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
@@ -543,7 +645,7 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
 
 
 def _flash_fwd(q, k, v, is_causal, scale, kv_lens=None, seg_q=None,
-               seg_k=None):
+               seg_k=None, window=None, alibi_slopes=None):
     b, sq, h, d = q.shape
     n_rep = h // k.shape[2]
     k = _repeat_kv(k, n_rep)
@@ -553,7 +655,8 @@ def _flash_fwd(q, k, v, is_causal, scale, kv_lens=None, seg_q=None,
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     out_t, lse = _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=kv_lens,
-                              seg_q=seg_q, seg_k=seg_k)
+                              seg_q=seg_q, seg_k=seg_k, window=window,
+                              alibi_slopes=alibi_slopes)
     return jnp.transpose(out_t, (0, 2, 1, 3)), lse
 
 
@@ -561,39 +664,50 @@ def _float0_like(a):
     return np.zeros(a.shape, jax.dtypes.float0) if a is not None else None
 
 
-def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k):
+def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k,
+                window=None, alibi_slopes=None):
     """Differentiable entry covering all structured-mask forms."""
-    flags = (kv_lens is not None, seg_q is not None)
+    flags = (kv_lens is not None, seg_q is not None,
+             alibi_slopes is not None)
     dummy_len = kv_lens if flags[0] else jnp.zeros((1,), jnp.int32)
     dummy_sq = seg_q if flags[1] else jnp.zeros((1, 1), jnp.int32)
     dummy_sk = seg_k if flags[1] else jnp.zeros((1, 1), jnp.int32)
+    dummy_al = (alibi_slopes if flags[2]
+                else jnp.zeros((1,), jnp.float32))
     return _flash_vjp_entry(q, k, v, dummy_len, dummy_sq, dummy_sk,
-                            flags, is_causal, scale)
+                            dummy_al, flags, is_causal, scale, window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
-def _flash_vjp_entry(q, k, v, kv_lens, seg_q, seg_k, flags, is_causal,
-                     scale):
+def _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window):
+    has_len, has_seg, has_alibi = flags
+    return dict(kv_lens=kv_lens if has_len else None,
+                seg_q=seg_q if has_seg else None,
+                seg_k=seg_k if has_seg else None,
+                window=window,
+                alibi_slopes=alibi if has_alibi else None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash_vjp_entry(q, k, v, kv_lens, seg_q, seg_k, alibi, flags,
+                     is_causal, scale, window):
     """Pallas forward + Pallas backward (dq / dk+dv block kernels)."""
-    has_len, has_seg = flags
     out, _ = _flash_fwd(q, k, v, is_causal, scale,
-                        kv_lens=kv_lens if has_len else None,
-                        seg_q=seg_q if has_seg else None,
-                        seg_k=seg_k if has_seg else None)
+                        **_mask_kw(kv_lens, seg_q, seg_k, alibi, flags,
+                                   window))
     return out
 
 
-def _flash_vjp_fwd(q, k, v, kv_lens, seg_q, seg_k, flags, is_causal, scale):
-    has_len, has_seg = flags
+def _flash_vjp_fwd(q, k, v, kv_lens, seg_q, seg_k, alibi, flags,
+                   is_causal, scale, window):
     out, lse = _flash_fwd(q, k, v, is_causal, scale,
-                          kv_lens=kv_lens if has_len else None,
-                          seg_q=seg_q if has_seg else None,
-                          seg_k=seg_k if has_seg else None)
-    return out, (q, k, v, out, lse, kv_lens, seg_q, seg_k)
+                          **_mask_kw(kv_lens, seg_q, seg_k, alibi, flags,
+                                     window))
+    return out, (q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi)
 
 
 def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
-                     kv_lens=None, seg_q=None, seg_k=None):
+                     kv_lens=None, seg_q=None, seg_k=None, window=None,
+                     alibi_slopes=None):
     """Shared Pallas backward. `lse` is (b, h, sq, LANES). When `g_lse`
     (b, h, sq) is given (cotangent on the returned LSE, e.g. from a ring
     merge), it folds into the softmax-grad correction: dS = P·(dP − Δ)
@@ -617,7 +731,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
-    kw = dict(kv_lens=kv_lens, seg_q=seg_q, seg_k=seg_k)
+    kw = dict(kv_lens=kv_lens, seg_q=seg_q, seg_k=seg_k, window=window,
+              alibi_slopes=alibi_slopes)
     dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc, **kw)
     dk_t, dv_t = _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal,
                                  sc, **kw)
@@ -632,12 +747,9 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_vjp_bwd(flags, is_causal, scale, res, g):
-    q, k, v, out, lse, kv_lens, seg_q, seg_k = res
-    has_len, has_seg = flags
-    kw = dict(kv_lens=kv_lens if has_len else None,
-              seg_q=seg_q if has_seg else None,
-              seg_k=seg_k if has_seg else None)
+def _flash_vjp_bwd(flags, is_causal, scale, window, res, g):
+    q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi = res
+    kw = _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window)
     try:
         dq, dk, dv = _pallas_bwd_impl(q, k, v, out, lse, g, is_causal,
                                       scale, **kw)
@@ -653,7 +765,7 @@ def _flash_vjp_bwd(flags, is_causal, scale, res, g):
             q, k, v)
         dq, dk, dv = pull(g)
     return (dq, dk, dv, _float0_like(res[5]), _float0_like(res[6]),
-            _float0_like(res[7]))
+            _float0_like(res[7]), _float0_like(res[8]))
 
 
 _flash_vjp_entry.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

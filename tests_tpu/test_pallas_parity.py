@@ -369,3 +369,61 @@ def test_flash_padded_head_dim_and_kv_parity():
                                           kv_segment_ids=seg_kc)
     ref = fa._xla_attention(q, kc, vc, seg_q=seg_q, seg_k=seg_kc)
     assert_close(out, ref)
+
+
+def test_flash_sliding_window_parity():
+    """Causal sliding window (Mistral-style) in the kernels, fwd + all
+    grads, vs the XLA dense-mask path."""
+    b, s, h, d = 2, 1024, 4, 64
+    q = rand(30, b, s, h, d)
+    k = rand(31, b, s, h, d)
+    v = rand(32, b, s, h, d)
+    for w in (128, 200):     # block-aligned and unaligned windows
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             window_size=w)
+        ref = fa._xla_attention(q, k, v, is_causal=True, window=w)
+        assert_close(out, ref)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    gp = jax.jit(jax.grad(loss(lambda *a: F.scaled_dot_product_attention(
+        *a, is_causal=True, window_size=200)), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss(lambda *a: fa._xla_attention(
+        *a, is_causal=True, window=200)), argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        assert_close(a, b_, rtol=5e-2, atol=5e-2)
+
+
+def test_flash_alibi_parity():
+    """ALiBi per-head linear bias inside the online softmax, fwd + grads,
+    composed with the sliding window."""
+    b, s, h, d = 2, 1024, 4, 64
+    q = rand(33, b, s, h, d)
+    k = rand(34, b, s, h, d)
+    v = rand(35, b, s, h, d)
+    slopes = jnp.asarray([2.0 ** (-i) for i in range(1, h + 1)],
+                         jnp.float32)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                         alibi_slopes=slopes)
+    ref = fa._xla_attention(q, k, v, is_causal=True, alibi_slopes=slopes)
+    assert_close(out, ref)
+    # composed: window + alibi
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                         window_size=256,
+                                         alibi_slopes=slopes)
+    ref = fa._xla_attention(q, k, v, is_causal=True, window=256,
+                            alibi_slopes=slopes)
+    assert_close(out, ref)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    gp = jax.jit(jax.grad(loss(lambda *a: F.scaled_dot_product_attention(
+        *a, is_causal=True, alibi_slopes=slopes)),
+        argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss(lambda *a: fa._xla_attention(
+        *a, is_causal=True, alibi_slopes=slopes)),
+        argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        assert_close(a, b_, rtol=5e-2, atol=5e-2)
