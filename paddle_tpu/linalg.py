@@ -208,3 +208,10 @@ def tensorinv(x, ind=2):
 
 def tensorsolve(x, y, axes=None):
     return jnp.linalg.tensorsolve(x, y, axes=axes)
+
+
+def cholesky_inverse(x, upper=False):
+    """Inverse of A from its Cholesky factor (paddle.linalg.cholesky_inverse)."""
+    x = jnp.asarray(x)
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    return jax.scipy.linalg.cho_solve((x, not upper), eye)

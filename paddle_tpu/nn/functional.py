@@ -989,3 +989,297 @@ def adaptive_avg_pool3d(x, output_size):
 
 # reference path: paddle.nn.functional.flash_attention.flash_attention
 from paddle_tpu.ops.flash_attention import flash_attention  # noqa: F401,E402
+
+
+# ---- long-tail functional parity (reference python/paddle/nn/functional) ---
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """(..., n) lengths → (..., n, maxlen) 0/1 mask."""
+    from paddle_tpu.core.dtype import to_jax_dtype
+    x = jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(x))
+    return (jnp.arange(m) < x[..., None]).astype(to_jax_dtype(dtype))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit.astype(jnp.float32))
+    lab = label.astype(jnp.float32)
+    ce = (jnp.maximum(logit, 0) - logit * lab
+          + jnp.log1p(jnp.exp(-jnp.abs(logit)))).astype(jnp.float32)
+    p_t = p * lab + (1.0 - p) * (1.0 - lab)
+    a_t = alpha * lab + (1.0 - alpha) * (1.0 - lab)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce_loss(loss, reduction)   # shared helper (loss section)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """input (N, ..., C) probabilities, label (N, ..., 1) int classes."""
+    c = input.shape[-1]
+    oh = jax.nn.one_hot(jnp.squeeze(label, -1), c, dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * oh, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(oh, axis=red)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference paddle npair_loss: softmax CE over anchor·positiveᵀ with
+    same-label targets + L2 on the embeddings."""
+    a = anchor.astype(jnp.float32)
+    p = positive.astype(jnp.float32)
+    labels = labels.reshape(-1)
+    sim = jnp.matmul(a, p.T,
+                     preferred_element_type=jnp.float32)   # (n, n)
+    tgt = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    # Beta = 0.25 — the reference's (and TF's) npair regularizer weight
+    reg = 0.25 * l2_reg * (jnp.mean(jnp.sum(a * a, 1)) +
+                           jnp.mean(jnp.sum(p * p, 1)))
+    return ce + reg
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance.astype(jnp.float32), epsilon)
+    loss = 0.5 * (jnp.log(var)
+                  + jnp.square(input - label).astype(jnp.float32) / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce_loss(loss, reduction)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM channel shift across the segment (time) axis."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.pad(xr[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                      (0, 0)))
+    right = jnp.pad(xr[:, :-1, fold:2 * fold],
+                    ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([left, right, xr[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             data_format="NCHW"):
+    return interpolate(x, scale_factor=scale_factor, size=size, mode=mode,
+                       data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    from paddle_tpu import nn as _nn
+    return _nn.ZeroPad2D(padding, data_format=data_format)(x)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    from paddle_tpu import nn as _nn
+    layer = _nn.AlphaDropout(p)
+    layer.training = training
+    return layer(x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    from paddle_tpu import nn as _nn
+    layer = _nn.Dropout2D(p, data_format=data_format)
+    layer.training = training
+    return layer(x)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    from paddle_tpu import nn as _nn
+    layer = _nn.Dropout3D(p, data_format=data_format)
+    layer.training = training
+    return layer(x)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+    from paddle_tpu import nn as _nn
+    return _nn.MaxUnPool1D(kernel_size, stride, padding, data_format,
+                           output_size)(x, indices)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    from paddle_tpu import nn as _nn
+    return _nn.MaxUnPool2D(kernel_size, stride, padding, data_format,
+                           output_size)(x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+    from paddle_tpu import nn as _nn
+    return _nn.MaxUnPool3D(kernel_size, stride, padding, data_format,
+                           output_size)(x, indices)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL"):
+    from paddle_tpu import nn as _nn
+    return _nn.LPPool1D(norm_type, kernel_size, stride, padding, ceil_mode,
+                        data_format)(x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    from paddle_tpu import nn as _nn
+    return _nn.LPPool2D(norm_type, kernel_size, stride, padding, ceil_mode,
+                        data_format)(x)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2,
+                     preferred_element_type=jnp.float32).astype(x1.dtype)
+    return out + bias if bias is not None else out
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta (N, 2, 3) → sampling grid (N, H, W, 2) in [-1, 1] coords."""
+    n, _, h, w = (out_shape if len(out_shape) == 4
+                  else (out_shape[0], 1, out_shape[1], out_shape[2]))
+
+    def base(steps):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, steps)
+        half = 1.0 - 1.0 / steps
+        return jnp.linspace(-half, half, steps)
+
+    ys = base(h)
+    xs = base(w)
+    ones = jnp.ones((h, w))
+    grid = jnp.stack([jnp.broadcast_to(xs[None, :], (h, w)),
+                      jnp.broadcast_to(ys[:, None], (h, w)), ones],
+                     axis=-1)                       # (H, W, 3)
+    theta = jnp.asarray(theta, jnp.float32)
+    # fp32 accumulation: default TPU matmul precision (bf16 passes) puts
+    # ~1e-2 error on the [-1, 1] grid coords ≈ pixels at high resolution
+    return jnp.einsum("hwk,nok->nhwo", grid, theta,
+                      preferred_element_type=jnp.float32)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """4-D grid sampling (reference paddle.nn.functional.grid_sample):
+    x (N, C, H, W), grid (N, Hg, Wg, 2) with xy in [-1, 1]."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0].astype(jnp.float32)
+    gy = grid[..., 1].astype(jnp.float32)
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    fx = unnorm(gx, w)
+    fy = unnorm(gy, h)
+
+    def reflect(v, lo, hi):
+        # reflect into [lo, hi] (continuous coordinates, period 2*(hi-lo))
+        rng_ = hi - lo
+        v = jnp.abs(v - lo) % (2 * rng_)
+        return lo + jnp.where(v > rng_, 2 * rng_ - v, v)
+
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            fx = jnp.clip(reflect(fx, -0.5, w - 0.5), 0, w - 1)
+            fy = jnp.clip(reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    def gather(ix, iy):
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,Hg,Wg,C)
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    if mode == "nearest":
+        out = gather(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = (gather(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(x1, y0) * (wx * (1 - wy))[..., None]
+               + gather(x0, y1) * ((1 - wx) * wy)[..., None]
+               + gather(x1, y1) * (wx * wy)[..., None])
+    return jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)  # (N,C,Hg,Wg)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margins: target logit cosθ → cos(m1·θ + m2) − m3,
+    all logits scaled by `scale`, then softmax CE."""
+    # clip strictly inside (−1, 1): arccos has infinite slope at the
+    # endpoints, and normalized embeddings routinely hit cos == ±1.0 —
+    # the gradient would be NaN and poison the whole step
+    eps = 1e-6
+    cos = jnp.clip(logits.astype(jnp.float32), -1.0 + eps, 1.0 - eps)
+    theta = jnp.arccos(cos)
+    tgt = jnp.cos(margin1 * theta + margin2) - margin3
+    oh = jax.nn.one_hot(label.reshape(-1), logits.shape[-1],
+                        dtype=jnp.float32)
+    adjusted = scale * jnp.where(oh > 0, tgt, cos)
+    loss = cross_entropy(adjusted, label.reshape(-1), reduction=reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted, axis=-1)
+    return loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None):
+    """Functional form of nn.AdaptiveLogSoftmaxWithLoss (same math, params
+    passed explicitly). Returns (per-sample logprob of the target, mean
+    NLL loss)."""
+    n_clusters = len(tail_weights)
+    head_logits = input @ head_weight
+    if head_bias is not None:
+        head_logits = head_logits + head_bias
+    head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+    shortlist = cutoffs[0]
+    out = jnp.zeros(input.shape[0], jnp.float32)
+    in_short = label < shortlist
+    idx_short = jnp.clip(label, 0, shortlist - 1)
+    out = jnp.where(
+        in_short,
+        jnp.take_along_axis(head_logp, idx_short[:, None], 1)[:, 0], out)
+    for ci in range(n_clusters):
+        lo = cutoffs[ci]
+        hi = cutoffs[ci + 1]
+        in_c = (label >= lo) & (label < hi)
+        w1, w2 = tail_weights[ci]
+        tail_logp = jax.nn.log_softmax((input @ w1) @ w2, axis=-1)
+        rel = jnp.clip(label - lo, 0, hi - lo - 1)
+        lp = (head_logp[:, shortlist + ci]
+              + jnp.take_along_axis(tail_logp, rel[:, None], 1)[:, 0])
+        out = jnp.where(in_c, lp, out)
+    return out, -jnp.mean(out)

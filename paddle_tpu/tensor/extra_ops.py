@@ -32,6 +32,7 @@ __all__ = [
     "logspace", "histc", "unstack", "view", "view_as", "swapdims",
     "shard_index", "reduce_as", "multigammaln", "lu_solve",
     "standard_normal", "bernoulli", "poisson", "multinomial",
+    "gammaincc", "negative",
 ]
 
 
@@ -526,3 +527,12 @@ def multinomial(x, num_samples=1, replacement=False):
     # without replacement: Gumbel top-k
     g = jax.random.gumbel(_next_key(), x.shape)
     return jax.lax.top_k(logits + g, num_samples)[1]
+
+
+def gammaincc(x, y):
+    """Regularized upper incomplete gamma (reference paddle.gammaincc)."""
+    return jax.scipy.special.gammaincc(jnp.asarray(x), jnp.asarray(y))
+
+
+def negative(x):
+    return jnp.negative(jnp.asarray(x))

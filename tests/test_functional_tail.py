@@ -1,0 +1,233 @@
+"""Long-tail nn.functional parity (round 4): vision ops (grid_sample /
+affine_grid / temporal_shift), loss tail, functional wrappers over the
+pooling/dropout layers, and the remaining tensor/linalg stragglers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.tensor as T
+from paddle_tpu import linalg as L
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+rs = np.random.RandomState(0)
+
+
+def test_square_error_and_log_loss():
+    x = jnp.asarray([0.2, 0.8])
+    y = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(np.asarray(F.square_error_cost(x, y)),
+                               [0.04, 0.04], rtol=1e-6)
+    ll = F.log_loss(x, y, epsilon=0.0)
+    np.testing.assert_allclose(
+        np.asarray(ll), [-np.log(0.8), -np.log(0.8)], rtol=1e-5)
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(jnp.asarray([1, 3]), maxlen=4)
+    np.testing.assert_array_equal(np.asarray(m),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0]])
+    # maxlen inferred
+    assert F.sequence_mask(jnp.asarray([2, 5])).shape == (2, 5)
+
+
+def test_sigmoid_focal_loss_reduces_easy_examples():
+    logit = jnp.asarray([[4.0], [-4.0]])
+    label = jnp.asarray([[1.0], [1.0]])
+    loss = F.sigmoid_focal_loss(logit, label, reduction="none")
+    ln = np.asarray(loss)
+    assert ln[0, 0] < ln[1, 0]  # confident correct ≪ confident wrong
+    # gamma=0, alpha=0.5 reduces to scaled BCE
+    bce = F.binary_cross_entropy_with_logits(logit, label, reduction="none")
+    l0 = F.sigmoid_focal_loss(logit, label, alpha=0.5, gamma=0.0,
+                              reduction="none")
+    np.testing.assert_allclose(np.asarray(l0), 0.5 * np.asarray(bce),
+                               rtol=1e-5)
+
+
+def test_dice_loss_perfect_prediction():
+    label = jnp.asarray([[[0], [1]]])                 # (1, 2, 1)
+    pred = jax.nn.one_hot(label[..., 0], 2)           # exact prediction
+    assert float(F.dice_loss(pred, label)) < 1e-4
+    # uniform prediction is worse
+    uni = jnp.full((1, 2, 2), 0.5)
+    assert float(F.dice_loss(uni, label)) > 0.2
+
+
+def test_npair_and_gaussian_nll():
+    a = jnp.asarray(rs.standard_normal((4, 8)), jnp.float32)
+    p = a + 0.01
+    labels = jnp.asarray([0, 1, 2, 3])
+    l_match = F.npair_loss(a, p, labels, l2_reg=0.0)
+    l_mismatch = F.npair_loss(a, jnp.asarray(
+        rs.standard_normal((4, 8)), jnp.float32), labels, l2_reg=0.0)
+    assert float(l_match) < float(l_mismatch)
+    # L2 term: Beta=0.25 (reference/TF convention)
+    reg = float(F.npair_loss(a, p, labels, l2_reg=0.002)) - float(l_match)
+    expected = 0.25 * 0.002 * float(jnp.mean(jnp.sum(a * a, 1))
+                                    + jnp.mean(jnp.sum(p * p, 1)))
+    np.testing.assert_allclose(reg, expected, rtol=1e-4)
+
+    x = jnp.zeros((5,))
+    mu = jnp.zeros((5,))
+    var = jnp.ones((5,))
+    # exact at mean: 0.5·log(var) = 0; full adds 0.5·log(2π)
+    np.testing.assert_allclose(float(F.gaussian_nll_loss(x, mu, var)), 0.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        float(F.gaussian_nll_loss(x, mu, var, full=True)),
+        0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+
+def test_temporal_shift_moves_channels():
+    nt, c, h, w = 4, 8, 2, 2          # 2 segments × 2 frames
+    x = jnp.asarray(np.arange(nt * c * h * w, dtype=np.float32)
+                    .reshape(nt, c, h, w))
+    out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert out.shape == x.shape
+    xr = np.asarray(x).reshape(2, 2, c, h, w)
+    on = np.asarray(out).reshape(2, 2, c, h, w)
+    # first fold shifts left (t ← t+1), last frame zero-filled
+    np.testing.assert_array_equal(on[:, 0, :2], xr[:, 1, :2])
+    assert (on[:, 1, :2] == 0).all()
+    # second fold shifts right (t ← t-1), first frame zero-filled
+    np.testing.assert_array_equal(on[:, 1, 2:4], xr[:, 0, 2:4])
+    assert (on[:, 0, 2:4] == 0).all()
+    # rest untouched
+    np.testing.assert_array_equal(on[:, :, 4:], xr[:, :, 4:])
+
+
+def test_functional_wrappers_match_layers():
+    x = jnp.asarray(rs.standard_normal((2, 3, 8, 8)), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(F.zeropad2d(x, [1, 1, 2, 0])),
+        np.asarray(nn.ZeroPad2D([1, 1, 2, 0])(x)))
+    np.testing.assert_allclose(
+        np.asarray(F.lp_pool2d(x, 2.0, 2)),
+        np.asarray(nn.LPPool2D(2.0, 2)(x)), rtol=1e-5)
+    # unpool through the functional form (dense indices, see test_longtail)
+    xs = np.asarray(x)[:, :, :4, :4]
+    n, c, h, w = xs.shape
+    r = xs.reshape(n, c, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+    pooled = r.reshape(n, c, 2, 2, 4).max(-1)
+    arg = r.reshape(n, c, 2, 2, 4).argmax(-1)
+    rows = (np.arange(2) * 2)[None, None, :, None] + arg // 2
+    cols = (np.arange(2) * 2)[None, None, None, :] + arg % 2
+    idx = rows * w + cols
+    un = F.max_unpool2d(jnp.asarray(pooled), jnp.asarray(idx), 2)
+    np.testing.assert_array_equal(
+        np.asarray(un),
+        np.asarray(nn.MaxUnPool2D(2, 2)(jnp.asarray(pooled),
+                                        jnp.asarray(idx))))
+    # dropout wrappers: identity when not training
+    np.testing.assert_array_equal(np.asarray(F.dropout2d(x, 0.5, False)),
+                                  np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(F.alpha_dropout(x, 0.5, False)),
+                                  np.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(F.upsample(x, scale_factor=2)).shape, (2, 3, 16, 16))
+    # bilinear functional == layer
+    paddle_tpu.seed(0)
+    lay = nn.Bilinear(4, 5, 6)
+    a = jnp.asarray(rs.standard_normal((3, 4)), jnp.float32)
+    b = jnp.asarray(rs.standard_normal((3, 5)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.bilinear(a, b, lay.weight, lay.bias)),
+        np.asarray(lay(a, b)), rtol=1e-5)
+
+
+def test_affine_grid_identity_and_grid_sample():
+    n, c, h, w = 1, 1, 4, 6
+    x = jnp.asarray(np.arange(h * w, dtype=np.float32).reshape(n, c, h, w))
+    theta = jnp.asarray([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]])
+    grid = F.affine_grid(theta, (n, c, h, w), align_corners=True)
+    assert grid.shape == (n, h, w, 2)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-4)
+    # nearest mode identity too
+    out_n = F.grid_sample(x, grid, mode="nearest", align_corners=True)
+    np.testing.assert_allclose(np.asarray(out_n), np.asarray(x), atol=1e-4)
+    # translation by one pixel (x shift): out[.., j] = x[.., j+1]
+    shift = 2.0 / (w - 1)
+    theta_t = jnp.asarray([[[1.0, 0.0, shift], [0.0, 1.0, 0.0]]])
+    grid_t = F.affine_grid(theta_t, (n, c, h, w), align_corners=True)
+    out_t = F.grid_sample(x, grid_t, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out_t)[..., :-1],
+                               np.asarray(x)[..., 1:], atol=1e-4)
+    # zeros padding beyond the border
+    assert abs(float(out_t[0, 0, 0, -1])) < 6.0  # half-weighted edge → <x.max
+    # border padding clamps instead
+    out_b = F.grid_sample(x, grid_t, padding_mode="border",
+                          align_corners=True)
+    np.testing.assert_allclose(np.asarray(out_b)[..., -1],
+                               np.asarray(x)[..., -1], atol=1e-4)
+
+
+def test_grid_sample_reflection_matches_torch_convention():
+    import torch
+    x_np = rs.standard_normal((2, 3, 5, 7)).astype(np.float32)
+    grid_np = (rs.uniform(-1.6, 1.6, (2, 4, 4, 2))).astype(np.float32)
+    for mode in ("bilinear", "nearest"):
+        for pad in ("zeros", "border", "reflection"):
+            for ac in (True, False):
+                ours = F.grid_sample(jnp.asarray(x_np), jnp.asarray(grid_np),
+                                     mode=mode, padding_mode=pad,
+                                     align_corners=ac)
+                ref = torch.nn.functional.grid_sample(
+                    torch.from_numpy(x_np), torch.from_numpy(grid_np),
+                    mode=mode, padding_mode=pad, align_corners=ac)
+                np.testing.assert_allclose(
+                    np.asarray(ours), ref.numpy(), atol=2e-4,
+                    err_msg=f"{mode}/{pad}/ac={ac}")
+
+
+def test_margin_cross_entropy_reduces_to_ce():
+    cos = jnp.asarray(rs.uniform(-0.9, 0.9, (4, 10)), jnp.float32)
+    label = jnp.asarray([1, 3, 5, 7])
+    plain = F.margin_cross_entropy(cos, label, margin1=1.0, margin2=0.0,
+                                   margin3=0.0, scale=1.0)
+    ce = F.cross_entropy(cos, label)
+    np.testing.assert_allclose(float(plain), float(ce), rtol=1e-4)
+    # margins make the loss strictly harder
+    hard = F.margin_cross_entropy(cos, label)
+    assert float(hard) > float(plain)
+    # cos == ±1.0 endpoints must not produce NaN grads (arccos endpoint)
+    edge = cos.at[0, 1].set(1.0).at[1, 3].set(-1.0)
+    g = jax.grad(lambda c: F.margin_cross_entropy(c, label))(edge)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_adaptive_log_softmax_functional_matches_layer():
+    paddle_tpu.seed(0)
+    layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12])
+    x = jnp.asarray(rs.standard_normal((6, 16)), jnp.float32)
+    y = jnp.asarray([0, 4, 6, 11, 13, 19])
+    out_l, loss_l = layer(x, y)
+    head_w = layer.head_weight
+    tails = [(layer._parameters[f"tail_proj_{i}"].value,
+              layer._parameters[f"tail_out_{i}"].value)
+             for i in range(layer.n_clusters)]
+    out_f, loss_f = F.adaptive_log_softmax_with_loss(
+        x, y, head_w, tails, layer.cutoffs, head_bias=layer.head_bias)
+    # reference convention: functional returns the target LOG-PROB (the
+    # layer here returns the per-sample NLL = −logprob); losses agree
+    np.testing.assert_allclose(np.asarray(out_f), -np.asarray(out_l),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(loss_f), float(loss_l), rtol=1e-4)
+
+
+def test_tensor_linalg_tail():
+    np.testing.assert_allclose(
+        float(T.gammainc(jnp.asarray(2.0), jnp.asarray(1.0)))
+        + float(T.gammaincc(jnp.asarray(2.0), jnp.asarray(1.0))),
+        1.0, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(T.negative(jnp.asarray([1.0, -2.0]))), [-1.0, 2.0])
+    a = rs.standard_normal((4, 4)).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    c = np.linalg.cholesky(a)
+    np.testing.assert_allclose(np.asarray(L.cholesky_inverse(
+        jnp.asarray(c))), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
