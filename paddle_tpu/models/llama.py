@@ -60,6 +60,19 @@ class LlamaConfig:
     # sequence chunks under remat (the full (b, s, vocab) logits tensor
     # never materializes); 1 = plain head+loss
     loss_seq_chunks: int = 1
+    # Mistral-style causal sliding-window attention (None = full causal).
+    # Rides the flash kernel's window_size support in training; the decode
+    # path masks the KV cache to the last `sliding_window` positions.
+    sliding_window: Optional[int] = None
+
+    @classmethod
+    def mistral_7b(cls):
+        # 4096-key window over a 32k context (the published pairing — a
+        # window equal to max positions would never mask anything)
+        return cls(vocab_size=32000, hidden_size=4096,
+                   intermediate_size=14336, num_layers=32, num_heads=32,
+                   num_kv_heads=8, max_position_embeddings=32768,
+                   sliding_window=4096)
 
     @property
     def kv_heads(self):
@@ -147,11 +160,19 @@ class LlamaAttention(nn.Layer):
             q_pos = start_pos + jnp.arange(s)[:, None]          # (s, 1)
             k_pos = jnp.arange(max_len)[None, :]                 # (1, max)
             mask = (k_pos <= q_pos)[None, None]                  # causal+fill
+            if cfg.sliding_window is not None:
+                mask = mask & (k_pos > q_pos - cfg.sliding_window)[None, None]
             out = F.scaled_dot_product_attention(
                 q, k_cache, v_cache, attn_mask=mask, is_causal=False)
             out = self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
             return out, {"k": k_cache, "v": v_cache}
         if cfg.context_parallel:
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "sliding_window is not supported on the "
+                    "context_parallel path (the ring/Ulysses kernels "
+                    "attend the full causal context) — silent full-causal "
+                    "training would mismatch the windowed decode")
             from paddle_tpu.parallel.context_parallel import (
                 context_parallel_attention)
             out = context_parallel_attention(q, k, v, axis="sep",
@@ -163,8 +184,9 @@ class LlamaAttention(nn.Layer):
             k = checkpoint_name(k, "attn_qkv")
             v = checkpoint_name(v, "attn_qkv")
             # always causal; an attn_mask (e.g. padding) composes with it
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                                 is_causal=True)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=True,
+                window_size=cfg.sliding_window)
             out = checkpoint_name(out, "attn_out")
         return self.o_proj(out.reshape(b, s, cfg.num_heads * cfg.head_dim))
 
@@ -437,7 +459,10 @@ class LlamaForCausalLM(CausalLMBase):
         plan from the traced state inside the jitted program."""
         from paddle_tpu.parallel.mp_layers import _active_mesh
         cfg = self.cfg
-        if _active_mesh(mp.MP_AXIS) is not None or cfg.head_dim % 2:
+        if (_active_mesh(mp.MP_AXIS) is not None or cfg.head_dim % 2
+                or cfg.sliding_window is not None):
+            # sliding-window decode masks the cache; the fused kernel
+            # attends the full filled prefix — scan path serves it
             return None
         int8 = "model.layers.0.self_attn.q_proj.weight_q" in state
         if not int8 and "model.layers.0.self_attn.q_proj.weight" not in state:

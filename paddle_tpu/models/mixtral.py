@@ -181,7 +181,9 @@ class MixtralForCausalLM(CausalLMBase):
         cfg = self.cfg
         if (_active_mesh(mp_mod.MP_AXIS) is not None or cfg.head_dim % 2
                 or cfg.num_experts % 8 or cfg.num_shared_experts
-                or cfg.moe_dropless):
+                or cfg.moe_dropless or cfg.sliding_window is not None):
+            # sliding-window decode masks the cache; the fused kernel
+            # attends the full filled prefix — scan path serves it
             return None
         if "model.layers.0.self_attn.q_proj.weight" not in state:
             return None     # non-standard / quantized state

@@ -153,3 +153,60 @@ def test_param_count_7b_config():
     per_layer = 4 * h * h + 3 * h * ffn + 2 * h
     total = v * h * 2 + L * per_layer + h
     assert 6.5e9 < total < 7.5e9
+
+
+def test_sliding_window_training_and_decode():
+    """Mistral-style sliding_window: the training forward masks beyond the
+    window (differs from full causal), and cached greedy decode replays
+    the teacher-forced argmax of the SAME windowed model."""
+    from paddle_tpu.inference import generate
+
+    cfg = LlamaConfig.tiny()
+    cfg.max_position_embeddings = 64
+    paddle_tpu.seed(0)
+    full = LlamaForCausalLM(cfg)
+
+    cfg_w = LlamaConfig.tiny()
+    cfg_w.max_position_embeddings = 64
+    cfg_w.sliding_window = 4
+    paddle_tpu.seed(0)
+    windowed = LlamaForCausalLM(cfg_w)    # same weights (same seed)
+
+    x, _ = _batch(cfg, b=2, s=24)
+    lf = np.asarray(full(x))
+    lw = np.asarray(windowed(x))
+    # positions inside the window agree; later positions differ
+    np.testing.assert_allclose(lw[:, :4], lf[:, :4], rtol=2e-5, atol=2e-6)
+    assert np.abs(lw[:, 12:] - lf[:, 12:]).max() > 1e-3
+
+    windowed.eval()
+    prompt = jnp.asarray(np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (2, 6)))
+    out = generate(windowed, prompt, max_new_tokens=8, temperature=0.0)
+    pred = np.asarray(jnp.argmax(windowed(out), -1))
+    assert (pred[:, 5:-1] == np.asarray(out)[:, 6:]).all()
+    # windowed configs must not ride the fused kernel (full-prefix attention)
+    assert windowed.fused_decode_plan(windowed.trainable_state(),
+                                      probe=True) is None
+
+
+def test_sliding_window_guards():
+    cfg = LlamaConfig.tiny()
+    cfg.sliding_window = 4
+    cfg.context_parallel = "ring"
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg)
+    x, _ = _batch(cfg)
+    with pytest.raises(ValueError, match="sliding_window"):
+        m(x)
+    # windowed Mixtral must not ride the fused MoE kernel either
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    mc = MixtralConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_position_embeddings=64, num_experts=8, top_k=2,
+                       sliding_window=8)
+    mm = MixtralForCausalLM(mc)
+    assert mm.fused_decode_plan(mm.trainable_state(), probe=True) is None
+    # the mistral preset pairs a 4096 window with a LARGER context
+    preset = LlamaConfig.mistral_7b()
+    assert preset.sliding_window < preset.max_position_embeddings
