@@ -142,6 +142,51 @@ def report_engine(layers, seq=2048, batch=8):
         set_hybrid_communicate_group(None)
 
 
+def report_lazy_65b(n_dev=32):
+    """The FULL 80-layer 65B program, compiled (not extrapolated):
+    `LazyGuard` meta-init builds the model without allocating a single
+    parameter buffer (65B fp32 weights would need 260 GB of host RAM),
+    and the pipeline engine scans over per-stage blocks so the HLO does
+    not grow with depth — the exact program a v5p-32 would run, with
+    XLA's own per-device memory accounting."""
+    import paddle_tpu
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import fleet
+    from paddle_tpu.parallel.pipeline import make_pipeline_train_step
+    from paddle_tpu.parallel.strategy import DistributedStrategy
+    from paddle_tpu.parallel.topology import set_hybrid_communicate_group
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 4,
+                        "sharding_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 8
+    s.sharding = True
+    s.sharding_configs.stage = 2
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        paddle_tpu.seed(0)
+        cfg = LlamaConfig.llama_65b()
+        cfg.tie_word_embeddings = False
+        with paddle_tpu.LazyGuard():
+            model = LlamaForCausalLM(cfg).bfloat16()
+        n_params = model.num_params()
+        opt = AdamW(learning_rate=1e-4)
+        step_fn, _ = make_pipeline_train_step(model, opt, strategy=s)
+        ma = step_fn.lower(8, 2048).compile().memory_analysis()
+        print(f"llama-65b FULL {cfg.num_layers}L (LazyGuard meta-init): "
+              f"params={n_params/1e9:.2f}B mesh=mp8·pp4 zero=2 micro=8 "
+              f"seq=2048 batch=8 n_dev={n_dev}")
+        print(f"  per-device: args={ma.argument_size_in_bytes/2**30:.2f} GiB"
+              f"  temp={ma.temp_size_in_bytes/2**30:.2f} GiB  total="
+              f"{(ma.argument_size_in_bytes+ma.temp_size_in_bytes)/2**30:.2f}"
+              " GiB (v5p HBM: 95 GiB)")
+        return ma
+    finally:
+        set_hybrid_communicate_group(None)
+
+
 def main():
     from paddle_tpu.models.llama import LlamaConfig
 
@@ -150,6 +195,10 @@ def main():
         # examples/scale_report.py ernie-l2 / ernie-l4
         layers = int(which.split("-l")[1]) if "-l" in which else 2
         report_engine(layers)
+        return
+    if which == "65b-full":
+        # XLA_FLAGS=--xla_force_host_platform_device_count=32 ... 65b-full
+        report_lazy_65b()
         return
     if which in ("7b", "all"):
         cfg = LlamaConfig.llama2_7b()

@@ -145,6 +145,7 @@ from paddle_tpu.framework.grad import no_grad, grad  # noqa: F401
 from paddle_tpu import jit  # noqa: F401  (module: jit.to_static/save/load)
 
 from paddle_tpu import nn  # noqa: F401
+from paddle_tpu.nn.layer import LazyGuard  # noqa: F401  (paddle.LazyGuard)
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import amp  # noqa: F401
 from paddle_tpu import io  # noqa: F401

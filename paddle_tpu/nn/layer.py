@@ -26,6 +26,28 @@ from paddle_tpu.core.dtype import to_jax_dtype, is_floating
 from paddle_tpu.core import rng as rng_mod
 
 
+_LAZY = [False]
+
+
+class LazyGuard:
+    """Meta-init context (reference paddle.LazyGuard): layers constructed
+    inside allocate NO parameter buffers — every Parameter holds a
+    jax.ShapeDtypeStruct. The resulting model supports shape/pspec queries,
+    `pipeline_parts()`, and the AOT `step_fn.lower()` feasibility path
+    (SCALE.md), but not execution (`init_fn`/forward need real buffers).
+    This is how a 65B model's full training program compiles on a host
+    that cannot hold 65B weights."""
+
+    def __enter__(self):
+        self._prev = _LAZY[0]
+        _LAZY[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY[0] = self._prev
+        return False
+
+
 class Parameter:
     """A named, trainable-flagged slot holding a jax Array."""
 
@@ -114,6 +136,14 @@ class Layer:
         """Create + register-ready Parameter (assign it to an attribute)."""
         from paddle_tpu.nn import initializer as init
         dtype = to_jax_dtype(dtype) if dtype is not None else self._dtype
+        if _LAZY[0]:
+            # LazyGuard (reference paddle.LazyGuard): META init — no
+            # buffer is ever allocated; the Parameter carries only
+            # shape/dtype (+ pspec set later by TP layers). Used to build
+            # pod-scale models (65B) for AOT feasibility compiles on
+            # hosts that can't hold their weights.
+            return Parameter(jax.ShapeDtypeStruct(tuple(shape), dtype),
+                             trainable=trainable)
         if default_initializer is None:
             default_initializer = init.Constant(0.0) if is_bias else init.XavierNormal()
         value = default_initializer(shape, dtype)
@@ -233,7 +263,10 @@ class Layer:
             dt = to_jax_dtype(dtype)
             for _, p in self.named_parameters():
                 if is_floating(p.value.dtype):
-                    p.value = p.value.astype(dt)
+                    if isinstance(p.value, jax.ShapeDtypeStruct):
+                        p.value = jax.ShapeDtypeStruct(p.value.shape, dt)
+                    else:
+                        p.value = p.value.astype(dt)
             for prefix, layer in self.named_sublayers(include_self=True):
                 for name, b in layer._buffers.items():
                     if b is not None and is_floating(b.dtype):

@@ -206,6 +206,10 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
     degree = hcg.get_sharding_parallel_world_size()
 
     state0 = model.trainable_state()
+    # LazyGuard (meta-init) models: shapes only — the AOT lower() path
+    # works, init_fn raises loudly (mirrors the pipeline engine's guard)
+    abstract = any(isinstance(v, jax.ShapeDtypeStruct)
+                   for v in state0.values())
 
     # ---- AMP (strategy.amp, O2): params in low precision, fp32 masters in
     # the optimizer (multi_precision), dynamic loss scaling for fp16 ----
@@ -214,7 +218,9 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
     if strategy.amp and strategy.amp_configs.level.upper() == "O2":
         from paddle_tpu.core.dtype import to_jax_dtype, is_floating
         amp_dt = to_jax_dtype(strategy.amp_configs.dtype)
-        state0 = {k: (v.astype(amp_dt) if is_floating(v.dtype) else v)
+        cast = (lambda v: jax.ShapeDtypeStruct(v.shape, amp_dt)) if abstract \
+            else (lambda v: v.astype(amp_dt))
+        state0 = {k: (cast(v) if is_floating(v.dtype) else v)
                   for k, v in state0.items()}
         if amp_dt == jnp.float16 and strategy.amp_configs.use_dynamic_loss_scaling:
             from paddle_tpu.amp import GradScaler
@@ -336,6 +342,11 @@ def make_train_step(model: Layer, optimizer, loss_fn: Callable,
         return new_state, new_opt, loss
 
     def init_fn():
+        if abstract:
+            raise RuntimeError(
+                "this train step was built from a LazyGuard (meta-init) "
+                "model — it has no parameter buffers to place; only the "
+                "AOT step_fn.lower() feasibility path is available")
         # copy so the jit step's donation can never free the Layer's own
         # param buffers (device_put aliases when placement already matches)
         placed = {k: jax.device_put(jnp.array(v, copy=True), param_sh[k])

@@ -255,8 +255,19 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
         arr = arr.reshape((v_chunks, n_stages, per_chunk) + leaves[0].shape)
         return _jnp.swapaxes(arr, 0, 1)             # (S, v, per_chunk, ...)
 
-    stacked = {k: stack_blocks([st[k] for st in parts.block_states])
-               for k in parts.block_states[0]}
+    # LazyGuard-built models carry ShapeDtypeStructs: stack abstractly
+    # (shapes only). Such a builder serves ONLY the AOT lower() path —
+    # init_fn raises (there are no buffers to place).
+    abstract = any(
+        isinstance(v, jax.ShapeDtypeStruct)
+        for st in parts.block_states for v in st.values())
+    if abstract:
+        stacked = jax.eval_shape(
+            lambda sts: {k: stack_blocks([st[k] for st in sts])
+                         for k in sts[0]}, parts.block_states)
+    else:
+        stacked = {k: stack_blocks([st[k] for st in parts.block_states])
+                   for k in parts.block_states[0]}
     state0 = {}
     state0.update({f"embed.{k}": v for k, v in parts.embed_state.items()})
     state0.update({f"blocks.{k}": v for k, v in stacked.items()})
@@ -593,6 +604,11 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
     jit_step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
 
     def init_fn():
+        if abstract:
+            raise RuntimeError(
+                "this train step was built from a LazyGuard (meta-init) "
+                "model — it has no parameter buffers to place; only the "
+                "AOT step_fn.lower() feasibility path is available")
         # copy so jit donation can never free the Layer's own param buffers
         placed = {k: jax.device_put(_jnp.array(v, copy=True),
                                     NamedSharding(mesh, pspecs[k]))

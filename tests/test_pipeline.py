@@ -263,3 +263,69 @@ def test_unknown_schedule_raises(pp_fleet):
     model = LlamaForCausalLM(cfg)
     with pytest.raises(ValueError, match="schedule_mode"):
         make_pipeline_train_step(model, AdamW(learning_rate=1e-3), strategy=s)
+
+
+def test_lazy_guard_aot_matches_eager():
+    """LazyGuard (meta-init) models: no parameter buffer is allocated,
+    the pipeline AOT lower() path produces byte-identical memory
+    accounting to the eager-built twin, and execution paths fail loudly."""
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs.accumulate_steps = 2
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = LlamaConfig.tiny()
+        cfg.tie_word_embeddings = False
+        paddle_tpu.seed(0)
+        eager = LlamaForCausalLM(cfg)
+        with paddle_tpu.LazyGuard():
+            lazy = LlamaForCausalLM(cfg).bfloat16()
+        assert all(isinstance(p.value, jax.ShapeDtypeStruct)
+                   for _, p in lazy.named_parameters())
+        assert lazy.num_params() == eager.num_params()
+
+        opt = AdamW(learning_rate=1e-3)
+        step_e, _ = make_pipeline_train_step(eager.bfloat16(), opt,
+                                             strategy=s)
+        step_l, init_l = make_pipeline_train_step(lazy, opt, strategy=s)
+        ma_e = step_e.lower(4, 16).compile().memory_analysis()
+        ma_l = step_l.lower(4, 16).compile().memory_analysis()
+        assert ma_l.argument_size_in_bytes == ma_e.argument_size_in_bytes
+        assert ma_l.temp_size_in_bytes == ma_e.temp_size_in_bytes
+        with pytest.raises(RuntimeError, match="LazyGuard"):
+            init_l()
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_lazy_guard_generic_path_lower_and_guard():
+    """The non-pipeline make_train_step also serves LazyGuard models:
+    lower() works (== eager accounting), init_fn raises the explicit
+    meta-init error."""
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                        "sharding_degree": 2}
+    s.sharding = True
+    s.sharding_configs.stage = 2
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        cfg = LlamaConfig.tiny()
+        paddle_tpu.seed(0)
+        eager = LlamaForCausalLM(cfg)
+        with paddle_tpu.LazyGuard():
+            lazy = LlamaForCausalLM(cfg)
+        loss_fn = lambda out, b: eager.loss(out, b["labels"])
+        step_e, _ = fleet.make_train_step(eager, AdamW(learning_rate=1e-3),
+                                          loss_fn, strategy=s)
+        step_l, init_l = fleet.make_train_step(
+            lazy, AdamW(learning_rate=1e-3),
+            lambda out, b: lazy.loss(out, b["labels"]), strategy=s)
+        ma_e = step_e.lower(8, 16).compile().memory_analysis()
+        ma_l = step_l.lower(8, 16).compile().memory_analysis()
+        assert ma_l.argument_size_in_bytes == ma_e.argument_size_in_bytes
+        with pytest.raises(RuntimeError, match="LazyGuard"):
+            init_l()
+    finally:
+        set_hybrid_communicate_group(None)
