@@ -142,13 +142,17 @@ def report_engine(layers, seq=2048, batch=8):
         set_hybrid_communicate_group(None)
 
 
-def report_lazy_65b(n_dev=32):
+def report_lazy_65b(pod128=False):
     """The FULL 80-layer 65B program, compiled (not extrapolated):
     `LazyGuard` meta-init builds the model without allocating a single
     parameter buffer (65B fp32 weights would need 260 GB of host RAM),
     and the pipeline engine scans over per-stage blocks so the HLO does
-    not grow with depth — the exact program a v5p-32 would run, with
-    XLA's own per-device memory accounting."""
+    not grow with depth — XLA's own per-device memory accounting of the
+    exact program.
+
+    pod128=False: mp8·pp4 on 32 devices (the v5p-32 fit point).
+    pod128=True: BASELINE's north-star v5p-128 with EVERY hybrid axis
+    active — dp2 × mp8 × pp4 × sharding2 (ZeRO-2) + Megatron-SP."""
     import paddle_tpu
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
@@ -158,26 +162,37 @@ def report_lazy_65b(n_dev=32):
     from paddle_tpu.parallel.topology import set_hybrid_communicate_group
 
     s = DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 4,
-                        "sharding_degree": 1}
+    if pod128:
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 8, "pp_degree": 4,
+                            "sharding_degree": 2}
+        batch, label = 32, ("v5p-128 north-star mesh "
+                            "(dp2·mp8·pp4·sharding2 + SP, zero-2)")
+    else:
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 4,
+                            "sharding_degree": 1}
+        batch, label = 8, "mesh mp8·pp4 (32 devices)"
     s.pipeline = True
     s.pipeline_configs.accumulate_steps = 8
     s.sharding = True
     s.sharding_configs.stage = 2
+    s.recompute = True
     fleet.init(is_collective=True, strategy=s)
     try:
         paddle_tpu.seed(0)
         cfg = LlamaConfig.llama_65b()
         cfg.tie_word_embeddings = False
+        # Megatron-SP: without it the (mb, s, h) activation stream is
+        # replicated mp× and dominates temp at pod scale
+        cfg.sequence_parallel = pod128
         with paddle_tpu.LazyGuard():
             model = LlamaForCausalLM(cfg).bfloat16()
         n_params = model.num_params()
         opt = AdamW(learning_rate=1e-4)
         step_fn, _ = make_pipeline_train_step(model, opt, strategy=s)
-        ma = step_fn.lower(8, 2048).compile().memory_analysis()
-        print(f"llama-65b FULL {cfg.num_layers}L (LazyGuard meta-init): "
-              f"params={n_params/1e9:.2f}B mesh=mp8·pp4 zero=2 micro=8 "
-              f"seq=2048 batch=8 n_dev={n_dev}")
+        ma = step_fn.lower(batch, 2048).compile().memory_analysis()
+        print(f"llama-65b FULL {cfg.num_layers}L (LazyGuard meta-init, "
+              f"params={n_params/1e9:.2f}B) on {label}, micro=8, "
+              f"seq 2048 × batch {batch}:")
         print(f"  per-device: args={ma.argument_size_in_bytes/2**30:.2f} GiB"
               f"  temp={ma.temp_size_in_bytes/2**30:.2f} GiB  total="
               f"{(ma.argument_size_in_bytes+ma.temp_size_in_bytes)/2**30:.2f}"
@@ -199,6 +214,10 @@ def main():
     if which == "65b-full":
         # XLA_FLAGS=--xla_force_host_platform_device_count=32 ... 65b-full
         report_lazy_65b()
+        return
+    if which == "65b-pod128":
+        # XLA_FLAGS=--xla_force_host_platform_device_count=128 ... 65b-pod128
+        report_lazy_65b(pod128=True)
         return
     if which in ("7b", "all"):
         cfg = LlamaConfig.llama2_7b()

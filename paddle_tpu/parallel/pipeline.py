@@ -272,6 +272,11 @@ def make_pipeline_train_step(model, optimizer, strategy=None, hcg=None,
     state0.update({f"embed.{k}": v for k, v in parts.embed_state.items()})
     state0.update({f"blocks.{k}": v for k, v in stacked.items()})
     state0.update({f"head.{k}": v for k, v in parts.head_state.items()})
+    # re-check over the ASSEMBLED state: embed/head may be abstract even
+    # when blocks were made concrete (partial set_state_dict) — init_fn's
+    # guard must cover any abstract leaf, mirroring fleet.py
+    abstract = abstract or any(
+        isinstance(v, jax.ShapeDtypeStruct) for v in state0.values())
 
     # ---- shardings: pp on the stage dim, TP placements, ZeRO composition ----
     zstage = strategy.sharding_configs.stage if strategy.sharding else 0

@@ -69,26 +69,45 @@ def shard_params_spec(state: Dict[str, jax.Array], stage: int, degree: int,
 def opt_state_specs(param_specs: Dict[str, P], stage: int, degree: int,
                     params: Dict[str, jax.Array],
                     axis_name: str = "sharding") -> Dict[str, P]:
-    """Optimizer-moment specs: stages 1+ shard moments even when params are
-    replicated (that's the whole point of stage 1)."""
+    """Optimizer-moment specs: stages 1+ shard moments over the sharding
+    axis REGARDLESS of existing TP/PP placements (GroupShardedStage2
+    semantics — the reference shards optimizer state across the sharding
+    group on top of whatever tensor parallelism already split; composing
+    the axis onto a remaining dim is what makes 'mp × pp × sharding'
+    multiplicative for state memory)."""
     out = {}
     for k, spec in param_specs.items():
         if stage >= 1 and degree > 1:
-            if any(s is not None for s in spec):
-                out[k] = spec  # follow the param sharding (stage 3)
+            if axis_name in _axes_of(spec):
+                out[k] = spec  # stage-3: param already sharding-sharded
             else:
-                out[k] = param_pspec(params[k].shape, degree, axis_name)
+                out[k] = param_pspec(params[k].shape, degree, axis_name,
+                                     existing=spec)
         else:
             out[k] = spec
     return out
 
 
+def _axes_of(spec: P):
+    axes = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            axes.add(a)
+    return axes
+
+
 def grad_specs(param_specs: Dict[str, P], stage: int, degree: int,
                params: Dict[str, jax.Array],
                axis_name: str = "sharding") -> Dict[str, P]:
+    """Stage-2 grads reduce-scatter over the sharding axis, composed with
+    TP/PP placements like the moments."""
     if stage >= 2 and degree > 1:
-        return {k: (param_specs[k] if any(s is not None for s in param_specs[k])
-                    else param_pspec(params[k].shape, degree, axis_name))
+        return {k: (param_specs[k]
+                    if axis_name in _axes_of(param_specs[k])
+                    else param_pspec(params[k].shape, degree, axis_name,
+                                     existing=param_specs[k]))
                 for k in param_specs}
     return dict(param_specs)
 
