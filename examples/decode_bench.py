@@ -127,10 +127,15 @@ def main():
         # refuse to silently measure the all-experts scan fallback
         # (FLAGS_pallas_strict can't catch this: no kernel failure occurs)
         plan = model.fused_decode_plan(model.trainable_state(), probe=True)
-        if plan is None or ns.batch > plan["max_batch"]:
+        if plan is None:
+            raise SystemExit(
+                "mixtral-1b config is ineligible for the fused MoE decode "
+                "kernel (fused_decode_plan returned None) — it would "
+                "silently measure the all-experts scan fallback")
+        if ns.batch > plan["max_batch"]:
             raise SystemExit(
                 f"mixtral-1b fused decode needs batch <= "
-                f"{plan and plan['max_batch']}; got {ns.batch}")
+                f"{plan['max_batch']}; got {ns.batch}")
     if ns.int8:
         from paddle_tpu.quantization import quantize_model, quantized_state
         quantize_model(model)

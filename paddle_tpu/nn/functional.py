@@ -1003,10 +1003,24 @@ def log_loss(input, label, epsilon=1e-4):
 
 
 def sequence_mask(x, maxlen=None, dtype="int64"):
-    """(..., n) lengths → (..., n, maxlen) 0/1 mask."""
+    """(..., n) lengths → (..., n, maxlen) 0/1 mask.
+
+    With ``maxlen=None`` the mask width is inferred as ``max(x)``, which
+    needs a concrete value — inside jit/grad/scan pass ``maxlen`` explicitly
+    (XLA requires static shapes).
+    """
     from paddle_tpu.core.dtype import to_jax_dtype
     x = jnp.asarray(x)
-    m = int(maxlen) if maxlen is not None else int(jnp.max(x))
+    if maxlen is None:
+        if isinstance(x, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) infers the mask width from "
+                "max(x), which is unavailable under jit/grad/scan tracing "
+                "(the output shape would be data-dependent). Pass an "
+                "explicit static maxlen.")
+        m = int(jnp.max(x))
+    else:
+        m = int(maxlen)
     return (jnp.arange(m) < x[..., None]).astype(to_jax_dtype(dtype))
 
 

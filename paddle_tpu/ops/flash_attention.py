@@ -764,8 +764,11 @@ def _flash_vjp_bwd(flags, is_causal, scale, window, res, g):
                 **kw),
             q, k, v)
         dq, dk, dv = pull(g)
+    # kv_lens/segments are integer primals → float0; alibi is fp32 (a dummy
+    # zeros(1) on non-ALiBi calls) so its cotangent must be a real float
+    # zero — float0 for a float primal breaks under custom_vjp aval checks.
     return (dq, dk, dv, _float0_like(res[5]), _float0_like(res[6]),
-            _float0_like(res[7]), _float0_like(res[8]))
+            _float0_like(res[7]), jnp.zeros(res[8].shape, res[8].dtype))
 
 
 _flash_vjp_entry.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -59,16 +59,31 @@ _VMEM_MIB_FALLBACK = 128
 
 
 def _vmem_mib() -> int:
-    """VMEM capacity of device 0 in MiB (flag override > kind table >
-    v5e fallback)."""
+    """VMEM capacity of device 0 in MiB (flag override > Mosaic probe >
+    kind table > v5e fallback).
+
+    ``FLAGS_vmem_mib = -1`` runs the boot-time scoped-VMEM bisect probe
+    (`ops/vmem_probe.py`, cached per device kind) instead of trusting the
+    table. The probe's trivial kernel allocates 4 MiB less than hardware
+    capacity (124 of 128 MiB on v5e — Mosaic's fixed reservations), so
+    capacity = probed + 4; on v5e that reproduces the table value exactly,
+    and the downstream `_vmem_budget/_vmem_limit` margins (which were
+    calibrated against *real* fused kernels) stay meaningful.
+    """
     from paddle_tpu.core.flags import flag
     override = flag("FLAGS_vmem_mib")
-    if override:
+    if override and int(override) > 0:
         return int(override)
     try:
         kind = jax.devices()[0].device_kind
     except Exception:
         return _VMEM_MIB_FALLBACK
+    if override and int(override) == -1:
+        try:
+            from paddle_tpu.ops.vmem_probe import probe_usable_vmem_mib
+            return probe_usable_vmem_mib(kind) + 4
+        except Exception:
+            pass   # non-TPU platform or probe failure → table
     return _VMEM_MIB_BY_KIND.get(kind, _VMEM_MIB_FALLBACK)
 
 
@@ -745,12 +760,13 @@ def _pick_expert_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
             continue
         fblk = ffn // j
         need = fixed_bytes + 2 * 3 * fblk * h * wbytes + 8 * 2 ** 20
-        if best is None:
-            best = (j, fblk)          # largest valid block as fallback
+        best = (j, fblk)              # smallest valid block so far
         if need <= budget:
             return j, fblk
     if best is None:
         raise ValueError(f"expert ffn {ffn} has no 128-multiple block")
+    # Nothing fit the budget: fall back to the SMALLEST valid block (the
+    # last candidate) — the one least likely to overflow VMEM.
     return best
 
 

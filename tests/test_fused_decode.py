@@ -124,3 +124,18 @@ def test_gpt_fused_reference_matches_unfused():
     set_flags({"FLAGS_fused_decode": True})
     out_fused = generate(g, prompt, max_new_tokens=12, temperature=0.0)
     assert np.asarray(out_ref).tolist() == np.asarray(out_fused).tolist()
+
+
+def test_vmem_mib_flag_dispatch():
+    """FLAGS_vmem_mib: >0 overrides; -1 asks the Mosaic probe (which
+    raises off-TPU, so the kind table wins here on CPU); 0 = table."""
+    from paddle_tpu.ops.fused_decode import _vmem_mib, _VMEM_MIB_FALLBACK
+    try:
+        set_flags({"FLAGS_vmem_mib": 192})
+        assert _vmem_mib() == 192
+        set_flags({"FLAGS_vmem_mib": -1})   # CPU: probe refuses -> table
+        assert _vmem_mib() == _VMEM_MIB_FALLBACK
+        set_flags({"FLAGS_vmem_mib": 0})
+        assert _vmem_mib() == _VMEM_MIB_FALLBACK
+    finally:
+        set_flags({"FLAGS_vmem_mib": 0})
