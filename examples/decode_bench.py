@@ -68,6 +68,14 @@ def build_model(name):
                           num_heads=32, num_kv_heads=4,
                           intermediate_size=5632,
                           max_position_embeddings=2048)
+    elif name == "llama2-7b":
+        # Llama-2-7B, served int8 weight-only via the stacked-weight
+        # engine (inference.stacked): ~6.6 GiB int8 weights + KV cache fit
+        # the 16 GiB v5e with ONE weight image; the fused kernel streams
+        # qkv in column phases (decode_block_plan q_split) because the 7B
+        # attention weights cannot double-buffer whole in VMEM
+        cfg = LlamaConfig.llama2_7b()
+        return cfg, None          # built via StackedLlamaDecoder below
     elif name == "mixtral-1b":
         # the moe_bench shape (0.93 B total / 0.31 B activated): 12L ×
         # 8 experts top-2 — decodes through the fused MoE kernel, which
@@ -89,7 +97,8 @@ def build_model(name):
 
 def kv_bytes_per_token(cfg, dtype_bytes=2):
     head_dim = cfg.hidden_size // cfg.num_heads
-    return 2 * cfg.num_layers * cfg.num_kv_heads * head_dim * dtype_bytes
+    nkv = getattr(cfg, "kv_heads", None) or cfg.num_kv_heads
+    return 2 * cfg.num_layers * nkv * head_dim * dtype_bytes
 
 
 def main():
@@ -119,8 +128,16 @@ def main():
     # a Pallas regression must FAIL the bench, not silently re-ride XLA
     paddle_tpu.set_flags({"FLAGS_pallas_strict": True})
 
+    if name == "llama2-7b" and not ns.int8:
+        print("note: llama2-7b implies --int8 (bf16 weights alone exceed "
+              "a 16 GiB v5e)", file=sys.stderr)
+        ns.int8 = True
+
     paddle_tpu.seed(0)
     cfg, model = build_model(name)
+    if model is None:        # stacked-weight engine (7B-class)
+        from paddle_tpu.inference.stacked import StackedLlamaDecoder
+        model = StackedLlamaDecoder.from_config(cfg, int8=ns.int8)
     n_params = model.num_params()
     if name == "mixtral-1b":
         # the streaming roofline below describes the fused MoE kernel;
@@ -136,7 +153,10 @@ def main():
             raise SystemExit(
                 f"mixtral-1b fused decode needs batch <= "
                 f"{plan['max_batch']}; got {ns.batch}")
-    if ns.int8:
+    stacked = name == "llama2-7b"
+    if stacked:
+        state = None              # the engine owns its (int8) stacks
+    elif ns.int8:
         from paddle_tpu.quantization import quantize_model, quantized_state
         quantize_model(model)
         state = quantized_state(model)
@@ -153,8 +173,12 @@ def main():
     # that depends on the last token, (b) time two decode lengths and use
     # the marginal time per token, cancelling the fixed dispatch cost.
     def timed(n_tokens):
-        out = generate(model, prompt, max_new_tokens=n_tokens,
-                       temperature=0.0, state=state)
+        if stacked:
+            out = model.generate(prompt, max_new_tokens=n_tokens,
+                                 temperature=0.0)
+        else:
+            out = generate(model, prompt, max_new_tokens=n_tokens,
+                           temperature=0.0, state=state)
         return int(out[:, -1].sum())  # sync on dependent value
 
     n_short = max(8, ns.new_tokens // 4)

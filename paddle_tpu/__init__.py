@@ -157,6 +157,7 @@ from paddle_tpu import parallel as distributed  # noqa: F401
 _sys.modules[__name__ + ".distributed"] = distributed
 from paddle_tpu import linalg  # noqa: F401
 from paddle_tpu import fft  # noqa: F401
+from paddle_tpu import signal  # noqa: F401  (paddle.signal stft/istft)
 from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import metric  # noqa: F401

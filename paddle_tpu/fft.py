@@ -79,3 +79,51 @@ def fftshift(x, axes=None):
 
 def ifftshift(x, axes=None):
     return jnp.fft.ifftshift(x, axes=axes)
+
+
+# Hermitian-input N-D transforms (reference paddle.fft.hfft2/hfftn etc.):
+# Hermitian symmetry is along the LAST transform axis; the other axes get
+# plain (i)fft. numpy has no nd variants — composed per the reference's
+# definition, validated against torch.fft in tests.
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _nd_axes(x, s, axes):
+    """fftn-convention resolution: axes default to all dims, or to the
+    LAST len(s) dims when only s is given; mismatched lengths raise."""
+    if axes is None:
+        axes = (tuple(range(x.ndim)) if s is None
+                else tuple(range(x.ndim - len(s), x.ndim)))
+    axes = tuple(axes)
+    if s is not None and len(s) != len(axes):
+        raise ValueError(
+            f"shape {tuple(s)} and axes {axes} must have the same length")
+    return axes
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    x = jnp.asarray(x)
+    axes = _nd_axes(x, s, axes)
+    if s is None:
+        s = [2 * (x.shape[a] - 1) if a == axes[-1] else x.shape[a]
+             for a in axes]
+    for a, n in zip(axes[:-1], s[:-1]):
+        x = jnp.fft.fft(x, n=n, axis=a, norm=norm)
+    return jnp.fft.hfft(x, n=s[-1], axis=axes[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    x = jnp.asarray(x)
+    axes = _nd_axes(x, s, axes)
+    if s is None:
+        s = [x.shape[a] for a in axes]
+    out = jnp.fft.ihfft(x, n=s[-1], axis=axes[-1], norm=norm)
+    for a, n in zip(axes[:-1], s[:-1]):
+        out = jnp.fft.ifft(out, n=n, axis=a, norm=norm)
+    return out

@@ -126,7 +126,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                     num_kv_heads=plan_t["num_kv_heads"], eps=plan_t["eps"],
                     rope_base=plan_t["rope_base"],
                     arch=plan_t.get("arch", "llama"),
-                    top_k=plan_t.get("top_k", 2))
+                    top_k=plan_t.get("top_k", 2),
+                    blocks=plan_t.get("blocks"))
                 nxt = _sample_logits(plan_t["head"](x), ki, temperature,
                                      top_k, top_p)
                 nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)

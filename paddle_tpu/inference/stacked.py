@@ -1,0 +1,319 @@
+"""Stacked-weight Llama inference engine — 7B-class serving on one chip.
+
+Reference: the fused_multi_transformer serving stack
+(paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu +
+fused_multi_transformer_int8, SURVEY.md §2.2 fusion + §2.4 inference) is
+how the reference serves 7B-class checkpoints: one weight image in the
+fused kernel's layout, consumed by both context (prefill) and decode.
+
+The nn.Layer `generate()` path stacks per-layer weights into the fused
+kernel's (L, ...) layout *inside* the jitted program, so both copies are
+live at the stack boundary — fine at 1B, impossible for Llama-2-7B int8
+(2 × 6.6 GiB) on a 16 GiB v5e. This engine owns ONE stacked copy:
+
+* prefill is a `lax.scan` over the layer dim reading the same stacks the
+  decode kernel streams (the standard TPU big-model shape — scan over
+  layers, static shapes, weights dequantized per layer inside the scan);
+* decode rides `ops.fused_decode` with the `decode_block_plan` that also
+  sized the stacks (qkv column split + padded FFN blocks at 7B scale);
+* `from_config` materializes random int8/bf16 weights host-side straight
+  into the stacked layout (benchmarking; never two copies), and
+  `from_state_dict` imports a per-layer checkpoint state layer by layer.
+"""
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.ops import fused_decode as fd
+from paddle_tpu.ops.rope import rope_cos_sin
+
+__all__ = ["StackedLlamaDecoder"]
+
+
+def _dequant(w, s, dtype):
+    return w.astype(dtype) * s.astype(dtype) if s is not None else w
+
+
+class StackedLlamaDecoder:
+    """Inference-only Llama with parameters in the fused kernel's stacked
+    layout. `params` follows `build_fused_params` naming ({ln1, wqkv, wo,
+    ln2, wg, wu, wd} (+ `*_s` int8 scales)); `embed_w` (vocab, h) bf16;
+    `head` either ("tied",), ("dense", w) or ("int8", q, scale)."""
+
+    def __init__(self, cfg, params: Dict[str, jax.Array], embed_w, norm_w,
+                 head, blocks: Optional[Dict] = None):
+        self.cfg = cfg
+        self.params = params
+        self.embed_w = embed_w
+        self.norm_w = norm_w
+        self.head = head
+        int8 = "wqkv_s" in params
+        hd = cfg.head_dim
+        dq = cfg.num_heads * hd
+        self.blocks = blocks or fd.decode_block_plan(
+            cfg.hidden_size, dq + 2 * cfg.kv_heads * hd, dq, hd,
+            cfg.intermediate_size, wbytes=1 if int8 else 2)
+        self._jit_cache = {}
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg, *, int8: bool = True, seed: int = 0,
+                    dtype=jnp.bfloat16):
+        """Random weights, materialized ON DEVICE directly in the stacked
+        layout via jax.random (no host->device transfer — materializing
+        Llama-2-7B through a remote-TPU tunnel host-side takes tens of
+        minutes; on-device it is seconds) and never held twice."""
+        key = jax.random.PRNGKey(seed)
+        L, h, ffn = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        hd = cfg.head_dim
+        dq, dkv = cfg.num_heads * hd, cfg.kv_heads * hd
+        dqkv = dq + 2 * dkv
+        blocks = fd.decode_block_plan(h, dqkv, dq, hd, ffn,
+                                      wbytes=1 if int8 else 2)
+        fp = blocks["ffn_pad"]
+        sd = cfg.initializer_range
+
+        def nxt():
+            nonlocal key
+            key, sub = jax.random.split(key)
+            return sub
+
+        def w(*shape, pad_axis=None, pad_to=0):
+            if int8:
+                a = jax.random.randint(nxt(), shape, -127, 128,
+                                       dtype=jnp.int8)
+            else:
+                a = (jax.random.normal(nxt(), shape, jnp.float32)
+                     * sd).astype(dtype)
+            if pad_axis is not None and pad_to > shape[pad_axis]:
+                widths = [(0, 0)] * a.ndim
+                widths[pad_axis] = (0, pad_to - shape[pad_axis])
+                a = jnp.pad(a, widths)
+            return a
+
+        def sc(n, pad_to=0):
+            a = jnp.full((L, 1, n), sd / 127.0, jnp.float32)
+            if pad_to > n:
+                a = jnp.pad(a, ((0, 0), (0, 0), (0, pad_to - n)),
+                            constant_values=1.0)
+            return a
+
+        params = {
+            "ln1": jnp.ones((L, h), dtype),
+            "ln2": jnp.ones((L, h), dtype),
+            "wqkv": w(L, h, dqkv),
+            "wo": w(L, dq, h),
+            "wg": w(L, h, ffn, pad_axis=2, pad_to=fp),
+            "wu": w(L, h, ffn, pad_axis=2, pad_to=fp),
+            "wd": w(L, ffn, h, pad_axis=1, pad_to=fp),
+        }
+        if int8:
+            params.update(wqkv_s=sc(dqkv), wo_s=sc(h), wg_s=sc(ffn, fp),
+                          wu_s=sc(ffn, fp), wd_s=sc(h))
+        embed_w = (jax.random.normal(nxt(), (cfg.vocab_size, h),
+                                     jnp.float32) * sd).astype(dtype)
+        norm_w = jnp.ones((h,), dtype)
+        if cfg.tie_word_embeddings:
+            head = ("tied",)
+        elif int8:
+            head = ("int8",
+                    jax.random.randint(nxt(), (h, cfg.vocab_size), -127,
+                                       128, dtype=jnp.int8),
+                    jnp.full((cfg.vocab_size,), sd / 127.0, jnp.float32))
+        else:
+            head = ("dense",
+                    (jax.random.normal(nxt(), (h, cfg.vocab_size),
+                                       jnp.float32) * sd).astype(dtype))
+        return cls(cfg, params, embed_w, norm_w, head, blocks)
+
+    @classmethod
+    def from_state_dict(cls, cfg, state: Dict[str, jax.Array]):
+        """Import a per-layer LlamaForCausalLM state dict (bf16 or
+        weight-only-int8 — paddle_tpu.quantization naming)."""
+        int8 = "model.layers.0.self_attn.q_proj.weight_q" in state
+        hd = cfg.head_dim
+        dq = cfg.num_heads * hd
+        blocks = fd.decode_block_plan(
+            cfg.hidden_size, dq + 2 * cfg.kv_heads * hd, dq, hd,
+            cfg.intermediate_size, wbytes=1 if int8 else 2)
+        params = fd.build_fused_params(state, cfg.num_layers,
+                                       ffn_pad=blocks["ffn_pad"])
+        if cfg.tie_word_embeddings:
+            head = ("tied",)
+        elif int8 and "lm_head.weight_q" in state:
+            head = ("int8", state["lm_head.weight_q"],
+                    state["lm_head.weight_scale"])
+        else:
+            head = ("dense", state["lm_head.weight"])
+        return cls(cfg, params, state["model.embed_tokens.weight"],
+                   state["model.norm.weight"], head, blocks)
+
+    # -- forward pieces ----------------------------------------------------
+
+    def _head_logits(self, xn, embed_w=None, head_arrays=None):
+        """head_arrays/embed_w default to self.* for eager use; the jitted
+        generate passes them as traced args (baking the ~400 MB 7B
+        embed+lm_head into the executable as constants would hold a second
+        on-device copy)."""
+        kind = self.head[0]
+        if kind == "tied":
+            ew = self.embed_w if embed_w is None else embed_w
+            return jnp.dot(xn, ew.T)
+        ha = tuple(self.head[1:]) if head_arrays is None else head_arrays
+        if kind == "int8":
+            q, s = ha
+            y = jnp.dot(xn, q.astype(xn.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * s
+        return jnp.dot(xn, ha[0])
+
+    def _final_norm(self, x, norm_w=None):
+        w = self.norm_w if norm_w is None else norm_w
+        return _rms_np(x, w, self.cfg.rms_norm_eps, w.dtype)
+
+    def prefill(self, params, ids, total: int, cache_dtype=jnp.bfloat16,
+                embed_w=None):
+        """Full-prompt forward as a lax.scan over the layer dim. Returns
+        (last-position hidden (b, h) fp32, kv cache (L, b, total, 2*dkv))."""
+        cfg = self.cfg
+        b, s = ids.shape
+        h, hd = cfg.hidden_size, cfg.head_dim
+        nh, nkv = cfg.num_heads, cfg.kv_heads
+        rep = nh // nkv
+        dq, dkv = nh * hd, nkv * hd
+        eps = cfg.rms_norm_eps
+        int8 = "wqkv_s" in params
+        dtype = self.embed_w.dtype
+        scale = 1.0 / math.sqrt(hd)
+        cos, sin = rope_cos_sin(s, hd, base=cfg.rope_base)
+        cos = cos[None, :, None, :].astype(jnp.float32)
+        sin = sin[None, :, None, :].astype(jnp.float32)
+
+        def rope(t):                       # (b, s, n, hd)
+            half = t.shape[-1] // 2
+            rot = jnp.concatenate([-t[..., half:], t[..., :half]], axis=-1)
+            return t * cos + rot * sin
+
+        def mm(act, wl, sl):
+            y = jnp.dot(act, wl.astype(act.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * sl if sl is not None else y
+
+        causal = jnp.tril(jnp.ones((s, s), bool))
+
+        def layer(xf, wl):
+            xn = _rms_np(xf, wl["ln1"], eps, dtype)
+            qkv = mm(xn, wl["wqkv"], wl.get("wqkv_s"))
+            q = rope(qkv[..., :dq].reshape(b, s, nh, hd))
+            k = rope(qkv[..., dq:dq + dkv].reshape(b, s, nkv, hd))
+            v = qkv[..., dq + dkv:].reshape(b, s, nkv, hd)
+            qg = q.reshape(b, s, nkv, rep, hd) * scale
+            sc_ = jnp.einsum("bsgrd,btgd->bgrst", qg, k)
+            sc_ = jnp.where(causal[None, None, None], sc_, fd.NEG_INF)
+            pr = jax.nn.softmax(sc_, axis=-1)
+            at = jnp.einsum("bgrst,btgd->bsgrd", pr, v)
+            o = mm(at.reshape(b, s, dq).astype(dtype), wl["wo"],
+                   wl.get("wo_s"))
+            xf = xf + o
+            xn2 = _rms_np(xf, wl["ln2"], eps, dtype)
+            g = mm(xn2, wl["wg"], wl.get("wg_s"))
+            u = mm(xn2, wl["wu"], wl.get("wu_s"))
+            act = (jax.nn.silu(g) * u).astype(dtype)
+            xf = xf + mm(act, wl["wd"], wl.get("wd_s"))
+            kflat = jnp.concatenate(
+                [k.reshape(b, s, dkv), v.reshape(b, s, dkv)],
+                axis=-1).astype(cache_dtype)
+            return xf, kflat
+
+        x = jnp.take(self.embed_w if embed_w is None else embed_w, ids,
+                     axis=0).astype(jnp.float32)
+        keys = [k for k in ("ln1", "wqkv", "wqkv_s", "wo", "wo_s", "ln2",
+                            "wg", "wg_s", "wu", "wu_s", "wd", "wd_s")
+                if k in params]
+        stacks = {k: params[k] for k in keys}
+        x, kv = lax.scan(lambda c, wl: layer(c, wl), x, stacks)
+        kv = jnp.pad(kv, ((0, 0), (0, 0), (0, total - s), (0, 0)))
+        return x[:, -1], kv
+
+    # -- generation --------------------------------------------------------
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0,
+                 cache_dtype=jnp.bfloat16):
+        """Prefill + fused-kernel decode, the whole loop one jitted scan.
+        Returns (b, prompt+new) ids including the prompt."""
+        from paddle_tpu.inference import _sample_logits
+
+        input_ids = jnp.asarray(input_ids)
+        b, prompt_len = input_ids.shape
+        total = -(-(prompt_len + max_new_tokens) // 128) * 128
+        cfg = self.cfg
+        key0 = jax.random.PRNGKey(seed)
+        jk = (b, prompt_len, max_new_tokens, float(temperature), int(top_k),
+              float(top_p), jnp.dtype(cache_dtype).name)
+        run = self._jit_cache.get(jk)
+        if run is None:
+            cos_tab, sin_tab = rope_cos_sin(total, cfg.head_dim,
+                                            base=cfg.rope_base)
+
+            def run_impl(params, embed_w, norm_w, head_arrays, ids, key):
+                x, kv = self.prefill(params, ids, total, cache_dtype,
+                                     embed_w=embed_w)
+                key, k0 = jax.random.split(key)
+
+                def logits(x):
+                    return self._head_logits(
+                        self._final_norm(x, norm_w), embed_w, head_arrays)
+
+                tok = _sample_logits(logits(x), k0, temperature, top_k,
+                                     top_p)
+
+                def step(carry, i):
+                    tok, kv, key = carry
+                    key, ki = jax.random.split(key)
+                    pos = prompt_len + i - 1
+                    x = jnp.take(embed_w, tok, axis=0)
+                    cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
+                    sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
+                    x, kv = fd.fused_decode_step(
+                        x, params, kv, pos, cos, sin,
+                        num_heads=cfg.num_heads, num_kv_heads=cfg.kv_heads,
+                        eps=cfg.rms_norm_eps, rope_base=cfg.rope_base,
+                        blocks=self.blocks)
+                    nxt = _sample_logits(logits(x), ki, temperature, top_k,
+                                         top_p)
+                    return (nxt, kv, key), nxt
+
+                (tok_last, kv, key), toks = lax.scan(
+                    step, (tok, kv, key), jnp.arange(1, max_new_tokens))
+                return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+            run = jax.jit(run_impl)
+            self._jit_cache[jk] = run
+        new = run(self.params, self.embed_w, self.norm_w,
+                  tuple(self.head[1:]), input_ids, key0)
+        return jnp.concatenate([input_ids, new], axis=1)
+
+    def num_params(self):
+        """True (unpadded) parameter count — roofline accounting."""
+        cfg = self.cfg
+        h, ffn, hd = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+        dq, dkv = cfg.num_heads * hd, cfg.kv_heads * hd
+        per_layer = 2 * h + h * (dq + 2 * dkv) + dq * h + 3 * h * ffn
+        n = cfg.vocab_size * h + cfg.num_layers * per_layer + h
+        if not cfg.tie_word_embeddings:
+            n += h * cfg.vocab_size
+        return n
+
+
+def _rms_np(x, w, eps, dtype):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(dtype) * w

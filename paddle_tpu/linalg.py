@@ -215,3 +215,88 @@ def cholesky_inverse(x, upper=False):
     x = jnp.asarray(x)
     eye = jnp.eye(x.shape[-1], dtype=x.dtype)
     return jax.scipy.linalg.cho_solve((x, not upper), eye)
+
+
+def matrix_transpose(x):
+    """paddle.linalg.matrix_transpose: swap the last two dims."""
+    return jnp.swapaxes(jnp.asarray(x), -2, -1)
+
+
+def ormqr(x, tau, other, left=True, transpose=False):
+    """paddle.linalg.ormqr: multiply `other` by the FULL (m, m) Q of a
+    Householder factorization (x, tau) — accumulated reflectors + matmul
+    (numerically equivalent; TPU has no LAPACK ormqr fast path).
+    Batched over leading dims like the reference."""
+    x = jnp.asarray(x)
+    tau = jnp.asarray(tau)
+    other = jnp.asarray(other)
+
+    def one(xm, tm, om):
+        # apply reflectors H_i = I - tau_i v_i v_i^H directly to `other`
+        # (O(k·m·n), lax.fori_loop — no (m, m) Q materialized, constant
+        # program size). Q = H_0 H_1 ... H_{k-1}; Q @ om applies reflectors
+        # last-first, om @ Q (and Q^H @ om) first-last.
+        m = xm.shape[0]
+        k = tm.shape[0]
+        ar = jnp.arange(m)
+
+        def refl(i):
+            return jnp.where(ar < i, 0.0,
+                             jnp.where(ar == i, 1.0,
+                                       jax.lax.dynamic_index_in_dim(
+                                           xm, i, 1, keepdims=False)))
+
+        qh = transpose          # Q^H x == conj-transposed application
+        if left:
+            def body(step, acc):     # acc (m, n)
+                i = step if qh else k - 1 - step
+                v = refl(i)
+                coef = (jnp.conj(tm[i]) if qh else tm[i])
+                return acc - coef * v[:, None] * (jnp.conj(v) @ acc)[None, :]
+        else:
+            def body(step, acc):     # acc (n, m): om @ Q applies first-last
+                i = k - 1 - step if qh else step
+                v = refl(i)
+                coef = (jnp.conj(tm[i]) if qh else tm[i])
+                return acc - coef * (acc @ v)[:, None] * jnp.conj(v)[None, :]
+        return jax.lax.fori_loop(0, k, body, om)
+
+    if x.ndim == 2:
+        return one(x, tau, other)
+    batch = x.shape[:-2]
+    xf = x.reshape((-1,) + x.shape[-2:])
+    tf = tau.reshape((-1,) + tau.shape[-1:])
+    of = jnp.broadcast_to(other, batch + other.shape[-2:]).reshape(
+        (-1,) + other.shape[-2:])
+    out = jax.vmap(one)(xf, tf, of)
+    return out.reshape(batch + out.shape[-2:])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """paddle.linalg.svd_lowrank: randomized low-rank SVD (Halko et al.
+    range finder with `niter` power iterations)."""
+    from paddle_tpu.core.rng import next_rng_key
+    x = jnp.asarray(x)
+    if M is not None:
+        x = x - jnp.asarray(M)
+    m, n = x.shape[-2:]
+    q = min(q, m, n)
+    g = jax.random.normal(next_rng_key(), x.shape[:-2] + (n, q), x.dtype)
+    y = jnp.matmul(x, g)
+    for _ in range(niter):
+        y = jnp.matmul(x, jnp.matmul(jnp.swapaxes(x, -2, -1), y))
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.matmul(jnp.swapaxes(qmat, -2, -1), x)
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return jnp.matmul(qmat, u), s, jnp.swapaxes(vh, -2, -1)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """paddle.linalg.pca_lowrank: PCA via randomized SVD."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2:]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    return svd_lowrank(x, q=q, niter=niter)

@@ -467,16 +467,22 @@ class LlamaForCausalLM(CausalLMBase):
         int8 = "model.layers.0.self_attn.q_proj.weight_q" in state
         if not int8 and "model.layers.0.self_attn.q_proj.weight" not in state:
             return None     # non-standard state
+        from paddle_tpu.ops import fused_decode as fd
+        hd = cfg.head_dim
+        dq = cfg.num_heads * hd
+        blocks = fd.decode_block_plan(
+            cfg.hidden_size, dq + 2 * cfg.kv_heads * hd, dq, hd,
+            cfg.intermediate_size, wbytes=1 if int8 else 2)
         meta = {
             "num_heads": cfg.num_heads, "num_kv_heads": cfg.kv_heads,
             "head_dim": cfg.head_dim, "eps": cfg.rms_norm_eps,
-            "rope_base": cfg.rope_base,
+            "rope_base": cfg.rope_base, "blocks": blocks,
         }
         if probe:
             return meta
-        from paddle_tpu.ops import fused_decode as fd
         from paddle_tpu.ops.rms_norm import rms_norm
-        params = fd.build_fused_params(state, cfg.num_layers)
+        params = fd.build_fused_params(state, cfg.num_layers,
+                                       ffn_pad=blocks["ffn_pad"])
         embed_w = state["model.embed_tokens.weight"]
         norm_w = state["model.norm.weight"]
 

@@ -58,6 +58,22 @@ class ZeroPad2D(Pad2D):
                          data_format=data_format)
 
 
+class ZeroPad1D(Pad1D):
+    """Reference paddle.nn.ZeroPad1D."""
+
+    def __init__(self, padding, data_format="NCL"):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class ZeroPad3D(Pad3D):
+    """Reference paddle.nn.ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW"):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
 # ---- pixel / channel rearrangement -----------------------------------------
 
 class PixelShuffle(Layer):
@@ -163,6 +179,9 @@ class AlphaDropout(Layer):
         super().__init__()
         self.p = p
 
+    def _mask_shape(self, x):
+        return x.shape
+
     def forward(self, x):
         if not self.training or self.p == 0.0:
             return x
@@ -170,11 +189,20 @@ class AlphaDropout(Layer):
         from paddle_tpu.core import rng as _rng
         keep = 1.0 - self.p
         mask = jax.random.bernoulli(_rng.next_rng_key("alpha_dropout"), keep,
-                                    x.shape)
+                                    self._mask_shape(x))
         a = (keep + self.p * self._alpha_p ** 2 * keep) ** -0.5
         b = -a * self._alpha_p * self.p
         y = jnp.where(mask, x, jnp.asarray(self._alpha_p, x.dtype))
         return (a * y + b).astype(x.dtype)
+
+
+class FeatureAlphaDropout(AlphaDropout):
+    """Alpha dropout over whole channel maps (reference
+    paddle.nn.FeatureAlphaDropout): the SELU-preserving affine is applied
+    with one mask element per (sample, channel), channels-first."""
+
+    def _mask_shape(self, x):
+        return x.shape[:2] + (1,) * (x.ndim - 2)
 
 
 # ---- distance ---------------------------------------------------------------

@@ -179,3 +179,17 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss (reference paddle.nn.RNNTLoss / warprnnt)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)

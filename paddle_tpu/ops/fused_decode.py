@@ -101,11 +101,115 @@ def _vmem_limit_bytes() -> int:
 
 
 # ---------------------------------------------------------------------------
+# Block planning (qkv column split + FFN column blocks)
+# ---------------------------------------------------------------------------
+
+# Bytes-equivalent cost of one extra grid step (~2 µs of per-step scalar
+# overhead at v5e HBM bandwidth) — lets the planner trade zero-padding a
+# non-128-multiple ffn (e.g. 11008 → 11264) against running many tiny
+# blocks (fblk=256 would take 43 grid steps/layer on Llama-2-7B).
+_GRID_STEP_BYTES = 3 * 2 ** 19
+
+
+def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
+                      wbytes: int, q_split: Optional[int] = None) -> Dict:
+    """Joint plan for the fused decode kernel's weight streaming.
+
+    At 7B scale (h=4096) the attention weights alone (wqkv 50 MiB + wo
+    17 MiB int8) cannot double-buffer in v5e's 128 MiB VMEM, so the qkv
+    projection is split into `q_split` head-aligned COLUMN phases — each
+    grid step streams one (h, qblk) block, mirroring how the FFN has
+    always streamed in column blocks. FFN blocks are chosen from
+    128-lane multiples (zero-padding ffn up to J*fblk when ffn isn't a
+    128-multiple — SwiGLU pad columns contribute silu(0)*0 = 0 exactly),
+    minimizing streamed bytes + grid-step overhead.
+
+    Returns {"q_split", "qblk", "ffn_blocks", "fblk", "ffn_pad"} where
+    ffn_pad >= ffn is the padded column count build_fused_params must
+    produce. `q_split` forces the split (tests).
+    """
+    budget = _vmem_budget_bytes()
+    half = max((budget - 8 * 2 ** 20) // 2, 2 ** 20)
+    nheads_tot = dqkv // hd
+
+    def ffn_pick(fmax):
+        # candidates: 128-multiples up to fmax (padding allowed) plus, for
+        # non-128-multiple ffns, the exact divisors (no padding)
+        if ffn <= 128:
+            return (1, ffn, ffn) if ffn <= fmax else None
+        cands = list(range(128, min(ffn + 127, fmax) + 1, 128))
+        if not cands:
+            # no lane-aligned block fits: exact divisors as a last resort
+            cands = [f for f in range(1, min(ffn, fmax) + 1)
+                     if ffn % f == 0]
+        best = None
+        for f in cands:
+            jn = -(-ffn // f)
+            cost = 3 * jn * f * h * wbytes + jn * _GRID_STEP_BYTES
+            if best is None or cost < best[0] or (cost == best[0]
+                                                  and f > best[2]):
+                best = (cost, jn, f)
+        return (best[1], best[2], best[1] * best[2]) if best else None
+
+    best = None
+    qs_list = ([q_split] if q_split else
+               [q for q in range(1, nheads_tot + 1) if nheads_tot % q == 0])
+    for qs in qs_list:
+        qblk = dqkv // qs
+        if qblk % hd:
+            continue
+        if qs > 1 and qblk % 128 and not q_split:
+            continue                     # lane-aligned splits only
+        fixed = (qblk + dq) * h * wbytes
+        pick = ffn_pick((half - fixed) // (3 * h * wbytes))
+        if pick is None:
+            continue
+        jn, fblk, pad = pick
+        cost = (3 * pad * h * wbytes + jn * _GRID_STEP_BYTES
+                + qs * _GRID_STEP_BYTES)
+        if best is None or cost < best[0]:
+            best = (cost, qs, qblk, jn, fblk, pad)
+    if best is None:
+        if q_split:
+            raise ValueError(
+                f"decode_block_plan: forced q_split={q_split} is invalid "
+                f"for dqkv={dqkv}, hd={hd} under the current VMEM budget")
+        # nothing fits the budget even maximally split: stream the finest
+        # head-aligned qkv blocks + 128-col FFN blocks and let Mosaic cope
+        qs = nheads_tot
+        jn = -(-ffn // 128) if ffn > 128 else 1
+        fblk = 128 if ffn > 128 else ffn
+        best = (0, qs, hd, jn, fblk, jn * fblk)
+    _, qs, qblk, jn, fblk, pad = best
+    return {"q_split": qs, "qblk": qblk, "ffn_blocks": jn, "fblk": fblk,
+            "ffn_pad": pad}
+
+
+def _pad_ffn(stacks: Dict[str, jax.Array], ffn_pad: int):
+    """Zero-pad the FFN stacks' ffn dim up to ffn_pad (scales pad with 1;
+    quantized pad weights are 0 so the scale value is inert)."""
+    ffn = stacks["wg"].shape[2]
+    if ffn_pad <= ffn:
+        return stacks
+    p = ffn_pad - ffn
+    out = dict(stacks)
+    for k in ("wg", "wu"):
+        out[k] = jnp.pad(stacks[k], ((0, 0), (0, 0), (0, p)))
+    out["wd"] = jnp.pad(stacks["wd"], ((0, 0), (0, p), (0, 0)))
+    for k in ("wg_s", "wu_s"):
+        if k in stacks:
+            out[k] = jnp.pad(stacks[k], ((0, 0), (0, 0), (0, p)),
+                             constant_values=1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Stacked parameter pytree
 # ---------------------------------------------------------------------------
 
 def build_fused_params(state: Dict[str, jax.Array], num_layers: int,
-                       prefix: str = "model.layers.") -> Dict[str, jax.Array]:
+                       prefix: str = "model.layers.",
+                       ffn_pad: int = 0) -> Dict[str, jax.Array]:
     """Stack a Llama-style flat state dict into per-layer-stacked arrays.
 
     Returns {ln1 (L,h), wqkv (L,h,(nh+2nkv)*hd), wo (L,nh*hd,h), ln2 (L,h),
@@ -151,6 +255,8 @@ def build_fused_params(state: Dict[str, jax.Array], num_layers: int,
     if int8:
         for k, v in scales.items():
             out[f"{k}_s"] = jnp.stack(v).astype(jnp.float32)[:, None, :]
+    if ffn_pad:
+        out = _pad_ffn(out, ffn_pad)
     return out
 
 
@@ -371,7 +477,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                          num_heads: int, num_kv_heads: int, head_dim: int,
                          rope_base: float = 10000.0,
                          eps: float = 1e-5, chunk: int = 0,
-                         arch: str = "llama"):
+                         arch: str = "llama", blocks: Optional[Dict] = None):
     # NOTE: not jit-wrapped — always invoked inside the caller's jit (the
     # generate() scan); a nested jit around a pallas_call trips XLA's
     # closed_call lowering cache.
@@ -398,14 +504,33 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     h = x.shape[1]
     dq = nh * hd
     dqkv = dq + 2 * dkv
-    ffn = params["wg"].shape[2]
+    ffn = params["wg"].shape[2]          # ffn_pad when a plan padded it
     int8 = "wqkv_s" in params
     gpt = arch == "gpt"
     wbytes = 1 if int8 else 2
-    J, fblk = _pick_ffn_blocks(
-        ffn, h, fixed_bytes=(dqkv + nh * hd) * h * wbytes, wbytes=wbytes)
+    if blocks is not None:
+        Qs, qblk = blocks["q_split"], blocks["qblk"]
+        J, fblk = blocks["ffn_blocks"], blocks["fblk"]
+        assert ffn == J * fblk, (ffn, blocks)
+        assert not (gpt and Qs > 1), "qkv split unsupported for arch=gpt"
+    else:
+        Qs, qblk = 1, dqkv
+        J, fblk = _pick_ffn_blocks(
+            ffn, h, fixed_bytes=(dqkv + nh * hd) * h * wbytes, wbytes=wbytes)
     if not chunk:
         chunk = 128
+        if blocks is not None:
+            # shrink the double-buffered KV chunks until weights + scratch
+            # fit the scoped-VMEM ceiling (7B at b=8 needs ck=64)
+            w2 = 2 * (qblk + dq + 3 * fblk) * h * wbytes
+            scratch_fixed = (b * 8 * 2 * dkv * 2 + b * 2 * dkv * 4
+                             + b * nh * hd * 4 + b * h * 10)
+            for cand in (128, 64, 32, 16, 8):
+                if S % cand == 0 and (w2 + scratch_fixed + 6 * 2 ** 20
+                                      + 2 * b * cand * 2 * dkv * 2
+                                      <= _vmem_limit_bytes()):
+                    chunk = cand
+                    break
     ck = min(chunk, S)
     assert S % ck == 0, f"cache len {S} not a multiple of chunk {ck}"
     assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
@@ -450,32 +575,42 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
         j = pl.program_id(1)
         pos = pos_ref[0]
 
-        @pl.when(j == 0)
-        def attention_phase():
-            @pl.when(li == 0)
-            def _():
-                x_s[...] = x_in_ref[...].astype(jnp.float32)
-
-            # cache-append RMW block reads: layer 0 issues its own; for
-            # later layers the previous layer's FFN j==1 step prefetched
-            # them (plus chunk 0) so attention starts with data in flight
+        def qkv_phase(p):
+            # Phase p streams wqkv's column block p and stages its
+            # head-aligned slices; the LAST phase also runs attention.
+            # (Qs == 1 reproduces the original single attention phase.)
             blk = (pos // 8) * 8
             off = pos - blk
-            rkb = pltpu.make_async_copy(
-                kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s, wsem.at[0])
 
-            @pl.when(li == 0)
-            def _():
-                rkb.start()
+            def chunk_copy(c, slot):
+                return pltpu.make_async_copy(
+                    kv_ref.at[li, :, pl.ds(c * ck, ck)],
+                    kvch_s.at[slot], rsem.at[slot])
+
+            nc = (blk + ck - 1) // ck          # chunks covering [0, blk)
+            if p == 0:
+                # cache-append RMW block reads: layer 0 issues its own
+                # (plus chunk 0); for later layers the previous layer's
+                # first FFN step prefetched them
+                @pl.when(li == 0)
+                def _():
+                    x_s[...] = x_in_ref[...].astype(jnp.float32)
+                    pltpu.make_async_copy(
+                        kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s,
+                        wsem.at[0]).start()
+
+                @pl.when((li == 0) & (nc > 0))
+                def _():
+                    chunk_copy(0, 0).start()
 
             if gpt:
                 xn = _layernorm(x_s[...], ln1_ref[...].reshape(h),
                                 ln1b_ref[...].reshape(h), eps)
             else:
                 xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
-            qkv = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
+            part = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
             if gpt:
-                qkv = qkv + bqkv_ref[...]
+                part = part + bqkv_ref[...]
                 rope2 = lambda t: t
             else:
                 # rope angles computed in-kernel from pos (NeoX convention:
@@ -489,24 +624,29 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 rope2 = lambda t: (t * cos_b + jnp.concatenate(
                     [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
             # heads via lane slices (no lane reshapes): q into a 3D f32
-            # scratch; new k/v staged FLAT (b, dkv) f32 for the RMW merge
-            for g in range(nh):
-                q_s[:, g, :] = rope2(qkv[:, g * hd:(g + 1) * hd])
-            for g in range(nkv):
-                kv32_s[:, g * hd:(g + 1) * hd] = rope2(
-                    qkv[:, dq + g * hd:dq + (g + 1) * hd])
-                kv32_s[:, dkv + g * hd:dkv + (g + 1) * hd] = \
-                    qkv[:, dq + dkv + g * hd:dq + dkv + (g + 1) * hd]
+            # scratch; new k/v staged FLAT (b, 2*dkv) f32 for the RMW
+            # merge. A column block may straddle the q|k|v boundaries —
+            # qblk % hd == 0 keeps every slice head-aligned.
+            for t in range(qblk // hd):
+                col = p * qblk + t * hd
+                seg = part[:, t * hd:(t + 1) * hd]
+                if col < dq:
+                    q_s[:, col // hd, :] = rope2(seg)
+                elif col < dq + dkv:
+                    kv32_s[:, col - dq:col - dq + hd] = rope2(seg)
+                else:
+                    kv32_s[:, col - dq:col - dq + hd] = seg
+            if p == Qs - 1:
+                attention_tail(blk, off, chunk_copy, nc)
 
+        def attention_tail(blk, off, chunk_copy, nc):
             # ---- online softmax, three stages sharing one set of
             # carries: (a) double-buffered chunk loop over the prefix
             # [0, blk) from HBM; (b) the freshly merged 8-token block
             # [blk, pos] straight from VMEM; stage (b) also hides the RMW
             # write-back behind the o-proj.
-            def chunk_copy(c, slot):
-                return pltpu.make_async_copy(
-                    kv_ref.at[li, :, pl.ds(c * ck, ck)],
-                    kvch_s.at[slot], rsem.at[slot])
+            rkb = pltpu.make_async_copy(
+                kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s, wsem.at[0])
 
             def merge(carry, kmat, vmat, idx, limit, width):
                 """One online-softmax block update. kmat/vmat readers
@@ -531,12 +671,6 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                     ls2.append(ls[g] * alpha + jnp.sum(pp, axis=-1))
                     accs2.append(acc)
                 return ms2, ls2, accs2
-
-            nc = (blk + ck - 1) // ck          # chunks covering [0, blk)
-
-            @pl.when((li == 0) & (nc > 0))
-            def _():
-                chunk_copy(0, 0).start()
 
             def body(c, carry):
                 slot = lax.rem(c, 2)
@@ -609,9 +743,12 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                                  eps).astype(dtype)
             acc_s[...] = jnp.zeros_like(acc_s)
 
-        @pl.when(j > 0)
+        for p in range(Qs):
+            pl.when(j == p)(functools.partial(qkv_phase, p))
+
+        @pl.when(j >= Qs)
         def ffn_phase():
-            @pl.when(j == 1)
+            @pl.when(j == Qs)
             def prefetch_next_layer():
                 # drain this layer's cache write-back, then issue the next
                 # layer's RMW-block + chunk-0 reads so its attention phase
@@ -644,24 +781,30 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             acc_s[...] += wdot(act, wd_ref, sd_ref if int8 else None)
 
             if gpt:
-                @pl.when(j == J)
+                @pl.when(j == Qs + J - 1)
                 def _():
                     acc_s[...] += jnp.broadcast_to(bd_ref[...], acc_s.shape)
 
-            @pl.when(j == J)
+            @pl.when(j == Qs + J - 1)
             def _():
                 x = x_s[...] + acc_s[...]
                 x_s[...] = x
                 x_out_ref[...] = x.astype(dtype)
 
+    def qi(jj):
+        # qkv column block: phase j < Qs streams block j; FFN phases keep
+        # the last block resident (no refetch)
+        return jnp.minimum(jj, Qs - 1)
+
     def jm(ll, jj):
-        # j==0 reuses whatever the previous grid step held (layer l-1's
-        # last FFN block) so the attention phase issues no FFN-weight
-        # fetch; j>=1 streams block j-1 of layer l.
-        return lax.select(jj == 0,
-                          lax.max(ll - 1, 0) * 0 + (J - 1) if J > 1 else 0,
-                          jj - 1)
-    grid = (L, 1 + J)
+        # attention phases (j < Qs) reuse whatever the previous grid step
+        # held (layer l-1's last FFN block) so they issue no FFN-weight
+        # fetch; j >= Qs streams block j-Qs of layer l.
+        return jnp.where(jj < Qs, J - 1, jj - Qs)
+
+    def fl(ll, jj):
+        return lax.max(ll - (jj < Qs), 0)
+    grid = (L, Qs + J)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -669,38 +812,34 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pl.BlockSpec(memory_space=pltpu.SMEM),                 # pos
             pl.BlockSpec((b, h), lambda l, j: (0, 0)),             # x
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln1
-            pl.BlockSpec((None, h, dqkv), lambda l, j: (l, 0, 0)),  # wqkv
+            pl.BlockSpec((None, h, qblk),
+                         lambda l, j: (l, 0, qi(j))),               # wqkv
             pl.BlockSpec((None, dq, h), lambda l, j: (l, 0, 0)),   # wo
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln2
             pl.BlockSpec((None, h, fblk),
-                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
-                                       jm(l, j))),                  # wg
+                         lambda l, j: (fl(l, j), 0, jm(l, j))),     # wg
         ] + ([] if gpt else [
             pl.BlockSpec((None, h, fblk),
-                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
-                                       jm(l, j))),                  # wu
+                         lambda l, j: (fl(l, j), 0, jm(l, j))),     # wu
         ]) + [
             pl.BlockSpec((None, fblk, h),
-                         lambda l, j: (lax.max(l - (j == 0), 0),
-                                       jm(l, j), 0)),               # wd
+                         lambda l, j: (fl(l, j), jm(l, j), 0)),     # wd
         ] + ([
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln1_b
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln2_b
             pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # bqkv
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bo
             pl.BlockSpec((None, 1, fblk),
-                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
-                                       jm(l, j))),                  # bg
+                         lambda l, j: (fl(l, j), 0, jm(l, j))),     # bg
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bd
         ] if gpt else []) + ([
-            pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # sqkv
+            pl.BlockSpec((None, 1, qblk),
+                         lambda l, j: (l, 0, qi(j))),               # sqkv
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # so
             pl.BlockSpec((None, 1, fblk),
-                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
-                                       jm(l, j))),                  # sg
+                         lambda l, j: (fl(l, j), 0, jm(l, j))),     # sg
             pl.BlockSpec((None, 1, fblk),
-                         lambda l, j: (lax.max(l - (j == 0), 0), 0,
-                                       jm(l, j))),                  # su
+                         lambda l, j: (fl(l, j), 0, jm(l, j))),     # su
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # sd
         ] if int8 else []) + [
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
@@ -1133,12 +1272,13 @@ _fallback_logged = False
 def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                       num_heads: int, num_kv_heads: int, eps: float = 1e-5,
                       rope_base: float = 10000.0, arch: str = "llama",
-                      top_k: int = 2):
+                      top_k: int = 2, blocks: Optional[Dict] = None):
     """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
 
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
     be traced (it is the scan counter inside `inference.generate`).
-    `top_k` applies to arch="moe" only.
+    `top_k` applies to arch="moe" only. `blocks` is a `decode_block_plan`
+    dict (the plan that padded the params must also drive the kernel).
     """
     from paddle_tpu.ops import use_pallas
     dkv = kv_cache.shape[-1] // 2
@@ -1154,7 +1294,7 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                 x, params, kv_cache, pos,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,
                 head_dim=dkv // num_kv_heads,
-                rope_base=rope_base, eps=eps, arch=arch)
+                rope_base=rope_base, eps=eps, arch=arch, blocks=blocks)
         except Exception as e:  # pragma: no cover - hardware-dependent
             from paddle_tpu.core.flags import flag
             if flag("FLAGS_pallas_strict"):

@@ -1,0 +1,100 @@
+"""paddle.signal parity: stft / istft.
+
+Reference: python/paddle/signal.py (SURVEY.md §2.7 tensor-API family).
+TPU-native: frame + window + rfft/fft compose into XLA ops; istft is the
+standard overlap-add with window-envelope normalization (COLA). Validated
+against torch.stft/istft in tests/test_signal.py.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    """(..., n) -> (..., frame_length, n_frames) (the reference layout)."""
+    n = x.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+    return jnp.take(x, idx, axis=-1)          # (..., frame_length, n_frames)
+
+
+def stft(x, n_fft, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True):
+    """paddle.signal.stft: returns (..., n_fft//2+1 | n_fft, n_frames)
+    complex. Real input + onesided=True rides rfft."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), x.dtype)
+    window = jnp.asarray(window)
+    if win_length < n_fft:                 # center-pad the window to n_fft
+        lp = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lp, n_fft - win_length - lp))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frame(x, n_fft, hop_length)          # (..., n_fft, n_frames)
+    frames = frames * window[:, None]
+    if jnp.iscomplexobj(frames) or not onesided:
+        spec = jnp.fft.fft(frames, axis=-2)
+        if onesided:
+            spec = spec[..., : n_fft // 2 + 1, :]
+    else:
+        spec = jnp.fft.rfft(frames, axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return spec
+
+
+def istft(x, n_fft, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False):
+    """paddle.signal.istft: inverse of stft by windowed overlap-add."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lp, n_fft - win_length - lp))
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided and not return_complex:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)   # (..., n_fft, T)
+    else:
+        frames = jnp.fft.ifft(x, n=n_fft, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window[:, None]
+    n_frames = frames.shape[-1]
+    out_len = n_fft + hop_length * (n_frames - 1)
+    lead = frames.shape[:-2]
+    out = jnp.zeros(lead + (out_len,), frames.dtype)
+    env = jnp.zeros((out_len,), jnp.float32)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])               # (T, n_fft)
+    out = out.at[..., idx.ravel()].add(
+        jnp.moveaxis(frames, -1, -2).reshape(lead + (-1,)))
+    env = env.at[idx.ravel()].add(
+        jnp.tile(jnp.square(window.astype(jnp.float32)), (n_frames,)))
+    out = out / jnp.where(env > 1e-11, env, 1.0)
+    if center:
+        out = out[..., n_fft // 2:]
+        if length is None:           # no target length: trim the tail half
+            out = out[..., : out.shape[-1] - n_fft // 2]
+    if length is not None:
+        out = (jnp.pad(out, [(0, 0)] * (out.ndim - 1)
+                       + [(0, max(0, length - out.shape[-1]))])
+               [..., :length])
+    return out

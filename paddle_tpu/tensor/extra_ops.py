@@ -28,6 +28,10 @@ __all__ = [
     "exp2", "float_power", "true_divide", "bitwise_invert", "gammaln",
     "gammainc", "erfc", "xlogy", "aminmax", "broadcast_shapes", "crop",
     "strided_slice",
+    # round-5 tail (VERDICT r4 #2)
+    "complex", "is_tensor", "is_empty", "t", "slice", "add_n",
+    "histogram_bin_edges", "finfo", "iinfo", "binomial", "standard_gamma",
+    "log_normal", "randint_like",
     "angle", "assign", "clone", "rank", "increment", "scale", "softsign",
     "logspace", "histc", "unstack", "view", "view_as", "swapdims",
     "shard_index", "reduce_as", "multigammaln", "lu_solve",
@@ -355,14 +359,14 @@ def crop(x, shape, offsets=None):
     """Static crop (reference paddle.crop): take `shape` starting at
     `offsets` (zeros when omitted)."""
     offsets = offsets or [0] * len(shape)
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    idx = tuple(_py_slice(o, o + s) for o, s in zip(offsets, shape))
     return jnp.asarray(x)[idx]
 
 
 def strided_slice(x, axes, starts, ends, strides):
-    idx = [slice(None)] * jnp.asarray(x).ndim
+    idx = [_py_slice(None)] * jnp.asarray(x).ndim
     for a, s, e, st in zip(axes, starts, ends, strides):
-        idx[a] = slice(s, e, st)
+        idx[a] = _py_slice(s, e, st)
     return jnp.asarray(x)[tuple(idx)]
 
 
@@ -536,3 +540,125 @@ def gammaincc(x, y):
 
 def negative(x):
     return jnp.negative(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# round-5 breadth tail (VERDICT r4 #2): remaining public tensor-namespace
+# APIs — reference python/paddle/tensor/{creation,random,attribute,math}.py
+# ---------------------------------------------------------------------------
+
+def complex(real, imag):
+    """paddle.complex: real + 1j*imag (broadcasting; ints promote to
+    float32 like the reference)."""
+    real = jnp.asarray(real)
+    if not jnp.issubdtype(real.dtype, jnp.floating):
+        real = real.astype(jnp.float32)
+    imag = jnp.asarray(imag).astype(real.dtype)
+    return jax.lax.complex(*jnp.broadcast_arrays(real, imag))
+
+
+def is_tensor(x):
+    """paddle.is_tensor."""
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def is_empty(x):
+    """paddle.is_empty: whether the tensor holds zero elements."""
+    return jnp.asarray(jnp.asarray(x).size == 0)
+
+
+def t(x):
+    """paddle.t: 0/1-D unchanged; 2-D transposed; >2-D is an error."""
+    x = jnp.asarray(x)
+    if x.ndim > 2:
+        raise ValueError(
+            f"paddle.t expects a tensor with rank <= 2, got {x.ndim}")
+    return x.T if x.ndim == 2 else x
+
+
+_py_slice = slice      # the builtin; shadowed by the reference API below
+
+
+def slice(input, axes, starts, ends):   # noqa: A001 - reference name
+    """paddle.slice: slice `input` along `axes` from starts to ends
+    (negative indices wrap; ends clamp to the dim)."""
+    x = jnp.asarray(input)
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        d = x.shape[ax]
+        s = min(max(int(s) + d if int(s) < 0 else int(s), 0), d)
+        e = min(max(int(e) + d if int(e) < 0 else int(e), 0), d)
+        idx[ax] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+def add_n(inputs):
+    """paddle.add_n: elementwise sum of a list of tensors."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    out = jnp.asarray(inputs[0])
+    for v in inputs[1:]:
+        out = out + jnp.asarray(v)
+    return out
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0):  # noqa: A002
+    """paddle.histogram_bin_edges: uniform bin edges over [min, max]
+    (both 0 -> the data range, like paddle.histogram)."""
+    x = jnp.asarray(input).astype(jnp.float32)
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+        degenerate = lo == hi
+        lo = jnp.where(degenerate, lo - 0.5, lo)
+        hi = jnp.where(degenerate, hi + 0.5, hi)
+    else:
+        lo = jnp.asarray(min, jnp.float32)
+        hi = jnp.asarray(max, jnp.float32)
+    return lo + (hi - lo) * jnp.arange(bins + 1, dtype=jnp.float32) / bins
+
+
+def finfo(dtype):
+    """paddle.finfo (floating-point type limits; ml_dtypes-aware)."""
+    from paddle_tpu.core.dtype import to_jax_dtype
+    return jnp.finfo(to_jax_dtype(dtype))
+
+
+def iinfo(dtype):
+    """paddle.iinfo (integer type limits)."""
+    from paddle_tpu.core.dtype import to_jax_dtype
+    return jnp.iinfo(to_jax_dtype(dtype))
+
+
+def binomial(count, prob):
+    """paddle.binomial: per-element Binomial(count, prob) samples
+    (int64, like the reference)."""
+    count = jnp.asarray(count)
+    prob = jnp.asarray(prob, jnp.float32)
+    out = jax.random.binomial(_next_key(), count.astype(jnp.float32), prob)
+    return out.astype(jnp.int_)    # int64 when x64 is enabled, else int32
+
+
+def standard_gamma(x):
+    """paddle.standard_gamma: elementwise Gamma(alpha=x, scale=1)."""
+    x = jnp.asarray(x)
+    return jax.random.gamma(_next_key(), x.astype(jnp.float32)).astype(
+        x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None):
+    """paddle.log_normal: exp(Normal(mean, std)) samples of `shape`
+    (mean/std are the parameters of the UNDERLYING normal)."""
+    from paddle_tpu.core.dtype import get_default_dtype
+    shape = (1,) if shape is None else tuple(shape)
+    z = jax.random.normal(_next_key(), shape, dtype=jnp.float32)
+    return jnp.exp(mean + std * z).astype(get_default_dtype())
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    """paddle.randint_like: uniform ints in [low, high) shaped like x."""
+    from paddle_tpu.core.dtype import to_jax_dtype
+    x = jnp.asarray(x)
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(_next_key(), x.shape, int(low), int(high))
+    return out.astype(to_jax_dtype(dtype) if dtype else x.dtype)

@@ -431,14 +431,18 @@ def test_flash_pallas_uneven_seq_matches_xla():
 
 
 def test_counted_api_surface_floors():
-    """Regression floors for the counted public surface (round 4: 367
-    UNIQUE tensor-family functions — tensor ∪ linalg ∪ fft, re-exports
-    counted once — 137 nn.Layer subclasses, and 110 nn.functional
-    functions; SURVEY.md §2.7 estimates ~400 / ~200 for the reference)."""
+    """Regression floors for the counted public surface (round 5: 391
+    UNIQUE tensor-family functions — tensor ∪ linalg ∪ fft ∪ signal,
+    re-exports counted once; paddle.signal's stft/istft are part of the
+    upstream tensor-API family SURVEY.md §2.7 counts toward ~400 — 141
+    nn.Layer subclasses, and 111 nn.functional functions. The residue vs
+    upstream is enumerated in STATUS.md EXCLUSIONS (in-place `_` variants
+    on immutable jax Arrays, CUDA-only handles)."""
     import inspect
 
     import paddle_tpu.fft as fft_mod
     import paddle_tpu.linalg as linalg_mod
+    import paddle_tpu.signal as signal_mod
     import paddle_tpu.tensor as tensor_mod
     from paddle_tpu import nn as nn_mod
     from paddle_tpu.nn import functional as f_mod
@@ -448,11 +452,12 @@ def test_counted_api_surface_floors():
                 and callable(getattr(mod, n))
                 and not inspect.isclass(getattr(mod, n))}
 
-    total = len(fns(tensor_mod) | fns(linalg_mod) | fns(fft_mod))
-    assert total >= 367, total
+    total = len(fns(tensor_mod) | fns(linalg_mod) | fns(fft_mod)
+                | fns(signal_mod))
+    assert total >= 390, total
     layers = [n for n in dir(nn_mod)
               if not n.startswith("_")
               and inspect.isclass(getattr(nn_mod, n))
               and issubclass(getattr(nn_mod, n), nn_mod.Layer)]
-    assert len(layers) >= 135, len(layers)
-    assert len(fns(f_mod)) >= 110, len(fns(f_mod))
+    assert len(layers) >= 141, len(layers)
+    assert len(fns(f_mod)) >= 111, len(fns(f_mod))
