@@ -90,6 +90,22 @@ def build_model(name):
         m = MixtralForCausalLM(cfg).bfloat16()
         m.eval()
         return cfg, m
+    elif name == "deepseek-16b-d4":
+        # DeepSeekMoE-16B cross-section (BASELINE #4's first-named MoE):
+        # the full 28-layer width — 64 fine-grained experts top-6 + 2
+        # shared experts, vocab 102400 — depth-reduced to 4 layers so the
+        # layered-prefill + stacked-decode weight pair fits a 16 GiB v5e.
+        # The fused kernel streams the 2 shared experts as dense SwiGLU
+        # blocks and exactly 6 routed experts per token.
+        import dataclasses
+        from paddle_tpu.models.mixtral import (MixtralConfig,
+                                               MixtralForCausalLM)
+        cfg = dataclasses.replace(MixtralConfig.deepseek_moe_16b(),
+                                  num_layers=4,
+                                  max_position_embeddings=2048)
+        m = MixtralForCausalLM(cfg).bfloat16()
+        m.eval()
+        return cfg, m
     else:
         raise SystemExit(f"unknown model {name}")
     return cfg, LlamaForCausalLM(cfg).bfloat16()
@@ -121,7 +137,7 @@ def main():
     on_tpu = dev.platform == "tpu"
     name = ns.model or ("llama-345m" if on_tpu else "llama-tiny")
     if ns.batch is None:
-        ns.batch = 1 if name == "mixtral-1b" else 8
+        ns.batch = 1 if name in ("mixtral-1b", "deepseek-16b-d4") else 8
     if not on_tpu:
         ns.batch, ns.prompt_len, ns.new_tokens = 2, 8, 16
 
@@ -139,19 +155,20 @@ def main():
         from paddle_tpu.inference.stacked import StackedLlamaDecoder
         model = StackedLlamaDecoder.from_config(cfg, int8=ns.int8)
     n_params = model.num_params()
-    if name == "mixtral-1b":
+    moe = name in ("mixtral-1b", "deepseek-16b-d4")
+    if moe:
         # the streaming roofline below describes the fused MoE kernel;
         # refuse to silently measure the all-experts scan fallback
         # (FLAGS_pallas_strict can't catch this: no kernel failure occurs)
         plan = model.fused_decode_plan(model.trainable_state(), probe=True)
         if plan is None:
             raise SystemExit(
-                "mixtral-1b config is ineligible for the fused MoE decode "
+                f"{name} config is ineligible for the fused MoE decode "
                 "kernel (fused_decode_plan returned None) — it would "
                 "silently measure the all-experts scan fallback")
         if ns.batch > plan["max_batch"]:
             raise SystemExit(
-                f"mixtral-1b fused decode needs batch <= "
+                f"{name} fused decode needs batch <= "
                 f"{plan['max_batch']}; got {ns.batch}")
     stacked = name == "llama2-7b"
     if stacked:
@@ -231,7 +248,9 @@ def main():
     # roofline's weight bytes count exactly what the kernel must read.
     avg_len = ns.prompt_len + ns.new_tokens / 2
     embed_params = cfg.vocab_size * cfg.hidden_size
-    if name == "mixtral-1b":
+    if moe:
+        # routed stacks stream only min(b·k, E) experts/layer; DENSE params
+        # (attention, router, shared experts, embed/head) stream whole
         expert_params = 3 * cfg.hidden_size * cfg.intermediate_size
         dense_params = n_params - cfg.num_layers * cfg.num_experts * expert_params
         streamed = (dense_params + cfg.num_layers * min(

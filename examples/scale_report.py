@@ -30,10 +30,14 @@ if "--xla_disable_hlo_passes" not in os.environ["XLA_FLAGS"]:
 import jax  # noqa: E402
 
 # pin BEFORE any backend query (a device query would freeze the default
-# backend and the pin would silently no-op — same trap as __graft_entry__)
-jax.config.update("jax_platforms", "cpu")
+# backend and the pin would silently no-op — same trap as __graft_entry__).
+# The AOT reports run on the CPU simulator; `ernie-titan-step` EXECUTES
+# real steps and must keep the real TPU backend.
+if "ernie-titan-step" not in sys.argv[1:2]:
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 
 def report(name, cfg, mesh_dims, n_micro, seq, batch, zero_stage=2,
@@ -202,10 +206,57 @@ def report_lazy_65b(pod128=False):
         set_hybrid_communicate_group(None)
 
 
+def execute_titan_step(steps=6, seq=256, batch=2):
+    """EXECUTE real Engine.fit steps at the full ERNIE-3.0-Titan WIDTH
+    (hidden 12288, heads 96, ffn 49152 — the widest slice one 16 GiB chip
+    holds: 1 shared + 1 task layer, SGD because AdamW moments alone exceed
+    the chip at this width) and report the device-clock step time. The
+    executed counterpart of the mp4·ZeRO-2 AOT rows (report_engine) and
+    of tests/test_auto_parallel.py's executed loss-parity twin."""
+    import shutil
+
+    import paddle_tpu
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.parallel import auto_parallel as auto
+
+    paddle_tpu.seed(0)
+    cfg = ErnieConfig.ernie3_titan()
+    cfg.num_hidden_layers = 1
+    cfg.num_task_layers = 1
+    cfg.max_position_embeddings = max(seq, 512)
+    cfg.hidden_dropout_prob = 0.0
+    model = ErnieForPretraining(cfg).bfloat16()
+    n_params = model.num_params()
+    eng = auto.Engine(model, loss=model.loss,
+                      optimizer=SGD(learning_rate=1e-4))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    batch_d = {"input": jnp.asarray(ids[:, :-1]),
+               "labels": jnp.asarray(ids[:, 1:])}
+    hist = eng.fit([batch_d] * 2, epochs=1, log_interval=1)  # compile+run
+    d = "/tmp/titan_step_trace"
+    shutil.rmtree(d, ignore_errors=True)
+    with jax.profiler.trace(d):
+        hist = eng.fit([batch_d] * steps, epochs=1, log_interval=1)
+    from paddle_tpu.profiler import xplane
+    dev_s = xplane.device_total_seconds(d, "jit_")
+    per_step_ms = 1e3 * dev_s / steps if dev_s else None
+    print(f"ernie-titan-width-1+1L EXECUTED on "
+          f"{jax.devices()[0].device_kind}: params={n_params/1e9:.2f}B "
+          f"seq={seq} batch={batch} steps={steps}")
+    print(f"  losses={[round(h['loss'], 3) for h in hist]}")
+    print(f"  device-clock step: {per_step_ms:.1f} ms"
+          if per_step_ms else "  (no xplane device time)")
+
+
 def main():
     from paddle_tpu.models.llama import LlamaConfig
 
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which == "ernie-titan-step":
+        execute_titan_step()
+        return
     if which.startswith("ernie"):
         # examples/scale_report.py ernie-l2 / ernie-l4
         layers = int(which.split("-l")[1]) if "-l" in which else 2

@@ -81,13 +81,22 @@ def main():
         from paddle_tpu.optimizer import AdamW
         opt = AdamW(learning_rate=1e-4, multi_precision=False)
         opt_state = opt.init_state(state)
+        # DDPM epsilon-prediction objective: the model denoises x_t =
+        # sqrt(abar)·x0 + sqrt(1-abar)·noise and regresses the noise
+        noise = jnp.asarray(rng.standard_normal(x0.shape), jnp.bfloat16)
+        abar = jnp.asarray(rng.uniform(0.2, 0.98, (ns.batch, 1, 1, 1)),
+                           jnp.float32)
+        xt = (jnp.sqrt(abar) * x0.astype(jnp.float32)
+              + jnp.sqrt(1 - abar) * noise.astype(jnp.float32)).astype(
+            jnp.bfloat16)
 
         def one(carry, _):
             st, ost = carry
 
             def loss_fn(s):
-                eps = functional_call(model, s, x0, t, ctx)
-                return jnp.mean(jnp.square(eps.astype(jnp.float32)))
+                eps = functional_call(model, s, xt, t, ctx)
+                return jnp.mean(jnp.square(
+                    eps.astype(jnp.float32) - noise.astype(jnp.float32)))
 
             loss, grads = jax.value_and_grad(loss_fn)(st)
             st, ost = opt.update(grads, ost, st)

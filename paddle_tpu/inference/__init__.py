@@ -73,7 +73,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             if flag("FLAGS_fused_decode")
             and hasattr(model, "fused_decode_plan") else None)
     if plan is not None and b > plan.get("max_batch", b):
-        plan = None     # e.g. MoE no-drop bound b·top_k ≤ capacity
+        plan = None     # e.g. MoE no-drop bound b ≤ per-expert capacity
     if plan is not None:
         total = -(-total // 128) * 128
     cache = model.init_cache(b, total, dtype=cache_dtype)

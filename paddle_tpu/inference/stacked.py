@@ -35,10 +35,6 @@ from paddle_tpu.ops.rope import rope_cos_sin
 __all__ = ["StackedLlamaDecoder"]
 
 
-def _dequant(w, s, dtype):
-    return w.astype(dtype) * s.astype(dtype) if s is not None else w
-
-
 class StackedLlamaDecoder:
     """Inference-only Llama with parameters in the fused kernel's stacked
     layout. `params` follows `build_fused_params` naming ({ln1, wqkv, wo,

@@ -173,14 +173,19 @@ class MixtralForCausalLM(CausalLMBase):
         """Fused MoE decode plan (ops.fused_decode arch="moe" — the
         reference's fused MoE inference analog: fused_multi_transformer +
         global_scatter). Eligibility: no active TP mesh, even head_dim,
-        E % 8 == 0, no shared experts, standard dispatch; `max_batch`
-        bounds b so b·top_k ≤ routing capacity (no token ever dropped —
-        the kernel streams exactly top_k experts per row)."""
+        E % 8 == 0 (gate-weight sublane alignment), standard dispatch.
+        DeepSeekMoE shared experts ride the kernel as a dense SwiGLU
+        streamed like the llama FFN (the model already concatenates them
+        into one shared_mlp). `max_batch` bounds b so the per-expert load
+        never exceeds routing capacity: a token's top-k experts are
+        DISTINCT, so the worst case is all b tokens picking the same
+        expert — load b, not b·top_k (this admits deepseek_moe_16b's
+        k=6 and doubles the mixtral bound)."""
         from paddle_tpu.parallel.mp_layers import _active_mesh
         from paddle_tpu.parallel import mp_layers as mp_mod
         cfg = self.cfg
         if (_active_mesh(mp_mod.MP_AXIS) is not None or cfg.head_dim % 2
-                or cfg.num_experts % 8 or cfg.num_shared_experts
+                or cfg.num_experts % 8
                 or cfg.moe_dropless or cfg.sliding_window is not None):
             # sliding-window decode masks the cache; the fused kernel
             # attends the full filled prefix — scan path serves it
@@ -190,7 +195,7 @@ class MixtralForCausalLM(CausalLMBase):
         gate = self.model.layers[0].moe.gate
         max_batch = 0
         for b in range(1, 65):
-            if b * gate.top_k <= gate.capacity(b):
+            if b <= gate.capacity(b):
                 max_batch = b
             else:
                 break

@@ -20,11 +20,17 @@ the (s, s) matrix is never materialized) covers, on TPU:
   skipping on both ends) and ALiBi (`alibi_slopes` — per-head linear
   bias applied inside the online softmax),
 * odd head dims / short cross-KV via zero-padding (`_pad_for_kernel`),
+* ARBITRARY DENSE MASKS (`attn_mask` (b|1, h|1, sq, sk), bool or
+  additive float) — streamed as (blk_q, blk_k) tiles with all-masked
+  prefix/suffix block skipping (`_mask_block_bounds`),
+* IN-KERNEL ATTENTION DROPOUT — counter-based PRNG keyed on
+  (seed, b, h, q-block, k-block) so the backward kernels regenerate the
+  exact forward mask (`_dropout_keep`; the vendored flash-attn-2 does
+  dropout in-kernel the same way),
 
-forward and backward. Documented exclusions that ride the XLA einsum path:
-attention dropout and arbitrary dense masks (every structured form above
-is in the kernels). Kernels compute internally in (b, h, s, d) so the
-trailing block dims meet TPU tiling (8, 128).
+forward and backward — the kernel-surface exclusion list is now EMPTY.
+Kernels compute internally in (b, h, s, d) so the trailing block dims
+meet TPU tiling (8, 128).
 """
 
 import functools
@@ -200,18 +206,28 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                 f"{alibi_slopes.shape}")
     # Pallas path: TPU, seq dims multiples of 128 and long enough to beat
     # XLA. Shapes the kernel can't take directly may still ride it via
-    # _pad_for_kernel (odd head dims, short cross-KV). Documented
-    # exclusions routed to XLA by design: attention dropout (modern LLM
-    # pretraining runs attn dropout 0) and arbitrary dense masks (the
-    # structured forms — causal/kv_lens/segments — are in the kernels).
-    if use_pallas() and dropout_p == 0.0 and attn_mask is None:
+    # _pad_for_kernel (odd head dims, short cross-KV). Round 5 closed the
+    # last two kernel-surface gaps: ARBITRARY DENSE MASKS ((b|1, h|1, sq,
+    # sk) bool or additive float, streamed as tiles with all-masked-block
+    # skipping) and IN-KERNEL ATTENTION DROPOUT (counter-based PRNG keyed
+    # on (seed, b, h, q-block, k-block), identical fwd/bwd masks).
+    eff_dropout = float(dropout_p) if training else 0.0
+    kmask = _kernel_mask(attn_mask, q.shape, k.shape)
+    if use_pallas() and (attn_mask is None or kmask is not None):
         padded = _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k)
         if padded is not None:
             qp, kp, vp, scale_p, klp, skp, hd = padded
+            if kmask is not None and kp.shape[1] != kmask.shape[3]:
+                pad_v = False if kmask.dtype == jnp.int8 else 0.0
+                kmask = jnp.pad(
+                    kmask, ((0, 0), (0, 0), (0, 0),
+                            (0, kp.shape[1] - kmask.shape[3])),
+                    constant_values=pad_v)   # pad cols masked via kv_lens
             try:
                 out = _flash_call(qp, kp, vp, is_causal, scale_p, klp,
                                   seg_q, skp, window=window_size,
-                                  alibi_slopes=alibi_slopes)
+                                  alibi_slopes=alibi_slopes, mask=kmask,
+                                  dropout_p=eff_dropout)
                 return out if out.shape[-1] == hd else out[..., :hd]
             except Exception as e:
                 from paddle_tpu.core.flags import flag
@@ -223,6 +239,33 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                           training=training, kv_lens=kv_lens,
                           seg_q=seg_q, seg_k=seg_k, window=window_size,
                           alibi_slopes=alibi_slopes)
+
+
+def _kernel_mask(attn_mask, q_shape, k_shape):
+    """Canonicalize a dense attn_mask for the kernels: 4-D with
+    broadcastable batch/head dims and exact (sq, sk) trailing dims.
+    bool masks become int8 (Mosaic has no bool operands); additive float
+    masks pass through. Returns None when the shape can't ride."""
+    if attn_mask is None:
+        return None
+    m = jnp.asarray(attn_mask)
+    if m.ndim == 2:
+        m = m[None, None]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.ndim != 4:
+        return None
+    b, sq, h = q_shape[0], q_shape[1], q_shape[2]
+    sk = k_shape[1]
+    if m.shape[2:] != (sq, sk):
+        return None
+    if m.shape[0] not in (1, b) or m.shape[1] not in (1, h):
+        return None
+    if m.dtype == jnp.bool_:
+        return m.astype(jnp.int8)
+    if jnp.issubdtype(m.dtype, jnp.floating):
+        return m.astype(jnp.float32)
+    return None
 
 
 def _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k):
@@ -277,14 +320,17 @@ def _causal_nk(qi, blk_q, blk_k, off, sk):
 
 
 def _block_mask(s_blk, qi, ki, blk_q, blk_k, off, is_causal,
-                kvlen_b, segq_blk, segk_ref, window=None, alibi=None):
+                kvlen_b, segq_blk, segk_ref, window=None, alibi=None,
+                mask_at=None):
     """Apply the structured masks to one (blk_q, blk_k) score block.
 
     kvlen_b: scalar valid length or None; segq_blk: (blk_q, 1) ids or
     None; segk_ref: callable ki -> (1, blk_k) ids; window: static int
     sliding-window width (causal: q row i sees the last `window` keys up
     to i + off); alibi: this head's ALiBi slope (traced fp32 scalar) —
-    score += slope · (k_pos − q_pos − off), the standard ≤ 0 linear bias."""
+    score += slope · (k_pos − q_pos − off), the standard ≤ 0 linear bias;
+    mask_at: callable ki -> (blk_q, blk_k) DENSE mask tile — bool (False
+    = masked) or additive float (the reference attn_mask semantics)."""
     k_pos = ki * blk_k + lax.broadcasted_iota(
         jnp.int32, (blk_q, blk_k), 1)
     if is_causal or window is not None or alibi is not None:
@@ -300,7 +346,52 @@ def _block_mask(s_blk, qi, ki, blk_q, blk_k, off, is_causal,
         s_blk = jnp.where(k_pos < kvlen_b, s_blk, NEG_INF)
     if segq_blk is not None:
         s_blk = jnp.where(segq_blk == segk_ref(ki), s_blk, NEG_INF)
+    if mask_at is not None:
+        mb = mask_at(ki)
+        if mb.dtype in (jnp.bool_, jnp.int8):   # bool masks ride as int8
+            s_blk = jnp.where(mb != 0, s_blk, NEG_INF)
+        else:
+            s_blk = s_blk + mb.astype(jnp.float32)
     return s_blk
+
+
+def _dropout_keep(pltpu, seed_ref, block_id, blk_q, blk_k, keep_p):
+    """Counter-based in-kernel dropout mask for one (qi, ki) score block
+    (the vendored flash-attn-2 does dropout in-kernel the same way —
+    canonical phi/kernels/gpu/flash_attn_kernel.cu). Reseeding the Mosaic
+    PRNG on (seed, block_id) — block_id folds (b, h, q-block, k-block)
+    into one int32, Mosaic's prng_seed takes at most two values — makes
+    the mask a pure function of the block coordinates, so the dq (loops
+    ki per qi) and dk/dv (loops qi per ki) backward kernels regenerate
+    the exact forward mask regardless of their iteration order."""
+    pltpu.prng_seed(seed_ref[0], block_id)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((blk_q, blk_k)),
+                         jnp.uint32)
+    return bits < jnp.uint32(min(int(keep_p * 4294967296.0), 4294967295))
+
+
+def _drop_block_id(bi, hi, qi, ki, h, nq, nk):
+    return ((bi * h + hi) * nq + qi) * nk + ki
+
+
+def _mask_block_bounds(mask, b, h, nq, nk, blk_q, blk_k, axis_q=True):
+    """Per-(b, h, row-block) [lo, hi) k-block bounds (or per-k-block q
+    bounds when axis_q=False) for all-masked-block SKIPPING: prefix and
+    suffix blocks with no unmasked entry are never touched. Returns two
+    (b, h, n) int32 arrays (broadcast dims expanded)."""
+    valid = (mask != 0) if mask.dtype in (jnp.bool_, jnp.int8) \
+        else (mask > -1e9)
+    mb, mh = valid.shape[0], valid.shape[1]
+    blocks = valid.reshape(mb, mh, nq, blk_q, nk, blk_k).any(axis=(3, 5))
+    if not axis_q:
+        blocks = jnp.swapaxes(blocks, 2, 3)       # (mb, mh, nk, nq)
+    n = blocks.shape[3]
+    has = blocks.any(-1)
+    lo = jnp.where(has, jnp.argmax(blocks, -1), 0).astype(jnp.int32)
+    hi = jnp.where(has, n - jnp.argmax(blocks[..., ::-1], -1),
+                   0).astype(jnp.int32)
+    tgt = (b, h, blocks.shape[2])
+    return (jnp.broadcast_to(lo, tgt), jnp.broadcast_to(hi, tgt))
 
 
 def _window_k0(qi, blk_q, blk_k, off, window):
@@ -326,9 +417,9 @@ def _seg_specs():
 
 
 def _build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, extra,
-                    alibi_slopes=None):
-    """Shared operand assembly:
-    [q, k, v, (lens), (segq, segk), (alibi)] + extra."""
+                    alibi_slopes=None, mask=None, bounds=None, seed=None):
+    """Shared operand assembly: [q, k, v, (lens), (segq, segk), (alibi),
+    (mask, lo, hi), (seed)] + extra."""
     ops = [qt, kt, vt]
     if kv_lens is not None:
         ops.append(kv_lens.astype(jnp.int32))
@@ -337,12 +428,45 @@ def _build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, extra,
         ops.append(seg_k.astype(jnp.int32)[:, None])   # (b, 1, sk)
     if alibi_slopes is not None:
         ops.append(alibi_slopes.astype(jnp.float32))   # (h,)
+    if mask is not None:
+        ops.append(mask)                               # (mb, mh, sq, sk)
+        ops.extend(bounds)                             # lo, hi (b, h, n)
+    if seed is not None:
+        ops.append(seed)                               # (1,) int32
     return ops + extra
 
 
+def _mask_specs(pl, pltpu, mask, blk_row, full_col, row_axis_q=True):
+    """BlockSpecs for [mask-tile, lo, hi]: the mask streams one
+    (blk_q, sk) row band (or (sq, blk_k) column band for the dkv kernel)
+    per grid step, broadcast dims pinned by index-map clamping; the lo/hi
+    skip bounds ride SMEM whole."""
+    mb, mh = mask.shape[0], mask.shape[1]
+
+    def imap(bi, hi, i):
+        bm = jnp.minimum(bi, mb - 1)
+        hm = jnp.minimum(hi, mh - 1)
+        return (bm, hm, i, 0) if row_axis_q else (bm, hm, 0, i)
+
+    shape = ((None, None, blk_row, full_col) if row_axis_q
+             else (None, None, full_col, blk_row))
+    return [pl.BlockSpec(shape, imap),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM)]
+
+
 def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
-                 seg_k=None, window=None, alibi_slopes=None):
-    """qt (b,h,sq,d), kt/vt (b,h,sk,d) → (out (b,h,sq,d), lse (b,h,sq))."""
+                 seg_k=None, window=None, alibi_slopes=None, mask=None,
+                 dropout_p=0.0, seed=None):
+    """qt (b,h,sq,d), kt/vt (b,h,sk,d) → (out (b,h,sq,d), lse (b,h,sq)).
+
+    mask: dense (mb, mh, sq, sk) bool/float attn_mask (broadcast dims
+    allowed) streamed as (blk_q, sk) row bands, with all-masked prefix/
+    suffix k-blocks skipped. dropout_p/seed: in-kernel counter-based
+    attention dropout (see _dropout_keep) — probabilities drop AFTER the
+    softmax statistics accumulate, matching standard dropout(softmax(s))
+    semantics; the output folds the 1/keep rescale into the final
+    normalization."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -355,6 +479,11 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
     has_len = kv_lens is not None
     has_seg = seg_q is not None
     has_alibi = alibi_slopes is not None
+    has_mask = mask is not None
+    has_drop = dropout_p > 0.0
+    keep_p = 1.0 - dropout_p
+    bounds = (_mask_block_bounds(mask, b, h, sq // blk_q, sk // blk_k,
+                                 blk_q, blk_k) if has_mask else None)
 
     def kernel(*refs):
         i = 3
@@ -365,18 +494,27 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         i += 2 * has_seg
         slopes_ref = refs[i] if has_alibi else None
         i += has_alibi
+        mask_ref = refs[i] if has_mask else None
+        mlo_ref = refs[i + 1] if has_mask else None
+        mhi_ref = refs[i + 2] if has_mask else None
+        i += 3 * has_mask
+        seed_ref = refs[i] if has_drop else None
+        i += has_drop
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         o_ref, lse_ref = refs[i], refs[i + 1]
 
         bi = pl.program_id(0)
+        hi_ = pl.program_id(1)
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32) * sc  # (blk_q, d)
         kvlen_b = lens_ref[bi] if has_len else None
-        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
+        alibi = slopes_ref[hi_] if has_alibi else None
         segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
                     if has_seg else None)          # (blk_q, 1)
         seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
             if has_seg else None
+        mask_at = (lambda ki: mask_ref[:, pl.ds(ki * blk_k, blk_k)]) \
+            if has_mask else None
 
         def body(ki, carry):
             acc, m_prev, l_prev = carry
@@ -385,7 +523,8 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             s_blk = qv @ kv.T  # (blk_q, blk_k)
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
                                 is_causal, kvlen_b, segq_blk, seg_at,
-                                window=window, alibi=alibi)
+                                window=window, alibi=alibi,
+                                mask_at=mask_at)
             m_cur = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
             alpha = jnp.exp(m_prev - m_cur)
             # rows with no valid entry yet keep m at NEG_INF — their p
@@ -393,6 +532,12 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             p = jnp.where(m_cur[:, None] <= NEG_INF * 0.5, 0.0,
                           jnp.exp(s_blk - m_cur[:, None]))
             l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+            if has_drop:   # l accumulates UNdropped p (flash-attn-2)
+                p = jnp.where(
+                    _dropout_keep(pltpu, seed_ref,
+                                  _drop_block_id(bi, hi_, qi, ki, h,
+                                                 sq // blk_q, sk // blk_k),
+                                  blk_q, blk_k, keep_p), p, 0.0)
             acc = acc * alpha[:, None] + p @ vv
             return acc, m_cur, l_cur
 
@@ -404,9 +549,13 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         if has_len:   # skip k-blocks entirely past the valid length
             n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
         k0 = _window_k0(qi, blk_q, blk_k, off, window) if window else 0
+        if has_mask:  # all-masked prefix/suffix block skipping
+            k0 = jnp.maximum(k0, mlo_ref[bi, hi_, qi])
+            n_k = jnp.minimum(n_k, mhi_ref[bi, hi_, qi])
         acc, m, l = lax.fori_loop(k0, n_k, body, (acc0, m0, l0))
         lsafe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[...] = (acc / lsafe[:, None]).astype(o_ref.dtype)
+        norm = lsafe * keep_p if has_drop else lsafe
+        o_ref[...] = (acc / norm[:, None]).astype(o_ref.dtype)
         # TPU tiling wants 2-D trailing blocks: replicate lse across lanes
         lse_ref[...] = jnp.broadcast_to((m + jnp.log(lsafe))[:, None],
                                         (qv.shape[0], LANES))
@@ -422,6 +571,10 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
         spec = _seg_specs()
         in_specs += [spec(blk_q, sq), spec(None, sk)]
     if has_alibi:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_mask:
+        in_specs += _mask_specs(pl, pltpu, mask, blk_q, sk)
+    if has_drop:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     out, lse = pl.pallas_call(
@@ -439,13 +592,14 @@ def _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=None, seg_q=None,
             jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
         ],
     )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k, [],
-                       alibi_slopes=alibi_slopes))
+                       alibi_slopes=alibi_slopes, mask=mask, bounds=bounds,
+                       seed=seed))
     return out, lse
 
 
 def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
                    kv_lens=None, seg_q=None, seg_k=None, window=None,
-                   alibi_slopes=None):
+                   alibi_slopes=None, mask=None, dropout_p=0.0, seed=None):
     """dq: loop over k-blocks for each q-block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -459,6 +613,11 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     has_len = kv_lens is not None
     has_seg = seg_q is not None
     has_alibi = alibi_slopes is not None
+    has_mask = mask is not None
+    has_drop = dropout_p > 0.0
+    keep_p = 1.0 - dropout_p
+    bounds = (_mask_block_bounds(mask, b, h, sq // blk_q, sk // blk_k,
+                                 blk_q, blk_k) if has_mask else None)
 
     def kernel(*refs):
         i = 3
@@ -469,21 +628,30 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         i += 2 * has_seg
         slopes_ref = refs[i] if has_alibi else None
         i += has_alibi
+        mask_ref = refs[i] if has_mask else None
+        mlo_ref = refs[i + 1] if has_mask else None
+        mhi_ref = refs[i + 2] if has_mask else None
+        i += 3 * has_mask
+        seed_ref = refs[i] if has_drop else None
+        i += has_drop
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         do_ref, lse_ref, dl_ref, dq_ref = refs[i:i + 4]
 
         bi = pl.program_id(0)
+        hi_ = pl.program_id(1)
         qi = pl.program_id(2)
         qv = q_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)          # (blk_q, d)
         lse_q = lse_ref[...][:, 0]                    # (blk_q,)
         delta_q = dl_ref[...][:, 0]                   # (blk_q,)
         kvlen_b = lens_ref[bi] if has_len else None
-        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
+        alibi = slopes_ref[hi_] if has_alibi else None
         segq_blk = (jnp.transpose(segq_ref[...], (1, 0))
                     if has_seg else None)
         seg_at = (lambda ki: segk_ref[:, pl.ds(ki * blk_k, blk_k)]) \
             if has_seg else None
+        mask_at = (lambda ki: mask_ref[:, pl.ds(ki * blk_k, blk_k)]) \
+            if has_mask else None
 
         def body(ki, dq_acc):
             kv = k_ref[pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
@@ -491,10 +659,18 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             s_blk = (qv @ kv.T) * sc
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
                                 is_causal, kvlen_b, segq_blk, seg_at,
-                                window=window, alibi=alibi)
+                                window=window, alibi=alibi,
+                                mask_at=mask_at)
             p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
                           jnp.exp(s_blk - lse_q[:, None]))
             dp = do @ vv.T                            # (blk_q, blk_k)
+            if has_drop:   # regenerate the forward's block mask
+                dp = jnp.where(
+                    _dropout_keep(pltpu, seed_ref,
+                                  _drop_block_id(bi, hi_, qi, ki, h,
+                                                 sq // blk_q, sk // blk_k),
+                                  blk_q, blk_k, keep_p),
+                    dp * (1.0 / keep_p), 0.0)
             ds = p * (dp - delta_q[:, None])
             return dq_acc + (ds @ kv) * sc
 
@@ -503,6 +679,9 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         if has_len:
             n_k = jnp.minimum(n_k, (kvlen_b + blk_k - 1) // blk_k)
         k0 = _window_k0(qi, blk_q, blk_k, off, window) if window else 0
+        if has_mask:
+            k0 = jnp.maximum(k0, mlo_ref[bi, hi_, qi])
+            n_k = jnp.minimum(n_k, mhi_ref[bi, hi_, qi])
         dq = lax.fori_loop(k0, n_k, body,
                            jnp.zeros((blk_q, d), jnp.float32))
         dq_ref[...] = dq.astype(dq_ref.dtype)
@@ -521,6 +700,10 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         in_specs += [spec(blk_q, sq), spec(None, sk)]
     if has_alibi:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_mask:
+        in_specs += _mask_specs(pl, pltpu, mask, blk_q, sk)
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     in_specs += [qblk(), row(), row()]
     return pl.pallas_call(
         kernel,
@@ -529,12 +712,14 @@ def _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         out_specs=qblk(),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
     )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
-                       [dot, lse, delta], alibi_slopes=alibi_slopes))
+                       [dot, lse, delta], alibi_slopes=alibi_slopes,
+                       mask=mask, bounds=bounds, seed=seed))
 
 
 def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
                     kv_lens=None, seg_q=None, seg_k=None, window=None,
-                    alibi_slopes=None):
+                    alibi_slopes=None, mask=None, dropout_p=0.0,
+                    seed=None):
     """dk, dv: loop over q-blocks for each k-block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -548,6 +733,12 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
     has_len = kv_lens is not None
     has_seg = seg_q is not None
     has_alibi = alibi_slopes is not None
+    has_mask = mask is not None
+    has_drop = dropout_p > 0.0
+    keep_p = 1.0 - dropout_p
+    bounds = (_mask_block_bounds(mask, b, h, sq // blk_q, sk // blk_k,
+                                 blk_q, blk_k, axis_q=False)
+              if has_mask else None)
 
     def kernel(*refs):
         i = 3
@@ -558,15 +749,22 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         i += 2 * has_seg
         slopes_ref = refs[i] if has_alibi else None
         i += has_alibi
+        mask_ref = refs[i] if has_mask else None
+        mlo_ref = refs[i + 1] if has_mask else None
+        mhi_ref = refs[i + 2] if has_mask else None
+        i += 3 * has_mask
+        seed_ref = refs[i] if has_drop else None
+        i += has_drop
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
         do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs[i:i + 5]
 
         bi = pl.program_id(0)
+        hi_ = pl.program_id(1)
         ki = pl.program_id(2)
         kv = k_ref[...].astype(jnp.float32)           # (blk_k, d)
         vv = v_ref[...].astype(jnp.float32)
         kvlen_b = lens_ref[bi] if has_len else None
-        alibi = slopes_ref[pl.program_id(1)] if has_alibi else None
+        alibi = slopes_ref[hi_] if has_alibi else None
         # k-side ids for THIS block, as (1, blk_k); q-side read per block
         segk_blk = segk_ref[...] if has_seg else None
         seg_at = (lambda _ki: segk_blk) if has_seg else None
@@ -581,13 +779,29 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             segq_blk = (jnp.transpose(
                 segq_ref[:, pl.ds(qi * blk_q, blk_q)], (1, 0))
                 if has_seg else None)
+            # mask column band for THIS k-block, rows sliced per q-block
+            # (slice the REF, not a loaded value — dynamic starts only
+            # exist at the ref level)
+            mask_at = ((lambda _ki: mask_ref[pl.ds(qi * blk_q, blk_q), :])
+                       if has_mask else None)
             s_blk = _block_mask(s_blk, qi, ki, blk_q, blk_k, off,
                                 is_causal, kvlen_b, segq_blk, seg_at,
-                                window=window, alibi=alibi)
+                                window=window, alibi=alibi,
+                                mask_at=mask_at)
             p = jnp.where(lse_q[:, None] <= NEG_INF * 0.5, 0.0,
                           jnp.exp(s_blk - lse_q[:, None]))
-            dv_acc = dv_acc + p.T @ do
             dp = do @ vv.T
+            if has_drop:   # same (bi, hi, qi, ki)-keyed mask as forward
+                dmask = _dropout_keep(pltpu, seed_ref,
+                                      _drop_block_id(bi, hi_, qi, ki, h,
+                                                     sq // blk_q,
+                                                     sk // blk_k),
+                                      blk_q, blk_k, keep_p)
+                dv_acc = dv_acc + jnp.where(
+                    dmask, p * (1.0 / keep_p), 0.0).T @ do
+                dp = jnp.where(dmask, dp * (1.0 / keep_p), 0.0)
+            else:
+                dv_acc = dv_acc + p.T @ do
             ds = p * (dp - delta_q[:, None])
             dk_acc = dk_acc + (ds.T @ qv) * sc
             return dk_acc, dv_acc
@@ -605,6 +819,9 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
             q_hi = jnp.clip(
                 (ki * blk_k + blk_k - 1 + window - off) // blk_q + 1,
                 0, n_q)
+        if has_mask:
+            q0 = jnp.maximum(q0, mlo_ref[bi, hi_, ki])
+            q_hi = jnp.minimum(q_hi, mhi_ref[bi, hi_, ki])
         dk, dv = lax.fori_loop(q0, q_hi, body,
                                (jnp.zeros((blk_k, d), jnp.float32),
                                 jnp.zeros((blk_k, d), jnp.float32)))
@@ -625,6 +842,11 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         in_specs += [spec(None, sq), spec(blk_k, sk)]
     if has_alibi:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if has_mask:
+        in_specs += _mask_specs(pl, pltpu, mask, blk_k, sq,
+                                row_axis_q=False)
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     in_specs += [qfull(), frow(), frow()]
     return pl.pallas_call(
         kernel,
@@ -634,7 +856,8 @@ def _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc,
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), qt.dtype)],
     )(*_build_operands(qt, kt, vt, kv_lens, seg_q, seg_k,
-                       [dot, lse, delta], alibi_slopes=alibi_slopes))
+                       [dot, lse, delta], alibi_slopes=alibi_slopes,
+                       mask=mask, bounds=bounds, seed=seed))
 
 
 @functools.partial(jax.jit, static_argnames=("is_causal", "scale"))
@@ -645,7 +868,8 @@ def _flash_attention_pallas(q, k, v, is_causal: bool, scale: Optional[float]):
 
 
 def _flash_fwd(q, k, v, is_causal, scale, kv_lens=None, seg_q=None,
-               seg_k=None, window=None, alibi_slopes=None):
+               seg_k=None, window=None, alibi_slopes=None, mask=None,
+               dropout_p=0.0, seed=None):
     b, sq, h, d = q.shape
     n_rep = h // k.shape[2]
     k = _repeat_kv(k, n_rep)
@@ -656,7 +880,8 @@ def _flash_fwd(q, k, v, is_causal, scale, kv_lens=None, seg_q=None,
     vt = jnp.transpose(v, (0, 2, 1, 3))
     out_t, lse = _fwd_kernels(qt, kt, vt, is_causal, sc, kv_lens=kv_lens,
                               seg_q=seg_q, seg_k=seg_k, window=window,
-                              alibi_slopes=alibi_slopes)
+                              alibi_slopes=alibi_slopes, mask=mask,
+                              dropout_p=dropout_p, seed=seed)
     return jnp.transpose(out_t, (0, 2, 1, 3)), lse
 
 
@@ -665,49 +890,67 @@ def _float0_like(a):
 
 
 def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k,
-                window=None, alibi_slopes=None):
-    """Differentiable entry covering all structured-mask forms."""
+                window=None, alibi_slopes=None, mask=None,
+                dropout_p=0.0):
+    """Differentiable entry covering all structured-mask forms, dense
+    masks and in-kernel dropout."""
     flags = (kv_lens is not None, seg_q is not None,
-             alibi_slopes is not None)
+             alibi_slopes is not None, mask is not None, dropout_p > 0.0)
     dummy_len = kv_lens if flags[0] else jnp.zeros((1,), jnp.int32)
     dummy_sq = seg_q if flags[1] else jnp.zeros((1, 1), jnp.int32)
     dummy_sk = seg_k if flags[1] else jnp.zeros((1, 1), jnp.int32)
     dummy_al = (alibi_slopes if flags[2]
                 else jnp.zeros((1,), jnp.float32))
+    dummy_mk = mask if flags[3] else jnp.zeros((1, 1, 1, 1), jnp.int8)
+    if flags[4]:
+        from paddle_tpu.core import rng as _rng
+        seed = jax.random.randint(_rng.next_rng_key("dropout"),
+                                  (1,), -2 ** 31, 2 ** 31 - 1, jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
     return _flash_vjp_entry(q, k, v, dummy_len, dummy_sq, dummy_sk,
-                            dummy_al, flags, is_causal, scale, window)
+                            dummy_al, dummy_mk, seed, flags, is_causal,
+                            scale, window, float(dropout_p))
 
 
-def _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window):
-    has_len, has_seg, has_alibi = flags
+def _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window, mask=None,
+             seed=None, dropout_p=0.0):
+    has_len, has_seg, has_alibi = flags[:3]
+    has_mask = len(flags) > 3 and flags[3]
+    has_drop = len(flags) > 4 and flags[4]
     return dict(kv_lens=kv_lens if has_len else None,
                 seg_q=seg_q if has_seg else None,
                 seg_k=seg_k if has_seg else None,
                 window=window,
-                alibi_slopes=alibi if has_alibi else None)
+                alibi_slopes=alibi if has_alibi else None,
+                mask=mask if has_mask else None,
+                dropout_p=dropout_p if has_drop else 0.0,
+                seed=seed if has_drop else None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
-def _flash_vjp_entry(q, k, v, kv_lens, seg_q, seg_k, alibi, flags,
-                     is_causal, scale, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
+def _flash_vjp_entry(q, k, v, kv_lens, seg_q, seg_k, alibi, mask, seed,
+                     flags, is_causal, scale, window, dropout_p):
     """Pallas forward + Pallas backward (dq / dk+dv block kernels)."""
     out, _ = _flash_fwd(q, k, v, is_causal, scale,
                         **_mask_kw(kv_lens, seg_q, seg_k, alibi, flags,
-                                   window))
+                                   window, mask, seed, dropout_p))
     return out
 
 
-def _flash_vjp_fwd(q, k, v, kv_lens, seg_q, seg_k, alibi, flags,
-                   is_causal, scale, window):
+def _flash_vjp_fwd(q, k, v, kv_lens, seg_q, seg_k, alibi, mask, seed,
+                   flags, is_causal, scale, window, dropout_p):
     out, lse = _flash_fwd(q, k, v, is_causal, scale,
                           **_mask_kw(kv_lens, seg_q, seg_k, alibi, flags,
-                                     window))
-    return out, (q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi)
+                                     window, mask, seed, dropout_p))
+    return out, (q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi, mask,
+                 seed)
 
 
 def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
                      kv_lens=None, seg_q=None, seg_k=None, window=None,
-                     alibi_slopes=None):
+                     alibi_slopes=None, mask=None, dropout_p=0.0,
+                     seed=None):
     """Shared Pallas backward. `lse` is (b, h, sq, LANES). When `g_lse`
     (b, h, sq) is given (cotangent on the returned LSE, e.g. from a ring
     merge), it folds into the softmax-grad correction: dS = P·(dP − Δ)
@@ -732,7 +975,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
     kw = dict(kv_lens=kv_lens, seg_q=seg_q, seg_k=seg_k, window=window,
-              alibi_slopes=alibi_slopes)
+              alibi_slopes=alibi_slopes, mask=mask, dropout_p=dropout_p,
+              seed=seed)
     dq_t = _bwd_dq_kernel(qt, kt, vt, dot, lse, delta, is_causal, sc, **kw)
     dk_t, dv_t = _bwd_dkv_kernel(qt, kt, vt, dot, lse, delta, is_causal,
                                  sc, **kw)
@@ -747,28 +991,47 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, is_causal, scale, g_lse=None,
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_vjp_bwd(flags, is_causal, scale, window, res, g):
-    q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi = res
-    kw = _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window)
+def _flash_vjp_bwd(flags, is_causal, scale, window, dropout_p, res, g):
+    q, k, v, out, lse, kv_lens, seg_q, seg_k, alibi, mask, seed = res
+    kw = _mask_kw(kv_lens, seg_q, seg_k, alibi, flags, window, mask, seed,
+                  dropout_p)
     try:
         dq, dk, dv = _pallas_bwd_impl(q, k, v, out, lse, g, is_causal,
                                       scale, **kw)
     except Exception as e:
         from paddle_tpu.core.flags import flag
-        if flag("FLAGS_pallas_strict"):
+        if flag("FLAGS_pallas_strict") or kw["dropout_p"] > 0.0:
+            # no XLA fallback under dropout: it could not reproduce the
+            # kernel's counter-based mask, silently mismatching the fwd
             raise
         _log_fallback("backward", e)
+        kw_x = dict(kw)
+        kw_x.pop("seed")
+        kw_x["attn_mask"] = _mask_as_attn(kw_x.pop("mask"))
         _, pull = jax.vjp(
             lambda q_, k_, v_: _xla_attention(
-                q_, k_, v_, is_causal=is_causal, scale=scale, dropout_p=0.0,
-                **kw),
+                q_, k_, v_, is_causal=is_causal, scale=scale,
+                **kw_x),
             q, k, v)
         dq, dk, dv = pull(g)
     # kv_lens/segments are integer primals → float0; alibi is fp32 (a dummy
     # zeros(1) on non-ALiBi calls) so its cotangent must be a real float
     # zero — float0 for a float primal breaks under custom_vjp aval checks.
+    # Dense masks are non-differentiable inputs (float masks get a real
+    # zero cotangent, int8/bool get float0); the seed is int32 → float0.
+    mask_ct = (_float0_like(res[9])
+               if res[9].dtype in (jnp.bool_, jnp.int8)
+               else jnp.zeros(res[9].shape, res[9].dtype))
     return (dq, dk, dv, _float0_like(res[5]), _float0_like(res[6]),
-            _float0_like(res[7]), jnp.zeros(res[8].shape, res[8].dtype))
+            _float0_like(res[7]), jnp.zeros(res[8].shape, res[8].dtype),
+            mask_ct, _float0_like(res[10]))
+
+
+def _mask_as_attn(mask):
+    """int8 kernel mask back to bool for the XLA fallback path."""
+    if mask is None:
+        return None
+    return (mask != 0) if mask.dtype == jnp.int8 else mask
 
 
 _flash_vjp_entry.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -111,6 +111,14 @@ def _vmem_limit_bytes() -> int:
 _GRID_STEP_BYTES = 3 * 2 ** 19
 
 
+def _step_penalty(w_step):
+    """Cost penalty for oversized per-grid-step weight blocks in the
+    split (big-model) regime: blocks above ~30 MiB serialize DMA against
+    compute — measured on llama2-7b int8 (SCALE.md r5 sweep: qs8/f512 at
+    11.33 ms/step beats qs4/f1024 at 11.93 and qs6/f512 at 12.08)."""
+    return max(0, 4 * (w_step - 28 * 2 ** 20))
+
+
 def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
                       wbytes: int, q_split: Optional[int] = None) -> Dict:
     """Joint plan for the fused decode kernel's weight streaming.
@@ -132,12 +140,17 @@ def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
     half = max((budget - 8 * 2 ** 20) // 2, 2 ** 20)
     nheads_tot = dqkv // hd
 
-    def ffn_pick(fmax):
+    def ffn_pick(fixed, fmax, split):
         # candidates: 128-multiples up to fmax (padding allowed) plus, for
         # non-128-multiple ffns, the exact divisors (no padding)
         if ffn <= 128:
             return (1, ffn, ffn) if ffn <= fmax else None
         cands = list(range(128, min(ffn + 127, fmax) + 1, 128))
+        if split:
+            # split (big-model) regime: only 512-multiples (+128/256)
+            # stream cleanly — 640/768-lane blocks measured 8-200% slower
+            # on the llama2-7b sweeps (SCALE.md r5)
+            cands = [f for f in cands if f % 512 == 0 or f in (128, 256)]
         if not cands:
             # no lane-aligned block fits: exact divisors as a last resort
             cands = [f for f in range(1, min(ffn, fmax) + 1)
@@ -146,6 +159,8 @@ def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
         for f in cands:
             jn = -(-ffn // f)
             cost = 3 * jn * f * h * wbytes + jn * _GRID_STEP_BYTES
+            if split:
+                cost += _step_penalty(fixed + 3 * f * h * wbytes)
             if best is None or cost < best[0] or (cost == best[0]
                                                   and f > best[2]):
                 best = (cost, jn, f)
@@ -158,15 +173,19 @@ def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
         qblk = dqkv // qs
         if qblk % hd:
             continue
-        if qs > 1 and qblk % 128 and not q_split:
-            continue                     # lane-aligned splits only
+        if qs > 1 and not q_split and (
+                qblk % 128 or not (qblk % 512 == 0 or qblk in (128, 256))):
+            continue    # lane-aligned, 512-multiple splits only (see
+            # ffn_pick: 768-lane qkv blocks measured 3x slower)
         fixed = (qblk + dq) * h * wbytes
-        pick = ffn_pick((half - fixed) // (3 * h * wbytes))
+        pick = ffn_pick(fixed, (half - fixed) // (3 * h * wbytes), qs > 1)
         if pick is None:
             continue
         jn, fblk, pad = pick
         cost = (3 * pad * h * wbytes + jn * _GRID_STEP_BYTES
                 + qs * _GRID_STEP_BYTES)
+        if qs > 1:
+            cost += _step_penalty(fixed + 3 * fblk * h * wbytes)
         if best is None or cost < best[0]:
             best = (cost, qs, qblk, jn, fblk, pad)
     if best is None:
@@ -296,9 +315,16 @@ def build_fused_params_moe(state: Dict[str, jax.Array], num_layers: int,
     (L,E,h,f), wed (L,E,f,h)}. The expert stacks stay in HBM; the kernel
     streams only the routed experts' weights per token (the TPU-native
     analog of the reference's fused MoE inference path —
-    fused_multi_transformer + global_scatter composition)."""
+    fused_multi_transformer + global_scatter composition).
+
+    DeepSeekMoE shared experts (the model's concatenated `shared_mlp`)
+    add dense stacks {wsg/wsu (L,h,ns·f), wsd (L,ns·f,h)} — every token
+    uses them, so the kernel streams them like the llama FFN."""
     cols = {"ln1": [], "wqkv": [], "wo": [], "ln2": [], "gate": [],
             "weg": [], "weu": [], "wed": []}
+    shared = f"{prefix}0.shared_mlp.gate_proj.weight" in state
+    if shared:
+        cols.update({"wsg": [], "wsu": [], "wsd": []})
     for i in range(num_layers):
         cols["ln1"].append(state[f"{prefix}{i}.input_layernorm.weight"])
         cols["wqkv"].append(jnp.concatenate(
@@ -311,6 +337,10 @@ def build_fused_params_moe(state: Dict[str, jax.Array], num_layers: int,
         cols["weg"].append(state[f"{prefix}{i}.moe.experts.w_gate"])
         cols["weu"].append(state[f"{prefix}{i}.moe.experts.w_up"])
         cols["wed"].append(state[f"{prefix}{i}.moe.experts.w_down"])
+        if shared:
+            cols["wsg"].append(state[f"{prefix}{i}.shared_mlp.gate_proj.weight"])
+            cols["wsu"].append(state[f"{prefix}{i}.shared_mlp.up_proj.weight"])
+            cols["wsd"].append(state[f"{prefix}{i}.shared_mlp.down_proj.weight"])
     return {k: jnp.stack(v) for k, v in cols.items()}
 
 
@@ -442,6 +472,14 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
             d = jnp.einsum("bkf,bkfh->bkh", act, wd_sel,
                            preferred_element_type=jnp.float32)
             xf = xf + jnp.einsum("bk,bkh->bh", vals, d)
+            if "wsg" in params:   # DeepSeekMoE shared experts: dense SwiGLU
+                sg = jnp.dot(xn2, params["wsg"][l],
+                             preferred_element_type=jnp.float32)
+                su = jnp.dot(xn2, params["wsu"][l],
+                             preferred_element_type=jnp.float32)
+                sact = (jax.nn.silu(sg) * su).astype(dtype)
+                xf = xf + jnp.dot(sact, params["wsd"][l],
+                                  preferred_element_type=jnp.float32)
         else:
             xn2 = _rms(xf, params["ln2"][l], eps)
             g = wdot(xn2, "wg", l)
@@ -520,12 +558,15 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     if not chunk:
         chunk = 128
         if blocks is not None:
-            # shrink the double-buffered KV chunks until weights + scratch
-            # fit the scoped-VMEM ceiling (7B at b=8 needs ck=64)
+            # pick the KV chunk so weights + scratch fit the scoped-VMEM
+            # ceiling. In the split regime ck=64 measured fastest on the
+            # llama2-7b sweep (SCALE.md r5) — chunk DMA granularity
+            # overlaps the weight stream better than maximal chunks.
             w2 = 2 * (qblk + dq + 3 * fblk) * h * wbytes
             scratch_fixed = (b * 8 * 2 * dkv * 2 + b * 2 * dkv * 4
                              + b * nh * hd * 4 + b * h * 10)
-            for cand in (128, 64, 32, 16, 8):
+            order = (64, 128, 32, 16, 8) if Qs > 1 else (128, 64, 32, 16, 8)
+            for cand in order:
                 if S % cand == 0 and (w2 + scratch_fixed + 6 * 2 ** 20
                                       + 2 * b * cand * 2 * dkv * 2
                                       <= _vmem_limit_bytes()):
@@ -952,11 +993,21 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
     k = top_k
     nslots = b * k
     wbytes = 2
+    shared = "wsg" in params
+    fs = params["wsg"].shape[2] if shared else 0
     # attention weights ride the Mosaic pipeline (double-buffered), expert
     # blocks ride the manual pipeline — both count against VMEM
     attn_fixed = 2 * (dqkv + dq + E) * h * wbytes
     J, fblk = _pick_expert_blocks(ffn, h, fixed_bytes=attn_fixed,
                                   wbytes=wbytes)
+    if shared:
+        # DeepSeekMoE dense shared experts: Mosaic-pipelined column
+        # blocks like the llama FFN, budgeted AFTER the expert buffers
+        Js, fsblk = _pick_expert_blocks(
+            fs, h, fixed_bytes=attn_fixed + 2 * 3 * fblk * h * wbytes,
+            wbytes=wbytes)
+    else:
+        Js, fsblk = 0, 0
     nsteps = nslots * J
     if not chunk:
         chunk = 128
@@ -967,11 +1018,17 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
     dtype = x.dtype
     scale = 1.0 / math.sqrt(hd)
 
-    def kernel(pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref,
-               gate_ref, weg_ref, weu_ref, wed_ref, kv_in,
-               x_out_ref, kv_ref,
-               x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
-               wsem, rsem, eid_s, egw_s, ewg_s, ewu_s, ewd_s, esem):
+    def kernel(*refs):
+        (pos_ref, x_in_ref, ln1_ref, wqkv_ref, wo_ref, ln2_ref,
+         gate_ref, weg_ref, weu_ref, wed_ref) = refs[:10]
+        i = 10
+        if shared:
+            wsg_ref, wsu_ref, wsd_ref = refs[i:i + 3]
+            i += 3
+        kv_in = refs[i]
+        x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
+        (x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
+         wsem, rsem, eid_s, egw_s, ewg_s, ewu_s, ewd_s, esem) = refs[i + 3:]
         del kv_in
         li = pl.program_id(0)
         t = pl.program_id(1)
@@ -1153,29 +1210,44 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             for cp in expert_copies(0, 0):
                 cp.start()
 
-        @pl.when(t > 0)
-        def ffn_phase():
-            u = t - 1
-            buf = lax.rem(u, 2)
+        @pl.when(t == 1)
+        def prefetch_next_layer():
+            blk = (pos // 8) * 8
+            pltpu.make_async_copy(
+                kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)],
+                wsem.at[0]).wait()
 
-            @pl.when(t == 1)
-            def prefetch_next_layer():
-                blk = (pos // 8) * 8
+            @pl.when(li + 1 < L)
+            def _():
                 pltpu.make_async_copy(
-                    kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)],
-                    wsem.at[0]).wait()
+                    kv_ref.at[li + 1, :, pl.ds(blk, 8)], kvblk_s,
+                    wsem.at[0]).start()
 
-                @pl.when(li + 1 < L)
+                @pl.when(blk > 0)
                 def _():
                     pltpu.make_async_copy(
-                        kv_ref.at[li + 1, :, pl.ds(blk, 8)], kvblk_s,
-                        wsem.at[0]).start()
+                        kv_ref.at[li + 1, :, pl.ds(0, ck)],
+                        kvch_s.at[0], rsem.at[0]).start()
 
-                    @pl.when(blk > 0)
-                    def _():
-                        pltpu.make_async_copy(
-                            kv_ref.at[li + 1, :, pl.ds(0, ck)],
-                            kvch_s.at[0], rsem.at[0]).start()
+        if shared:
+            # DeepSeekMoE shared experts: dense SwiGLU column blocks
+            # (Mosaic-pipelined BlockSpecs, weight 1.0, ALL rows) — the
+            # routed experts' slot-0 DMAs overlap these phases
+            @pl.when((t > 0) & (t <= Js))
+            def shared_phase():
+                xn = xn_s[...]
+                g = jnp.dot(xn, wsg_ref[...],
+                            preferred_element_type=jnp.float32)
+                u = jnp.dot(xn, wsu_ref[...],
+                            preferred_element_type=jnp.float32)
+                act = (jax.nn.silu(g) * u).astype(dtype)
+                acc_s[...] += jnp.dot(act, wsd_ref[...],
+                                      preferred_element_type=jnp.float32)
+
+        @pl.when(t > Js)
+        def ffn_phase():
+            u = t - 1 - Js
+            buf = lax.rem(u, 2)
 
             for cp in expert_copies(u, buf):
                 cp.wait()
@@ -1205,13 +1277,22 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             rowmask = lax.broadcasted_iota(jnp.int32, (b, 1), 0) == r
             acc_s[...] += jnp.where(rowmask, d * wsel, 0.0)
 
-            @pl.when(t == nsteps)
+            @pl.when(t == Js + nsteps)
             def _():
                 xr = x_s[...] + acc_s[...]
                 x_s[...] = xr
                 x_out_ref[...] = xr.astype(dtype)
 
-    grid = (L, 1 + nsteps)
+    def sjm(ll, tt):
+        # shared-FFN column block: phases 1..Js stream blocks 0..Js-1;
+        # t==0 keeps the previous layer's last block (no refetch), expert
+        # phases keep the last block resident
+        return jnp.where(tt < 1, Js - 1, jnp.minimum(tt - 1, Js - 1))
+
+    def sl(ll, tt):
+        return lax.max(ll - (tt < 1), 0)
+
+    grid = (L, 1 + Js + nsteps)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -1226,6 +1307,14 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # weg
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # weu
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # wed
+        ] + ([
+            pl.BlockSpec((None, h, fsblk),
+                         lambda l, t: (sl(l, t), 0, sjm(l, t))),    # wsg
+            pl.BlockSpec((None, h, fsblk),
+                         lambda l, t: (sl(l, t), 0, sjm(l, t))),    # wsu
+            pl.BlockSpec((None, fsblk, h),
+                         lambda l, t: (sl(l, t), sjm(l, t), 0)),    # wsd
+        ] if shared else []) + [
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
         ],
         out_specs=[
@@ -1253,7 +1342,7 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             pltpu.VMEM((2, fblk, h), dtype),          # ewd_s
             pltpu.SemaphoreType.DMA((2, 3)),          # esem
         ],
-        input_output_aliases={10: 1},
+        input_output_aliases={10 + 3 * shared: 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=_vmem_limit_bytes()),
@@ -1262,6 +1351,7 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
       params["ln1"][:, None], params["wqkv"], params["wo"],
       params["ln2"][:, None], params["gate"],
       params["weg"], params["weu"], params["wed"],
+      *((params["wsg"], params["wsu"], params["wsd"]) if shared else ()),
       kv_cache)
     return out[0], out[1]
 

@@ -70,6 +70,10 @@ def istft(x, n_fft, hop_length: Optional[int] = None,
         window = jnp.pad(window, (lp, n_fft - win_length - lp))
     if normalized:
         x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided and return_complex:
+        raise ValueError(
+            "istft: onesided=True cannot return a complex signal (the "
+            "reference rejects this combination)")
     if onesided and not return_complex:
         frames = jnp.fft.irfft(x, n=n_fft, axis=-2)   # (..., n_fft, T)
     else:

@@ -192,3 +192,36 @@ def test_mixtral_train_loss_chunked():
     got4 = float(functional_call(m, state, x, y, method="train_loss"))
     np.testing.assert_allclose(got1, ref, rtol=2e-5)
     np.testing.assert_allclose(got4, ref, rtol=2e-5)
+
+
+def test_deepseek_shared_experts_fused_plan_matches_layered():
+    """DeepSeekMoE decode (round 5): shared experts ride the fused plan
+    (dense SwiGLU folded next to the routed top-k) — greedy tokens equal
+    the layered scan path; k=6-style multi-expert routing is eligible
+    because the no-drop bound is per-expert load b, not b·top_k."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle_tpu.seed(0)
+    cfg = MixtralConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_position_embeddings=256, num_experts=16, top_k=4,
+                        num_shared_experts=2)
+    m = MixtralForCausalLM(cfg)
+    m.eval()
+    state = m.trainable_state()
+    plan = m.fused_decode_plan(state, probe=True)
+    assert plan is not None and plan["max_batch"] >= 2
+    full = m.fused_decode_plan(state)
+    assert "wsg" in full["params"]          # shared stacks present
+    assert full["params"]["wsg"].shape == (2, 64, 256)
+
+    prompt = jnp.asarray(np.random.RandomState(3).randint(0, 256, (2, 5)))
+    out_fused = generate(m, prompt, max_new_tokens=8, temperature=0.0)
+    paddle_tpu.set_flags({"FLAGS_fused_decode": False})
+    try:
+        m._generate_jit_cache.clear()
+        out_layered = generate(m, prompt, max_new_tokens=8, temperature=0.0)
+    finally:
+        paddle_tpu.set_flags({"FLAGS_fused_decode": True})
+    np.testing.assert_array_equal(np.asarray(out_fused),
+                                  np.asarray(out_layered))

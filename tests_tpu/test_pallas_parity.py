@@ -142,6 +142,139 @@ def test_flash_public_api_structured_masks():
     assert_close(out, ref)
 
 
+@pytest.mark.parametrize("kind", ["bool", "float"])
+def test_flash_dense_mask_parity(kind):
+    """Arbitrary dense attn_mask tiles (round 5 — the last mask-surface
+    gap): kernel fwd+bwd == XLA reference under a random (b, 1, s, s)
+    mask, bool and additive-float forms."""
+    from paddle_tpu.ops import flash_attention as fa
+
+    r = np.random.RandomState(0)
+    b, s, h, d = 2, 512, 2, 64
+    q, k, v = (jnp.asarray(r.standard_normal((b, s, h, d)) * 0.3,
+                           jnp.float32) for _ in range(3))
+    mb = r.rand(b, 1, s, s) > 0.3
+    mb[:, :, :, 0] = True            # no fully-masked rows
+    if kind == "bool":
+        mask_x = jnp.asarray(mb)
+        mask_k = mask_x.astype(jnp.int8)
+    else:
+        mask_x = jnp.asarray(np.where(mb, r.standard_normal(
+            (b, 1, s, s)) * 0.5, -1e30), jnp.float32)
+        mask_k = mask_x
+
+    def loss_k(q, k, v):
+        return fa._flash_call(q, k, v, False, None, None, None, None,
+                              mask=mask_k).astype(jnp.float32).sum()
+
+    def loss_x(q, k, v):
+        return fa._xla_attention(q, k, v, attn_mask=mask_x,
+                                 is_causal=False).astype(
+            jnp.float32).sum()
+
+    ok, gk = jax.value_and_grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    ox, gx = jax.value_and_grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    assert np.allclose(float(ok), float(ox), rtol=2e-3)
+    for a, b_ in zip(gk, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-2, atol=5e-3)
+
+
+def test_flash_dense_mask_block_skipping():
+    """A mask whose valid region covers only the first quarter of the
+    keys must produce identical results to the unskipped dense form —
+    the prefix/suffix block-skipping bounds are exact."""
+    from paddle_tpu.ops import flash_attention as fa
+
+    r = np.random.RandomState(1)
+    b, s, h, d = 1, 512, 2, 64
+    q, k, v = (jnp.asarray(r.standard_normal((b, s, h, d)) * 0.3,
+                           jnp.float32) for _ in range(3))
+    mask = np.zeros((1, 1, s, s), bool)
+    mask[:, :, :, :128] = True       # only k-block 0 valid
+    out = fa._flash_call(q, k, v, False, None, None, None, None,
+                         mask=jnp.asarray(mask, jnp.int8))
+    ref = fa._xla_attention(q, k, v, attn_mask=jnp.asarray(mask),
+                            is_causal=False)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_flash_dropout_in_kernel():
+    """In-kernel attention dropout (round 5 — the last kernel-surface
+    gap): deterministic per seed, unbiased vs the no-dropout output, and
+    the backward regenerates the forward's mask (finite-difference check
+    through the kernel with a pinned seed)."""
+    import paddle_tpu
+    from paddle_tpu.ops import flash_attention as fa
+
+    r = np.random.RandomState(0)
+    b, s, h, d = 2, 512, 4, 64
+    q, k, v = (jnp.asarray(r.standard_normal((b, s, h, d)) * 0.3,
+                           jnp.float32) for _ in range(3))
+    p = 0.3
+
+    def run(seed_int, dropout=p):
+        paddle_tpu.seed(seed_int)      # pins the kernel's dropout seed
+        return fa._flash_call(q, k, v, True, None, None, None, None,
+                              dropout_p=dropout)
+
+    o1 = np.asarray(run(7), np.float32)
+    o2 = np.asarray(run(7), np.float32)
+    np.testing.assert_array_equal(o1, o2)          # deterministic
+    o3 = np.asarray(run(8), np.float32)
+    assert np.abs(o1 - o3).max() > 1e-4            # seed matters
+    base = np.asarray(run(7, dropout=0.0), np.float32)
+    # unbiased: averaging over many seeds approaches the no-drop output
+    acc = np.zeros_like(base)
+    n_seeds = 24
+    for sd in range(n_seeds):
+        acc += np.asarray(run(100 + sd), np.float32)
+    err = np.abs(acc / n_seeds - base).mean() / (np.abs(base).mean())
+    assert err < 0.15, err
+
+    # backward consistency. Pointwise FD on dq is hopeless here: the
+    # projected-loss reduction carries ~1e-3 of f32 noise while dq
+    # signals are ~1e-4 (measured; the formula itself is verified
+    # against autodiff with an explicit mask in the numpy twin). Three
+    # checks that ARE decisive:
+    proj = jnp.asarray(r.standard_normal((b, s, h, d)), jnp.float32)
+
+    def loss_of(qq, vv, p_, seed_int=7):
+        paddle_tpu.seed(seed_int)
+        out = fa._flash_call(qq, k, vv, True, None, None, None, None,
+                             dropout_p=p_)
+        return (out * proj).astype(jnp.float32).sum()
+
+    # (a) p -> 0 limit: the dropout backward must reduce EXACTLY to the
+    # no-dropout backward (threshold saturates to keep-all)
+    g_p0 = np.asarray(jax.grad(lambda qq: loss_of(qq, v, 0.0))(q))
+    g_eps = np.asarray(jax.grad(lambda qq: loss_of(qq, v, 1e-9))(q))
+    np.testing.assert_array_equal(g_p0, g_eps)
+
+    # (b) dv finite difference — dv entries are O(1), far above the
+    # noise floor; a mask mismatch between the fwd and dkv kernels
+    # would break this immediately
+    gv = np.asarray(jax.grad(lambda vv: loss_of(q, vv, p))(v))
+    for idx in [(0, 3, 1, 5), (1, 100, 2, 17)]:
+        fd = (float(loss_of(q, v.at[idx].add(1e-2), p))
+              - float(loss_of(q, v.at[idx].add(-1e-2), p))) / 2e-2
+        assert abs(fd - gv[idx]) < 0.05 * max(0.2, abs(fd)), (idx, fd,
+                                                              gv[idx])
+
+    # (c) gradient unbiasedness: dq averaged over seeds approaches the
+    # p=0 gradient (a wrong mask in the dq kernel cannot average out)
+    gacc = np.zeros_like(g_p0)
+    for sd in range(n_seeds):
+        gacc += np.asarray(jax.grad(
+            lambda qq: loss_of(qq, v, p, 100 + sd))(q))
+    gmean = gacc / n_seeds
+    denom = np.abs(g_p0).mean()
+    assert np.abs(gmean - g_p0).mean() / denom < 0.25, \
+        np.abs(gmean - g_p0).mean() / denom
+
+
 # ---------------------------------------------------------------------------
 # fused decode step
 # ---------------------------------------------------------------------------
@@ -352,6 +485,45 @@ def test_fused_decode_moe_kernel_parity(b):
               "gate": f(L, E, h),
               "weg": f(L, E, h, ffn), "weu": f(L, E, h, ffn),
               "wed": f(L, E, ffn, h)}
+    x = f(b, h)
+    kv = f(L, b, S, 2 * nkv * hd)
+    pos = 130
+    cos, sin = rope_cos_sin(S, hd)
+
+    xr, kvr = jax.jit(lambda *a: fd.fused_decode_reference(
+        *a, num_heads=nh, num_kv_heads=nkv, eps=1e-5, arch="moe",
+        top_k=k))(x, params, kv, pos, cos[pos:pos + 1], sin[pos:pos + 1])
+    xp, kvp = jax.jit(lambda x, p, kv: fd._fused_decode_moe_pallas(
+        x, p, kv, pos, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+        top_k=k, eps=1e-5))(x, params, kv)
+
+    assert_close(xp, xr)
+    d = np.abs(np.asarray(kvr, np.float32) - np.asarray(kvp, np.float32))
+    touched = sorted(set(np.argwhere(d > 1e-3)[:, 2].tolist()))
+    assert touched in ([], [pos]), touched
+    assert d.max() < 0.05, d.max()
+
+
+def test_fused_decode_moe_shared_experts_parity():
+    """DeepSeekMoE shape: shared experts stream as Mosaic-pipelined dense
+    SwiGLU blocks next to the routed top-k manual pipeline; k=4 multi-slot
+    routing. Kernel vs the jnp reference twin."""
+    from paddle_tpu.ops import fused_decode as fd
+    from paddle_tpu.ops.rope import rope_cos_sin
+
+    L, S, hd, h, ffn, E, k = 3, 256, 64, 256, 256, 16, 4
+    fs = 2 * ffn                             # 2 shared experts
+    nkv, rep, b = 2, 2, 2
+    nh = nkv * rep
+    r = np.random.RandomState(0)
+    f = lambda *s: jnp.asarray(r.randn(*s) * 0.05, jnp.bfloat16)
+    params = {"ln1": jnp.ones((L, h), jnp.bfloat16),
+              "wqkv": f(L, h, (nh + 2 * nkv) * hd),
+              "wo": f(L, nh * hd, h), "ln2": jnp.ones((L, h), jnp.bfloat16),
+              "gate": f(L, E, h),
+              "weg": f(L, E, h, ffn), "weu": f(L, E, h, ffn),
+              "wed": f(L, E, ffn, h),
+              "wsg": f(L, h, fs), "wsu": f(L, h, fs), "wsd": f(L, fs, h)}
     x = f(b, h)
     kv = f(L, b, S, 2 * nkv * hd)
     pos = 130
