@@ -241,25 +241,33 @@ def main():
     tok_s = ns.batch * n_eff / dt
     per_seq = n_eff / dt
 
-    # roofline: average cache length over the decode window. int8
-    # quantizes every linear INCLUDING lm_head; only the embedding table
-    # (one vocab×hidden gather source) stays bf16. MoE: the fused kernel
-    # streams only b·top_k routed experts per layer per step — the
-    # roofline's weight bytes count exactly what the kernel must read.
+    # roofline: average cache length over the decode window. The UNTIED
+    # embedding table is NOT streamed per step — decode gathers b rows
+    # from it; only a TIED head (gpt2) re-reads it as the unembedding
+    # matmul. (Round-5 correction: earlier rooflines counted the unread
+    # embed table, inflating bytes/step — the deepseek row came out at
+    # "114% of roofline", which is how the bug surfaced. Historical rows
+    # in SCALE.md are re-derived under this definition.) int8 quantizes
+    # every linear INCLUDING lm_head; the bf16 embed table is excluded
+    # either way. MoE: the fused kernel streams only min(b·k, E) routed
+    # experts per layer per step.
     avg_len = ns.prompt_len + ns.new_tokens / 2
-    embed_params = cfg.vocab_size * cfg.hidden_size
+    tied = bool(getattr(cfg, "tie_word_embeddings", False)) \
+        or name == "gpt2-345m"
+    embed_params = 0 if tied else cfg.vocab_size * cfg.hidden_size
     if moe:
         # routed stacks stream only min(b·k, E) experts/layer; DENSE params
-        # (attention, router, shared experts, embed/head) stream whole
+        # (attention, router, shared experts, head) stream whole
         expert_params = 3 * cfg.hidden_size * cfg.intermediate_size
-        dense_params = n_params - cfg.num_layers * cfg.num_experts * expert_params
+        dense_params = (n_params - embed_params
+                        - cfg.num_layers * cfg.num_experts * expert_params)
         streamed = (dense_params + cfg.num_layers * min(
             ns.batch * cfg.top_k, cfg.num_experts) * expert_params)
         param_bytes = 2 * streamed
     elif ns.int8:
-        param_bytes = (n_params - embed_params) + 2 * embed_params
+        param_bytes = n_params - embed_params
     else:
-        param_bytes = 2 * n_params
+        param_bytes = 2 * (n_params - embed_params)
     step_bytes = param_bytes + ns.batch * kv_bytes_per_token(cfg) * avg_len
     bw = HBM_BW.get(dev.device_kind, 819e9 if on_tpu else 50e9)
     roofline_tok_s = ns.batch * bw / step_bytes
