@@ -27,10 +27,28 @@ def _inference_state(model):
     return model.state_dict(include_buffers=False)
 
 
+def _greedy_argmax(logits):
+    """Two-stage argmax over the vocab dim. XLA lowers a flat argmax over
+    ~50K lanes to an iota+reduce running at ~11 GB/s (0.15 ms/step in the
+    r5 decode profile); reducing lane-blocks first then the tiny block
+    axis is ~50x faster. First-occurrence tie-breaking matches
+    jnp.argmax: the first block holding the global max wins, then the
+    first lane within it."""
+    v = logits.shape[-1]
+    if v % 128 or v < 4096:
+        return jnp.argmax(logits, axis=-1)
+    lb = logits.reshape(logits.shape[:-1] + (v // 128, 128))
+    bmax = jnp.max(lb, axis=-1)
+    bidx = jnp.argmax(lb, axis=-1).astype(jnp.int32)     # (b, v/128)
+    blk = jnp.argmax(bmax, axis=-1).astype(jnp.int32)    # (b,)
+    lane = jnp.take_along_axis(bidx, blk[..., None], axis=-1)[..., 0]
+    return blk * 128 + lane
+
+
 def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
     """logits (b, vocab) → token ids (b,). Greedy when temperature == 0."""
     if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+        return _greedy_argmax(logits)
     logits = logits.astype(jnp.float32) / temperature
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]

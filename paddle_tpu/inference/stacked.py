@@ -159,8 +159,9 @@ class StackedLlamaDecoder:
         on-device copy)."""
         kind = self.head[0]
         if kind == "tied":
+            from paddle_tpu.ops import tied_unembed
             ew = self.embed_w if embed_w is None else embed_w
-            return jnp.dot(xn, ew.T)
+            return tied_unembed(xn, ew)
         ha = tuple(self.head[1:]) if head_arrays is None else head_arrays
         if kind == "int8":
             q, s = ha

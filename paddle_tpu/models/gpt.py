@@ -202,16 +202,16 @@ class GPTPretrainModel(nn.Layer):
         wpe = state["gpt.wpe.weight"]
         lnf_w = state["gpt.ln_f.weight"]
         lnf_b = state["gpt.ln_f.bias"]
-        head_w = (wte.T if cfg.tie_word_embeddings
-                  else state["lm_head.weight"])
-
         def embed(tok, pos):                  # (b,), scalar -> (b, h)
             return jnp.take(wte, tok, axis=0) + wpe[pos]
 
         def head(x):
             xn = _ln(x, (x.shape[-1],), lnf_w, lnf_b,
                      cfg.layer_norm_epsilon)
-            return jnp.dot(xn, head_w)
+            if cfg.tie_word_embeddings:
+                from paddle_tpu.ops import tied_unembed
+                return tied_unembed(xn, wte)
+            return jnp.dot(xn, state["lm_head.weight"])
 
         return dict(meta, params=params, embed=embed, head=head)
 

@@ -491,7 +491,8 @@ class LlamaForCausalLM(CausalLMBase):
             return jnp.take(embed_w, tok, axis=0)
 
         if cfg.tie_word_embeddings:
-            head_mm = lambda xn: jnp.dot(xn, embed_w.T)
+            from paddle_tpu.ops import tied_unembed
+            head_mm = lambda xn: tied_unembed(xn, embed_w)
         elif int8 and "lm_head.weight_q" in state:
             from paddle_tpu.quantization import weight_only_linear
             head_mm = lambda xn: weight_only_linear(

@@ -30,3 +30,13 @@ from paddle_tpu.ops import rms_norm  # noqa: F401,E402
 from paddle_tpu.ops import rope  # noqa: F401,E402
 from paddle_tpu.ops.rope import fused_rotary_position_embedding  # noqa: F401,E402
 from paddle_tpu.ops.flash_attention import flash_attention as flash_attn  # noqa: F401,E402
+
+
+def tied_unembed(x, embed_w):
+    """Unembedding against a TIED embedding table (vocab, h): contract
+    the hidden dim directly — `x @ embed_w.T` materializes a (h, vocab)
+    transposed copy every step (measured 0.12 ms at gpt2-medium decode,
+    r5 profile)."""
+    import jax
+
+    return jax.lax.dot_general(x, embed_w, (((x.ndim - 1,), (1,)), ((), ())))

@@ -225,3 +225,26 @@ def test_deepseek_shared_experts_fused_plan_matches_layered():
         paddle_tpu.set_flags({"FLAGS_fused_decode": True})
     np.testing.assert_array_equal(np.asarray(out_fused),
                                   np.asarray(out_layered))
+
+
+def test_greedy_argmax_matches_flat_argmax():
+    """Two-stage vocab argmax (r5 decode-glue optimization): exact parity
+    with jnp.argmax including first-occurrence tie-breaking."""
+    from paddle_tpu.inference import _greedy_argmax
+
+    r = np.random.RandomState(0)
+    logits = jnp.asarray(r.randn(4, 50304).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(_greedy_argmax(logits)),
+        np.asarray(jnp.argmax(logits, axis=-1)))
+    # ties across blocks AND within a block: first occurrence must win
+    t = np.zeros((3, 4096), np.float32)
+    t[0, [7, 700, 3000]] = 5.0       # cross-block tie
+    t[1, [130, 131]] = 2.0           # in-block tie
+    t[2, :] = 1.0                    # all-equal
+    got = np.asarray(_greedy_argmax(jnp.asarray(t)))
+    np.testing.assert_array_equal(got, np.argmax(t, axis=-1))
+    # non-128-multiple vocab falls back to the flat path
+    small = jnp.asarray(r.randn(2, 1000).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(_greedy_argmax(small)),
+                                  np.asarray(jnp.argmax(small, -1)))
