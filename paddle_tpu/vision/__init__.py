@@ -5,6 +5,7 @@ pretrained backbones (`paddle.vision.models.resnet50`)."""
 
 from paddle_tpu.vision import datasets  # noqa: F401
 from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401  (detection ops)
 from paddle_tpu.vision import transforms  # noqa: F401
 from paddle_tpu.vision.models import (  # noqa: F401
     ResNet,

@@ -1,0 +1,464 @@
+"""paddle.vision.ops parity — detection-family operators.
+
+Reference: python/paddle/vision/ops.py over phi detection kernels
+(SURVEY.md §2.7 vision extras). TPU-native shapes: the box math is pure
+jnp (XLA fuses it); `roi_align`/`roi_pool` are bilinear/max gathers with
+static sampling grids (MXU-free, bandwidth-bound — the right form for
+TPU); `nms` follows the same eager-outside-jit contract as
+`tensor.unique` (its output length is data-dependent; inside jit the
+reference kernel is equally dynamic). Each op is validated against a
+hand-rolled numpy oracle in tests/test_vision_ops.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["box_area", "box_iou", "nms", "roi_align", "roi_pool",
+           "box_coder", "prior_box", "yolo_box", "deform_conv2d",
+           "DeformConv2D", "distribute_fpn_proposals"]
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import initializer as init
+
+
+def box_area(boxes):
+    """(N, 4) [x1, y1, x2, y2] → (N,) areas."""
+    boxes = jnp.asarray(boxes)
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU: (N, 4), (M, 4) → (N, M)."""
+    boxes1 = jnp.asarray(boxes1)
+    boxes2 = jnp.asarray(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(boxes1)[:, None] + box_area(boxes2)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """paddle.vision.ops.nms: greedy non-maximum suppression.
+
+    Data-dependent output length → runs the greedy loop with a FIXED
+    N-iteration lax.fori_loop over a suppression mask (jit-compatible
+    core), then compacts eagerly. With `category_idxs`, suppression is
+    per category (batched-NMS offset trick)."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n = boxes.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int64)
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-jnp.asarray(scores, jnp.float32),
+                            stable=True)
+    if category_idxs is not None:
+        # disjoint coordinate offsets per category → one plain NMS
+        cat = jnp.asarray(category_idxs)[order]
+        span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+        shifted = boxes[order] + (cat.astype(jnp.float32)
+                                  * span)[:, None]
+    else:
+        shifted = boxes[order]
+    iou = box_iou(shifted, shifted)
+
+    def body(i, keep):
+        # suppress j > i when iou(i, j) > thr and i itself is kept
+        row = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~row
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return jnp.asarray(kept, jnp.int32)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """paddle.vision.ops.roi_align (NCHW): average of bilinear samples on
+    a static grid per output bin.
+
+    Divergence note: the reference's default sampling_ratio<=0 ADAPTS the
+    grid per RoI (ceil(roi_h/pooled_h) samples) — a data-dependent shape
+    jit cannot express; here the default is a fixed 2 samples/bin (the
+    common configured value). Pass sampling_ratio explicitly for exact
+    parity with a configured reference model. Samples falling more than
+    one pixel outside the image contribute ZERO (reference semantics),
+    nearer out-of-range samples clamp to the border."""
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    nb = boxes.shape[0]
+    # batch index per roi from boxes_num
+    bn = np.asarray(boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: (nb, ph*sr) y coords, (nb, pw*sr) x coords
+    ys = (y1[:, None] + (jnp.arange(ph * sr) + 0.5)[None, :]
+          * (rh[:, None] / (ph * sr)))
+    xs = (x1[:, None] + (jnp.arange(pw * sr) + 0.5)[None, :]
+          * (rw[:, None] / (pw * sr)))
+
+    def bilinear(img, yy, xx):
+        """img (c, h, w); yy (P,), xx (Q,) → (c, P, Q)."""
+        vy = (yy >= -1.0) & (yy <= h)         # ref: >1px outside → 0
+        vx = (xx >= -1.0) & (xx <= w)
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, h - 1)
+        x1_ = jnp.minimum(x0 + 1, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        g = lambda yi, xi: img[:, yi, :][:, :, xi]
+        out = (g(y0, x0) * (1 - wy)[None, :, None] * (1 - wx)[None, None]
+               + g(y1_, x0) * wy[None, :, None] * (1 - wx)[None, None]
+               + g(y0, x1_) * (1 - wy)[None, :, None] * wx[None, None]
+               + g(y1_, x1_) * wy[None, :, None] * wx[None, None])
+        return out * (vy[None, :, None] & vx[None, None]).astype(out.dtype)
+
+    def one(bi, yy, xx):
+        img = x[bi]
+        s = bilinear(img, yy, xx)                    # (c, ph*sr, pw*sr)
+        s = s.reshape(c, ph, sr, pw, sr)
+        return s.mean(axis=(2, 4))
+
+    return jax.vmap(one)(batch_idx, ys, xs)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """paddle.vision.ops.roi_pool (max pooling over quantized bins).
+    Implemented as a dense bin-membership max (TPU-friendly: no dynamic
+    shapes)."""
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    bn = np.asarray(boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+
+    hh = jnp.arange(h)
+    ww = jnp.arange(w)
+
+    def one(bi, x1_, y1_, rw_, rh_):
+        img = x[bi]                                   # (c, h, w)
+        # reference bin boundaries OVERLAP: bin i spans rows
+        # [floor(i·rh/ph), ceil((i+1)·rh/ph)) relative to y1 — a pixel on
+        # a fractional boundary belongs to BOTH adjacent bins
+        bi_ = jnp.arange(ph)[:, None]
+        rel_y = (hh - y1_)[None, :]                   # (1, h)
+        ylo = jnp.floor(bi_ * rh_ / ph)
+        yhi = jnp.ceil((bi_ + 1) * rh_ / ph)
+        ymask = ((rel_y >= ylo) & (rel_y < yhi)
+                 & (rel_y >= 0) & (rel_y < rh_))      # (ph, h)
+        bj = jnp.arange(pw)[:, None]
+        rel_x = (ww - x1_)[None, :]
+        xlo = jnp.floor(bj * rw_ / pw)
+        xhi = jnp.ceil((bj + 1) * rw_ / pw)
+        xmask = ((rel_x >= xlo) & (rel_x < xhi)
+                 & (rel_x >= 0) & (rel_x < rw_))      # (pw, w)
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]
+        vals = jnp.where(m[None], img[:, None, None], -jnp.inf)
+        out = vals.max(axis=(3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(batch_idx, x1, y1, rw, rh)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    """paddle.vision.ops.box_coder: encode/decode boxes against priors."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    var = (jnp.asarray(prior_box_var, jnp.float32)
+           if prior_box_var is not None else None)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    phh = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + phh * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / phh,
+                         jnp.log(tw / pw), jnp.log(th / phh)], axis=1)
+        if var is not None:
+            out = out / var
+        return out
+    # decode: target (N, 4) deltas against priors
+    d = tb * var if var is not None else tb
+    cx = d[:, 0] * pw + pcx
+    cy = d[:, 1] * phh + pcy
+    bw = jnp.exp(d[:, 2]) * pw
+    bh = jnp.exp(d[:, 3]) * phh
+    return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                      cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    """paddle.vision.ops.prior_box (SSD anchors). input (n, c, h, w),
+    image (n, c, ih, iw) → (h, w, num_priors, 4), (h, w, num_priors, 4)."""
+    h, w = jnp.asarray(input).shape[2:]
+    ih, iw = jnp.asarray(image).shape[2:]
+    sw = steps[0] or iw / w
+    sh = steps[1] or ih / h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            f"prior_box: max_sizes ({len(max_sizes)}) must pair 1:1 with "
+            f"min_sizes ({len(min_sizes)}) — the reference zips them")
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[i]               # paired, not cross-product
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = np.asarray(whs, np.float32)                 # (np_, 2)
+    cx = (np.arange(w) + offset) * sw
+    cy = (np.arange(h) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)                    # (h, w)
+    n_p = whs.shape[0]
+    out = np.zeros((h, w, n_p, 4), np.float32)
+    out[..., 0] = (cxg[:, :, None] - whs[None, None, :, 0] / 2) / iw
+    out[..., 1] = (cyg[:, :, None] - whs[None, None, :, 1] / 2) / ih
+    out[..., 2] = (cxg[:, :, None] + whs[None, None, :, 0] / 2) / iw
+    out[..., 3] = (cyg[:, :, None] + whs[None, None, :, 1] / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0):
+    """paddle.vision.ops.yolo_box: decode YOLOv3 head outputs.
+    x (n, an*(5+cls), h, w) → (boxes (n, h*w*an, 4),
+    scores (n, h*w*an, cls))."""
+    x = jnp.asarray(x, jnp.float32)
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(an, 2))
+    p = x.reshape(n, an, 5 + class_num, h, w)
+    gx = (jnp.arange(w) + 0.0)[None, None, None, :]
+    gy = (jnp.arange(h) + 0.0)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (gx + sig(p[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) * 0.5) / w
+    by = (gy + sig(p[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) * 0.5) / h
+    in_w = w * downsample_ratio
+    in_h = h * downsample_ratio
+    bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+    conf = sig(p[:, :, 4])
+    cls = sig(p[:, :, 5:]) * conf[:, :, None]
+    img_size = jnp.asarray(img_size, jnp.float32)      # (n, 2) [h, w]
+    imh = img_size[:, 0][:, None, None, None]
+    imw = img_size[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, imw - 1)
+        y2 = jnp.minimum(y2, imh - 1)
+    # ANCHOR-MAJOR flatten (reference kernel layout: idx = a·h·w + r·w + c)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # (n, an, h, w, 4)
+    boxes = boxes.reshape(n, -1, 4)
+    # mask out low-confidence predictions like the reference (zeroed)
+    keep = (conf > conf_thresh)
+    cls = jnp.where(keep[:, :, None], cls, 0.0)        # (n, an, cls, h, w)
+    scores = cls.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    boxes = boxes * (scores.sum(-1, keepdims=True) > 0)
+    return boxes, scores
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """paddle.vision.ops.deform_conv2d (DCNv1; DCNv2 with `mask`):
+    bilinear-sample the input at offset positions, then a dense matmul —
+    the gather+MXU form TPU wants. x (n, cin, h, w); offset
+    (n, 2*dg*kh*kw, oh, ow); weight (cout, cin/groups, kh, kw)."""
+    x = jnp.asarray(x)
+    offset = jnp.asarray(offset, jnp.float32)
+    weight = jnp.asarray(weight)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pa = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    di = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    oh = (h + 2 * pa[0] - di[0] * (kh - 1) - 1) // st[0] + 1
+    ow = (w + 2 * pa[1] - di[1] * (kw - 1) - 1) // st[1] + 1
+    dg = deformable_groups
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pa[0], pa[0]), (pa[1], pa[1])))
+    hp, wp = xp.shape[2:]
+    # sampling positions: (oh, ow, kh, kw)
+    gy = (jnp.arange(oh) * st[0])[:, None, None, None] + \
+        (jnp.arange(kh) * di[0])[None, None, :, None]
+    gx = (jnp.arange(ow) * st[1])[None, :, None, None] + \
+        (jnp.arange(kw) * di[1])[None, None, None, :]
+    off = offset.reshape(n, dg, kh, kw, 2, oh, ow)
+    oy = off[:, :, :, :, 0].transpose(0, 1, 4, 5, 2, 3)  # (n,dg,oh,ow,kh,kw)
+    ox = off[:, :, :, :, 1].transpose(0, 1, 4, 5, 2, 3)
+    py = gy[None, None].astype(jnp.float32) + oy
+    px = gx[None, None].astype(jnp.float32) + ox
+    if mask is not None:
+        mk = jnp.asarray(mask, jnp.float32).reshape(
+            n, dg, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+    else:
+        mk = None
+
+    cpg = cin // dg         # channels per deformable group
+
+    def sample_group(xg, pyg, pxg, mg):
+        """xg (cpg, hp, wp); pyg/pxg (oh, ow, kh, kw) → (cpg, oh, ow, kh, kw)."""
+        yc = jnp.clip(pyg, 0.0, hp - 1.0)
+        xc = jnp.clip(pxg, 0.0, wp - 1.0)
+        valid = ((pyg > -1.0) & (pyg < hp) & (pxg > -1.0) & (pxg < wp))
+        y0 = jnp.floor(yc).astype(jnp.int32)
+        x0 = jnp.floor(xc).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, hp - 1)
+        x1 = jnp.minimum(x0 + 1, wp - 1)
+        wy = yc - y0
+        wx = xc - x0
+        flat = xg.reshape(cpg, -1)
+        g = lambda yi, xi: flat[:, (yi * wp + xi).reshape(-1)].reshape(
+            (cpg,) + yi.shape)
+        v = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+             + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+        v = v * valid
+        if mg is not None:
+            v = v * mg
+        return v
+
+    def one(xi, pyi, pxi, mi):
+        """xi (cin, hp, wp) one image; vmapped over the batch (a python
+        loop would unroll n copies of the gather graph)."""
+        groups_out = []
+        for gidx in range(dg):
+            mg = mi[gidx] if mi is not None else None
+            groups_out.append(sample_group(
+                xi[gidx * cpg:(gidx + 1) * cpg], pyi[gidx], pxi[gidx],
+                mg))
+        return jnp.concatenate(groups_out, axis=0)  # (cin, oh, ow, kh, kw)
+
+    if mk is not None:
+        cols = jax.vmap(one)(xp, py, px, mk)
+    else:
+        cols = jax.vmap(lambda a, b, c: one(a, b, c, None))(xp, py, px)
+    # (n, cin, oh, ow, kh, kw) @ weight (cout, cin/groups, kh, kw)
+    if groups == 1:
+        out = jnp.einsum("nchwyx,ocyx->nohw", cols, weight)
+    else:
+        cg = cin // groups
+        og = cout // groups
+        outs = []
+        for gi in range(groups):
+            outs.append(jnp.einsum(
+                "nchwyx,ocyx->nohw",
+                cols[:, gi * cg:(gi + 1) * cg],
+                weight[gi * og:(gi + 1) * og]))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + jnp.asarray(bias)[None, :, None, None]
+    return out
+
+
+class DeformConv2D(Layer):
+    """paddle.vision.ops.DeformConv2D (layer form of deform_conv2d)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        w_init = weight_attr or init.XavierNormal()
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            default_initializer=w_init, dtype="float32")
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), default_initializer=init.Constant(0.0),
+                dtype="float32", is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        bias = self._parameters.get("bias")
+        return deform_conv2d(
+            x, offset, self.weight,
+            bias.value if bias is not None else None,
+            stride=self.stride, padding=self.padding,
+            dilation=self.dilation,
+            deformable_groups=self.deformable_groups, groups=self.groups,
+            mask=mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """paddle.vision.ops.distribute_fpn_proposals: route each RoI to an
+    FPN level by its scale. Eager (data-dependent split sizes)."""
+    rois = np.asarray(fpn_rois, np.float32)
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(rois_num)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+    outs, idxs, nums = [], [], [] if rois_num is not None else None
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == level)[0]
+        outs.append(jnp.asarray(rois[sel]))
+        idxs.append(sel)
+        if rois_num is not None:
+            # per-IMAGE counts at this level (the reference's rois_num
+            # output is (batch,) per level, not a single total)
+            nums.append(jnp.asarray(
+                np.bincount(img_of[sel], minlength=len(rn)), np.int32))
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    return outs, jnp.asarray(restore, jnp.int32), nums

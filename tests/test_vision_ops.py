@@ -1,0 +1,223 @@
+"""paddle.vision.ops parity (round 5) — numpy oracles.
+
+Reference: python/paddle/vision/ops.py over phi detection kernels
+(SURVEY.md §2.7 vision extras)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import ops as V
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a = ((boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+                 + (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+                 - inter)
+            if inter / max(a, 1e-10) > thr:
+                sup[j] = True
+    return np.asarray(keep)
+
+
+def test_nms_matches_numpy_oracle():
+    r = np.random.RandomState(0)
+    boxes = np.abs(r.randn(40, 2)) * 10
+    boxes = np.concatenate([boxes, boxes + np.abs(r.randn(40, 2)) * 10 + 1],
+                           axis=1).astype(np.float32)
+    scores = r.rand(40).astype(np.float32)
+    got = np.asarray(V.nms(boxes, 0.4, scores=scores))
+    ref = _np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(got, ref)
+    # top_k truncation + unscored (index order) variant
+    np.testing.assert_array_equal(np.asarray(V.nms(boxes, 0.4,
+                                                   scores=scores, top_k=5)),
+                                  ref[:5])
+    got2 = np.asarray(V.nms(boxes, 0.4))
+    ref2 = _np_nms(boxes, -np.arange(40, dtype=np.float32), 0.4)
+    np.testing.assert_array_equal(got2, ref2)
+
+
+def test_nms_per_category_never_crosses():
+    r = np.random.RandomState(1)
+    base = np.array([[0, 0, 10, 10]], np.float32)
+    boxes = np.concatenate([base, base + 0.1], axis=0)   # near-identical
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    kept = np.asarray(V.nms(boxes, 0.3, scores=scores,
+                            category_idxs=cats, categories=[0, 1]))
+    assert set(kept.tolist()) == {0, 1}      # different class: both kept
+    kept_same = np.asarray(V.nms(boxes, 0.3, scores=scores))
+    assert kept_same.tolist() == [0]         # same class: one suppressed
+
+
+def test_box_iou_and_area():
+    a = jnp.asarray([[0., 0., 2., 2.]])
+    b = jnp.asarray([[1., 1., 3., 3.], [4., 4., 5., 5.]])
+    iou = np.asarray(V.box_iou(a, b))
+    np.testing.assert_allclose(iou, [[1.0 / 7.0, 0.0]], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(V.box_area(b)), [4.0, 1.0])
+
+
+def test_roi_align_constant_and_linear():
+    # constant image: every bin averages to the constant
+    x = jnp.full((1, 3, 16, 16), 5.0)
+    boxes = jnp.asarray([[2.0, 2.0, 10.0, 10.0]])
+    out = V.roi_align(x, boxes, [1], 4, spatial_scale=1.0)
+    assert out.shape == (1, 3, 4, 4)
+    np.testing.assert_allclose(np.asarray(out), 5.0, rtol=1e-6)
+    # linear-in-x image: bin centers reproduce the linear ramp exactly
+    ramp = jnp.broadcast_to(jnp.arange(16.0)[None, None, None, :],
+                            (1, 1, 16, 16))
+    out = np.asarray(V.roi_align(ramp, boxes, [1], 4, sampling_ratio=2))
+    xs = 2.0 + (np.arange(8) + 0.5) * 1.0 - 0.5      # sample cols
+    expect = xs.reshape(4, 2).mean(-1)
+    np.testing.assert_allclose(out[0, 0, 0], expect, rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 3, 3].set(9.0)
+    boxes = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
+    out = np.asarray(V.roi_pool(x, boxes, [1], 2))
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 9.0            # peak lands in bin (0,0)
+    assert out.sum() == 9.0
+
+
+def test_roi_pool_overlapping_bin_boundaries():
+    """Reference floor/ceil bin bounds OVERLAP when the RoI size is not
+    divisible by output_size: the boundary pixel belongs to BOTH bins."""
+    x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 2, 2].set(9.0)
+    boxes = jnp.asarray([[0.0, 0.0, 4.0, 4.0]])      # rh = rw = 5
+    out = np.asarray(V.roi_pool(x, boxes, [1], 2))
+    # row/col 2 sits on the fractional boundary (5/2): all four bins
+    # include it — the reference returns 9 everywhere
+    np.testing.assert_allclose(out[0, 0], 9.0)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    r = np.random.RandomState(0)
+    priors = np.abs(r.rand(10, 2) * 50)
+    priors = np.concatenate([priors, priors + r.rand(10, 2) * 20 + 5],
+                            axis=1).astype(np.float32)
+    targets = priors + r.randn(10, 4).astype(np.float32)
+    var = np.full((10, 4), 0.5, np.float32)
+    enc = V.box_coder(priors, var, targets, "encode_center_size")
+    dec = V.box_coder(priors, var, np.asarray(enc), "decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec), targets, rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    feat = jnp.zeros((1, 8, 4, 4))
+    img = jnp.zeros((1, 3, 64, 64))
+    boxes, var = V.prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+    # priors per cell: 1 (ar=1) + 2 (ar=2 flipped) + 1 (max_size) = 4
+    assert boxes.shape == (4, 4, 4, 4) and var.shape == boxes.shape
+    # multi-scale: max_sizes pair 1:1 with min_sizes (reference zips);
+    # 2 min · (1 + 2 ars) + 2 paired max = 8 priors per cell
+    b2, _ = V.prior_box(feat, img, min_sizes=[16.0, 32.0],
+                        max_sizes=[32.0, 64.0], aspect_ratios=[2.0],
+                        flip=True)
+    assert b2.shape == (4, 4, 8, 4)
+    with pytest.raises(ValueError):
+        V.prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0, 64.0])
+    b = np.asarray(boxes)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    # center of cell (0,0) is at 8/64
+    np.testing.assert_allclose((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2, 0.125,
+                               atol=1e-6)
+
+
+def test_yolo_box_decodes_center_cell():
+    n, an, cls, h, w = 1, 1, 2, 2, 2
+    x = np.zeros((n, an * (5 + cls), h, w), np.float32)
+    x[0, 4] = 8.0                                 # conf ≈ 1
+    x[0, 5] = 8.0                                 # class0 ≈ 1
+    boxes, scores = V.yolo_box(x, np.asarray([[64.0, 64.0]]),
+                               anchors=[16, 16], class_num=cls,
+                               conf_thresh=0.5, downsample_ratio=32)
+    assert boxes.shape == (1, 4, 4) and scores.shape == (1, 4, 2)
+    b = np.asarray(boxes)[0, 0]
+    # cell (0,0): center (.25,.25)·64 = 16, anchor 16/64·64 = 16 wide
+    np.testing.assert_allclose(b, [8.0, 8.0, 24.0, 24.0], atol=0.5)
+    assert np.asarray(scores)[0, 0, 0] > 0.9
+
+
+def test_yolo_box_anchor_major_layout():
+    """Reference flatten order: idx = anchor·h·w + row·w + col."""
+    n, an, cls, h, w = 1, 2, 1, 2, 2
+    x = np.zeros((n, an * (5 + cls), h, w), np.float32)
+    x[0, 4] = 8.0      # anchor0 conf
+    x[0, 5] = 8.0      # anchor0 class
+    x[0, 10] = 8.0     # anchor1 conf
+    x[0, 11] = 8.0     # anchor1 class
+    boxes, scores = V.yolo_box(x, np.asarray([[64.0, 64.0]]),
+                               anchors=[8, 8, 32, 32], class_num=1,
+                               conf_thresh=0.5, downsample_ratio=32)
+    b = np.asarray(boxes)
+    # entries 0..3 = anchor0 (8px wide), 4..7 = anchor1 (32px wide)
+    np.testing.assert_allclose(b[0, 0, 2] - b[0, 0, 0], 8.0, atol=0.5)
+    np.testing.assert_allclose(b[0, 4, 2] - b[0, 4, 0], 32.0, atol=0.5)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import torch
+    r = np.random.RandomState(0)
+    x = r.randn(1, 4, 8, 8).astype(np.float32)
+    wgt = r.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 8, 8), np.float32)
+    got = np.asarray(V.deform_conv2d(x, off, wgt, stride=1, padding=1))
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(wgt),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+    # DCNv2 mask of 0.5 halves the output
+    mask = np.full((1, 9, 8, 8), 0.5, np.float32)
+    got2 = np.asarray(V.deform_conv2d(x, off, wgt, stride=1, padding=1,
+                                      mask=mask))
+    np.testing.assert_allclose(got2, ref * 0.5, rtol=1e-3, atol=1e-4)
+
+
+def test_deform_conv2d_layer():
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    layer = V.DeformConv2D(4, 6, 3, padding=1)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 4, 6, 6), jnp.float32)
+    off = jnp.zeros((2, 18, 6, 6), jnp.float32)
+    out = layer(x, off)
+    assert out.shape == (2, 6, 6, 6)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_distribute_fpn_proposals_routing_and_restore():
+    rois = np.asarray([[0, 0, 10, 10],        # small → low level
+                       [0, 0, 500, 500],      # large → high level
+                       [0, 0, 100, 100]], np.float32)
+    outs, restore, nums = V.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=np.asarray([3]))
+    total = sum(o.shape[0] for o in outs)
+    assert total == 3
+    cat = np.concatenate([np.asarray(o) for o in outs if o.shape[0]])
+    np.testing.assert_allclose(cat[np.asarray(restore)], rois)
+    # per-IMAGE counts per level (reference rois_num output shape)
+    outs2, _, nums2 = V.distribute_fpn_proposals(
+        np.concatenate([rois, rois]), 2, 5, 4, 224,
+        rois_num=np.asarray([3, 3]))
+    for lv_num, lv_out in zip(nums2, outs2):
+        assert lv_num.shape == (2,)
+        assert int(lv_num.sum()) == lv_out.shape[0]
