@@ -22,4 +22,13 @@ from paddle_tpu.vision.models import (  # noqa: F401
     vgg19,
     MobileNetV2,
     mobilenet_v2,
+    DenseNet,
+    densenet121,
+    densenet161,
+    SqueezeNet,
+    squeezenet1_0,
+    squeezenet1_1,
+    ShuffleNetV2,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
 )

@@ -268,3 +268,225 @@ class MobileNetV2(nn.Layer):
 
 def mobilenet_v2(scale=1.0, num_classes=1000, **kw):
     return MobileNetV2(scale=scale, num_classes=num_classes, **kw)
+
+
+# ---------------------------------------------------------------------------
+# round-5 backbone tail (reference python/paddle/vision/models/{densenet,
+# squeezenet,shufflenetv2}.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth_rate, bn_size):
+        super().__init__()
+        inter = bn_size * growth_rate
+        self.norm1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, inter, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(inter)
+        self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        y = self.conv1(F.relu(self.norm1(x)))
+        y = self.conv2(F.relu(self.norm2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+
+    def forward(self, x):
+        x = self.conv(F.relu(self.norm(x)))
+        return F.avg_pool2d(x, 2, stride=2)
+
+
+class DenseNet(nn.Layer):
+    """Reference: paddle.vision.models.DenseNet (layers=121|161|169|201)."""
+
+    _cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+             169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000):
+        super().__init__()
+        if layers == 161:
+            growth_rate, init_ch = 48, 96
+        else:
+            init_ch = 64
+        blocks = self._cfgs[layers]
+        self.conv0 = nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.norm0 = nn.BatchNorm2D(init_ch)
+        stages = []
+        ch = init_ch
+        for i, n in enumerate(blocks):
+            stage = []
+            for _ in range(n):
+                stage.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            stages.append(nn.Sequential(*stage))
+            if i != len(blocks) - 1:
+                stages.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.features = nn.Sequential(*stages)
+        self.norm5 = nn.BatchNorm2D(ch)
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.norm0(self.conv0(x)))
+        x = F.max_pool2d(x, 3, stride=2, padding=1)
+        x = F.relu(self.norm5(self.features(x)))
+        x = F.adaptive_avg_pool2d(x, 1)
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def densenet121(num_classes=1000, **kw):
+    return DenseNet(121, num_classes=num_classes, **kw)
+
+
+def densenet161(num_classes=1000, **kw):
+    return DenseNet(161, num_classes=num_classes, **kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return jnp.concatenate([F.relu(self.expand1(s)),
+                                F.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference: paddle.vision.models.SqueezeNet (version '1.0'|'1.1')."""
+
+    def __init__(self, version="1.0", num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.version = str(version)
+        if self.version == "1.0":
+            self.conv1 = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [(96, 16, 64, 64), (128, 16, 64, 64),
+                     (128, 32, 128, 128), (256, 32, 128, 128),
+                     (256, 48, 192, 192), (384, 48, 192, 192),
+                     (384, 64, 256, 256), (512, 64, 256, 256)]
+            self.pool_after = (0, 3, 7)     # maxpool after these fires
+        else:
+            self.conv1 = nn.Conv2D(3, 64, 3, stride=2)
+            fires = [(64, 16, 64, 64), (128, 16, 64, 64),
+                     (128, 32, 128, 128), (256, 32, 128, 128),
+                     (256, 48, 192, 192), (384, 48, 192, 192),
+                     (384, 64, 256, 256), (512, 64, 256, 256)]
+            self.pool_after = (1, 3)
+        self.fires = nn.LayerList([_Fire(*f) for f in fires])
+        self.drop = nn.Dropout(dropout)
+        self.final_conv = nn.Conv2D(512, num_classes, 1)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.max_pool2d(x, 3, stride=2)
+        for i, fire in enumerate(self.fires):
+            x = fire(x)
+            if i in self.pool_after:
+                x = F.max_pool2d(x, 3, stride=2)
+        x = F.relu(self.final_conv(self.drop(x)))
+        x = F.adaptive_avg_pool2d(x, 1)
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(num_classes=1000, **kw):
+    return SqueezeNet("1.0", num_classes=num_classes, **kw)
+
+
+def squeezenet1_1(num_classes=1000, **kw):
+    return SqueezeNet("1.1", num_classes=num_classes, **kw)
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    return x.reshape(n, groups, c // groups, h, w).transpose(
+        0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 2:
+            self.b1_dw = nn.Conv2D(in_ch, in_ch, 3, stride=2, padding=1,
+                                   groups=in_ch, bias_attr=False)
+            self.b1_dwbn = nn.BatchNorm2D(in_ch)
+            self.b1_pw = nn.Conv2D(in_ch, branch, 1, bias_attr=False)
+            self.b1_pwbn = nn.BatchNorm2D(branch)
+            in2 = in_ch
+        else:
+            in2 = in_ch // 2
+        self.b2_pw1 = nn.Conv2D(in2, branch, 1, bias_attr=False)
+        self.b2_bn1 = nn.BatchNorm2D(branch)
+        self.b2_dw = nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                               groups=branch, bias_attr=False)
+        self.b2_bn2 = nn.BatchNorm2D(branch)
+        self.b2_pw2 = nn.Conv2D(branch, branch, 1, bias_attr=False)
+        self.b2_bn3 = nn.BatchNorm2D(branch)
+
+    def forward(self, x):
+        if self.stride == 2:
+            left = F.relu(self.b1_pwbn(self.b1_pw(
+                self.b1_dwbn(self.b1_dw(x)))))
+            right = x
+        else:
+            c = x.shape[1] // 2
+            left, right = x[:, :c], x[:, c:]
+        y = F.relu(self.b2_bn1(self.b2_pw1(right)))
+        y = self.b2_bn2(self.b2_dw(y))
+        y = F.relu(self.b2_bn3(self.b2_pw2(y)))
+        out = jnp.concatenate([left, y], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference: paddle.vision.models.ShuffleNetV2 (scale 0.5|1.0|1.5|2.0)."""
+
+    _chs = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+            1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        c2, c3, c4, c5 = self._chs[scale]
+        self.conv1 = nn.Conv2D(3, 24, 3, stride=2, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(24)
+        stages = []
+        in_ch = 24
+        for out_ch, repeat in ((c2, 4), (c3, 8), (c4, 4)):
+            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(out_ch, out_ch, 1))
+            stages.append(nn.Sequential(*units))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = nn.Conv2D(in_ch, c5, 1, bias_attr=False)
+        self.bn5 = nn.BatchNorm2D(c5)
+        self.fc = nn.Linear(c5, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.max_pool2d(x, 3, stride=2, padding=1)
+        x = self.stages(x)
+        x = F.relu(self.bn5(self.conv5(x)))
+        x = F.adaptive_avg_pool2d(x, 1)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def shufflenet_v2_x1_0(num_classes=1000, **kw):
+    return ShuffleNetV2(1.0, num_classes=num_classes, **kw)
+
+
+def shufflenet_v2_x0_5(num_classes=1000, **kw):
+    return ShuffleNetV2(0.5, num_classes=num_classes, **kw)

@@ -73,3 +73,69 @@ def test_transforms_pipeline():
     assert out.shape == (3, 24, 24)
     assert out.dtype == np.float32
     assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_backbone_tail_forward_shapes():
+    """Round-5 backbones (reference paddle.vision.models
+    {densenet,squeezenet,shufflenetv2}): forward shape + param count
+    sanity vs the published sizes."""
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu.vision.models import (densenet121, shufflenet_v2_x0_5,
+                                          squeezenet1_1)
+
+    paddle_tpu.seed(0)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 64, 64),
+                    jnp.float32)
+    d = densenet121(num_classes=10)
+    d.eval()
+    out = d(x)
+    assert out.shape == (1, 10)
+    n = sum(int(np.prod(p.shape)) for _, p in d.named_parameters())
+    # published densenet121 ≈ 7.98M params (at 1000 classes; 10-class
+    # head shrinks the classifier): backbone ≈ 6.95M
+    assert 6.5e6 < n < 8.5e6, n
+
+    s = squeezenet1_1(num_classes=10)
+    s.eval()
+    assert s(x).shape == (1, 10)
+    ns = sum(int(np.prod(p.shape)) for _, p in s.named_parameters())
+    assert 0.7e6 < ns < 1.3e6, ns          # published ≈ 1.24M @1000 cls
+
+    sh = shufflenet_v2_x0_5(num_classes=10)
+    sh.eval()
+    assert sh(x).shape == (1, 10)
+    nsh = sum(int(np.prod(p.shape)) for _, p in sh.named_parameters())
+    assert 0.3e6 < nsh < 1.5e6, nsh        # published ≈ 1.37M @1000 cls
+
+
+def test_backbone_tail_trains_one_step():
+    import numpy as np
+
+    import jax
+    import paddle_tpu
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.vision.models import shufflenet_v2_x0_5
+
+    paddle_tpu.seed(0)
+    m = shufflenet_v2_x0_5(num_classes=4)
+    m.eval()       # BN running-stat updates need the mutable=True
+    # functional_call contract; this smoke trains the weights only
+    state = m.trainable_state()
+    opt = SGD(learning_rate=1e-3)
+    ost = opt.init_state(state)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 32, 32),
+                    jnp.float32)
+    y = jnp.asarray([0, 3])
+
+    def loss_fn(st):
+        from paddle_tpu.nn import functional as F
+        logits = functional_call(m, st, x)
+        return F.cross_entropy(logits, y)
+
+    l0, g = jax.value_and_grad(loss_fn)(state)
+    state2, _ = opt.update(g, ost, state)
+    l1 = loss_fn(state2)
+    assert float(l1) < float(l0)
