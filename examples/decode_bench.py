@@ -16,8 +16,11 @@ achieved decode tokens/s and compares against that roofline:
     roofline tok/s  =  B · HBM_BW / bytes_per_step
 
 Run: python examples/decode_bench.py [--model llama-1b|gpt2-345m]
-[--batch 8] [--int8]. Prints one JSON line; SCALE.md records the
-measured table (fused decode-step kernel, device-clock timing).
+[--batch 8] [--int8] [--cache_int8]. Prints one JSON line; SCALE.md
+records the measured table (fused decode-step kernel, device-clock
+timing). The long-context int8-KV-cache row (cache bytes dominate):
+python examples/decode_bench.py --model llama-345m --prompt_len 2048
+--new_tokens 256 --cache_int8
 """
 
 import argparse
@@ -128,6 +131,12 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 (halves the weight stream — "
                     "the fused_multi_transformer_int8 analog)")
+    ap.add_argument("--cache_int8", action="store_true",
+                    help="int8 KV cache (fused_multi_transformer_int8 "
+                    "cache_kv quant analog): prefill calibrates per-head "
+                    "scales, decode streams int8 KV — the long-context "
+                    "(s >= 2048) row where cache bytes dominate runs "
+                    "--prompt_len 2048 --cache_int8")
     ns = ap.parse_args()
 
     import paddle_tpu
@@ -180,6 +189,11 @@ def main():
     else:
         state = model.trainable_state()
 
+    if ns.cache_int8 and moe:
+        raise SystemExit("--cache_int8 is not supported for MoE decode "
+                         "(the fused MoE kernel streams a bf16 cache)")
+    cache_dtype = jnp.int8 if ns.cache_int8 else jnp.bfloat16
+
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(
         rng.randint(0, cfg.vocab_size, (ns.batch, ns.prompt_len)))
@@ -192,10 +206,11 @@ def main():
     def timed(n_tokens):
         if stacked:
             out = model.generate(prompt, max_new_tokens=n_tokens,
-                                 temperature=0.0)
+                                 temperature=0.0, cache_dtype=cache_dtype)
         else:
             out = generate(model, prompt, max_new_tokens=n_tokens,
-                           temperature=0.0, state=state)
+                           temperature=0.0, state=state,
+                           cache_dtype=cache_dtype)
         return int(out[:, -1].sum())  # sync on dependent value
 
     n_short = max(8, ns.new_tokens // 4)
@@ -268,11 +283,12 @@ def main():
         param_bytes = n_params - embed_params
     else:
         param_bytes = 2 * (n_params - embed_params)
-    step_bytes = param_bytes + ns.batch * kv_bytes_per_token(cfg) * avg_len
+    cache_bytes = kv_bytes_per_token(cfg, 1 if ns.cache_int8 else 2)
+    step_bytes = param_bytes + ns.batch * cache_bytes * avg_len
     bw = HBM_BW.get(dev.device_kind, 819e9 if on_tpu else 50e9)
     roofline_tok_s = ns.batch * bw / step_bytes
 
-    tag = " int8" if ns.int8 else ""
+    tag = (" int8" if ns.int8 else "") + (" kv8" if ns.cache_int8 else "")
     print(json.dumps({
         "metric": f"{name}{tag} decode tokens/s (batch={ns.batch})",
         "value": round(tok_s, 1),

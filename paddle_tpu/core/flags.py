@@ -74,6 +74,7 @@ define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas fusion kernels when on
 define_flag("FLAGS_pallas_strict", False, "Raise (instead of XLA fallback) when a Pallas kernel fails")
 define_flag("FLAGS_fused_decode", True, "Use the fused decode-step path (fused_multi_transformer analog) in generate()")
 define_flag("FLAGS_vmem_mib", 0, "Override the device VMEM capacity (MiB) used for Pallas kernel budgets; 0 = derive from device_kind")
+define_flag("FLAGS_pallas_interpret", False, "Off-TPU, run Pallas kernels in interpret mode instead of the XLA fallback (CPU-CI kernel parity)")
 define_flag("FLAGS_log_level", "INFO", "paddle_tpu logger level")
 define_flag("FLAGS_profile_dir", "", "If set, jax.profiler traces are written here")
 define_flag("FLAGS_benchmark", False, "Print per-step timing")

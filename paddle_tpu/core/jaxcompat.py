@@ -1,0 +1,148 @@
+"""Run the jax-0.9-targeted codebase on older jax (0.4.x).
+
+The package is written against the jax 0.9 public API (``jax.shard_map``
+with ``axis_names=`` partial-manual mode, ``jax.lax.pcast`` vma casts,
+``jax.typeof``, ``jax.set_mesh``, and the renamed Pallas-TPU params
+``pltpu.CompilerParams`` / ``pltpu.MemorySpace``). Containers that ship a
+0.4.x jax lack all of these, so ``install()`` — invoked at the top of
+``paddle_tpu/__init__`` before any submodule touches jax — grafts
+equivalents onto the jax namespace:
+
+* ``jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names={...})``
+  lowers to ``jax.experimental.shard_map.shard_map`` with
+  ``auto = mesh_axes - axis_names`` (0.4.x's partial-auto spelling) and
+  ``check_rep=False`` (0.4.x cannot rep-check partial-auto bodies, and
+  without vma tracking the pcast discipline has nothing to verify).
+* ``jax.lax.pcast(x, axes, to=...)`` becomes the identity: vma ("varying
+  over manual axes") tracking does not exist in 0.4.x, so the casts the
+  0.9 type system requires are vacuous there.
+* ``jax.typeof`` maps to the aval — callers only probe ``.vma`` via
+  ``getattr(..., "vma", ())``, which stays an empty default.
+* ``jax.set_mesh(mesh)`` returns the mesh itself (a context manager in
+  0.4.x); the ambient-abstract-mesh dispatch in ``mp_layers.constrain``
+  already falls back when ``jax.sharding.get_abstract_mesh`` is missing.
+* ``pltpu.CompilerParams`` ← ``pltpu.TPUCompilerParams`` and
+  ``pltpu.MemorySpace`` ← a namespace with ``HBM`` aliased to ``ANY``
+  (0.4.x has no dedicated HBM enum member; ANY keeps a ref off-chip,
+  which is what every use here wants).
+
+Everything is additive: on a jax that already has the 0.9 names,
+``install()`` is a no-op.
+"""
+
+import jax
+
+_ACTIVE = False
+
+
+def active() -> bool:
+    """True when install() had to graft 0.9 names onto an older jax —
+    i.e. this process runs on the 0.4.x compat layer. Tests exercising
+    0.9-only behavior (grad through partial-manual shard_map, vma-typed
+    cond branches) skip on it."""
+    return _ACTIVE
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None, **kw):
+        # 0.9's partial-manual (axis_names ⊂ mesh axes) maps to 0.4.x's
+        # `auto=` — but 0.4.x partial-auto lowers axis_index/ppermute
+        # through a PartitionId instruction XLA:CPU's SPMD partitioner
+        # rejects. Run FULLY manual instead: specs already name every
+        # axis the body's collectives use, and unnamed axes degrade to
+        # manual replication — correct, merely forgoing auto-axis
+        # parallelism on old-jax installs. check_rep=True engages 0.4.x's
+        # replication-tracking rewrite, which grad-through-shard_map
+        # needs (with check_rep=False, device-varying SCALAR residuals of
+        # the backward have no concatenable out_spec and trace fails).
+        del axis_names
+        return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=True)
+
+    jax.shard_map = shard_map
+
+    # 0.4.x's replication checker has no rules for a few identity-like
+    # primitives the codebase traces through (checkpoint_name's `name`).
+    # They forward their operand's replication unchanged.
+    try:
+        from jax.experimental import shard_map as _sm
+        from jax._src.ad_checkpoint import name_p
+        if name_p not in _sm._check_rules:
+            _sm.register_standard_check(name_p)
+            _sm.register_standard_rewrite(name_p)
+    except Exception:
+        pass
+
+
+def _install_lax_names():
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axes=None, to=None):
+            # to="varying" maps to 0.4.x shard_map's pbroadcast (the
+            # physical no-op that demotes "replicated over axes" to
+            # "varying" in the replication checker). Outside a shard_map
+            # trace — or for axes not in scope — it is the identity.
+            axes = (axes,) if isinstance(axes, str) else tuple(axes or ())
+            try:
+                from jax._src import core as _core
+                env = _core.get_axis_env()
+                axes = tuple(a for a in axes if env.axis_exists(a))
+                if not axes or to != "varying":
+                    return x
+                from jax.experimental.shard_map import pbroadcast
+                return jax.tree.map(lambda t: pbroadcast(t, axes), x)
+            except Exception:
+                return x
+        jax.lax.pcast = pcast
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(name):
+            from jax._src import core as _core
+            return _core.get_axis_env().axis_size(name)
+        jax.lax.axis_size = axis_size
+    if not hasattr(jax, "typeof"):
+        def typeof(x):
+            from jax import core
+            return core.get_aval(x)
+        jax.typeof = typeof
+    if not hasattr(jax, "set_mesh"):
+        import contextlib
+
+        def set_mesh(mesh):
+            # concrete Mesh is already a context manager in 0.4.x;
+            # anything else (None / abstract) gets a null context
+            if hasattr(mesh, "__enter__"):
+                return mesh
+            return contextlib.nullcontext(mesh)
+        jax.set_mesh = set_mesh
+
+
+def _install_pallas_names():
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:       # pallas not importable on this platform
+        return
+    if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+    if not hasattr(pltpu, "MemorySpace") and hasattr(pltpu, "TPUMemorySpace"):
+        ms = pltpu.TPUMemorySpace
+
+        class MemorySpace:
+            ANY = ms.ANY
+            HBM = ms.ANY
+            VMEM = ms.VMEM
+            SMEM = ms.SMEM
+        pltpu.MemorySpace = MemorySpace
+
+
+def install():
+    global _ACTIVE
+    if not hasattr(jax, "shard_map"):
+        _ACTIVE = True
+    _install_shard_map()
+    _install_lax_names()
+    _install_pallas_names()

@@ -76,6 +76,13 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     The whole decode loop runs as ONE jitted lax.scan (a single device
     dispatch — the fused_multi_transformer-style decode path); after an eos
     every subsequent token of that row is emitted as eos.
+
+    cache_dtype=jnp.int8 enables the int8 KV-cache decode mode (the
+    fused_multi_transformer_int8 cache_kv quant analog): prefill runs in
+    bf16 and acts as the calibration pass, the stacked cache is quantized
+    with per-(layer, kv-head) scales, and every decode step streams int8
+    KV + dequantizes on the compute path. Requires the fused decode plan
+    (llama/gpt archs).
     """
     from paddle_tpu.core.flags import flag
 
@@ -83,6 +90,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
     state = state if state is not None else _inference_state(model)
+    kv_int8 = jnp.dtype(cache_dtype) == jnp.int8
     # fused decode path (ops.fused_decode, the fused_multi_transformer
     # analog): whole decoder stack per step in one Pallas call on TPU /
     # one stacked jnp program elsewhere. The cache length is padded to the
@@ -92,9 +100,17 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             and hasattr(model, "fused_decode_plan") else None)
     if plan is not None and b > plan.get("max_batch", b):
         plan = None     # e.g. MoE no-drop bound b ≤ per-expert capacity
+    if kv_int8 and (plan is None or plan.get("arch") == "moe"):
+        raise ValueError(
+            "cache_dtype=int8 requires the fused decode path (llama/gpt "
+            "archs with an eligible fused_decode_plan); this model/config "
+            "cannot ride it")
     if plan is not None:
         total = -(-total // 128) * 128
-    cache = model.init_cache(b, total, dtype=cache_dtype)
+    # int8 mode prefills through the layered path in bf16 (the
+    # calibration pass); the cache is quantized after stacking
+    cache = model.init_cache(
+        b, total, dtype=jnp.bfloat16 if kv_int8 else cache_dtype)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
     # One decode program per static configuration, cached on the model so
@@ -109,7 +125,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     run = jit_cache.get(jit_key)
     if run is None and plan is not None:
         from paddle_tpu.ops import rope as rope_ops
-        from paddle_tpu.ops.fused_decode import fused_decode_step
+        from paddle_tpu.ops.fused_decode import (fused_decode_step,
+                                                 quantize_kv_cache)
 
         cos_tab, sin_tab = rope_ops.rope_cos_sin(
             total, plan["head_dim"], base=plan["rope_base"])
@@ -125,6 +142,11 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             kv = jnp.stack([jnp.concatenate(
                 [c["k"].reshape(b, total, -1), c["v"].reshape(b, total, -1)],
                 axis=-1) for c in cache])
+            if kv_int8:     # prefill was the calibration pass
+                kv, kv_scales = quantize_kv_cache(
+                    kv, plan_t["num_kv_heads"])
+            else:
+                kv_scales = None
             key, k0 = jax.random.split(key)
             tok = _sample_logits(out[:, -1, :], k0, temperature, top_k,
                                  top_p)
@@ -138,6 +160,9 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                 x = plan_t["embed"](tok, pos)
                 cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
                 sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
+                blocks = plan_t.get("blocks")
+                if kv_int8 and blocks is not None:
+                    blocks = dict(blocks, cache_wbytes=1)
                 x, kv = fused_decode_step(
                     x, plan_t["params"], kv, pos, cos, sin,
                     num_heads=plan_t["num_heads"],
@@ -145,7 +170,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                     rope_base=plan_t["rope_base"],
                     arch=plan_t.get("arch", "llama"),
                     top_k=plan_t.get("top_k", 2),
-                    blocks=plan_t.get("blocks"))
+                    blocks=blocks, kv_scales=kv_scales)
                 nxt = _sample_logits(plan_t["head"](x), ki, temperature,
                                      top_k, top_p)
                 nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)

@@ -245,13 +245,21 @@ class StackedLlamaDecoder:
                  top_p: float = 1.0, seed: int = 0,
                  cache_dtype=jnp.bfloat16):
         """Prefill + fused-kernel decode, the whole loop one jitted scan.
-        Returns (b, prompt+new) ids including the prompt."""
+        Returns (b, prompt+new) ids including the prompt.
+
+        cache_dtype=jnp.int8 decodes against an int8 KV cache: prefill
+        runs bf16 (the calibration pass), the cache is quantized with
+        per-(layer, kv-head) scales (ops.fused_decode.quantize_kv_cache)
+        and the fused kernel streams int8 KV chunks — halving the
+        per-step cache DMA, the long-context (s >= 2048) decode regime
+        where cache bytes dominate the roofline."""
         from paddle_tpu.inference import _sample_logits
 
         input_ids = jnp.asarray(input_ids)
         b, prompt_len = input_ids.shape
         total = -(-(prompt_len + max_new_tokens) // 128) * 128
         cfg = self.cfg
+        kv_int8 = jnp.dtype(cache_dtype) == jnp.int8
         key0 = jax.random.PRNGKey(seed)
         jk = (b, prompt_len, max_new_tokens, float(temperature), int(top_k),
               float(top_p), jnp.dtype(cache_dtype).name)
@@ -259,10 +267,18 @@ class StackedLlamaDecoder:
         if run is None:
             cos_tab, sin_tab = rope_cos_sin(total, cfg.head_dim,
                                             base=cfg.rope_base)
+            blocks = (dict(self.blocks, cache_wbytes=1) if kv_int8
+                      else self.blocks)
 
             def run_impl(params, embed_w, norm_w, head_arrays, ids, key):
-                x, kv = self.prefill(params, ids, total, cache_dtype,
-                                     embed_w=embed_w)
+                x, kv = self.prefill(
+                    params, ids, total,
+                    jnp.bfloat16 if kv_int8 else cache_dtype,
+                    embed_w=embed_w)
+                if kv_int8:
+                    kv, kv_scales = fd.quantize_kv_cache(kv, cfg.kv_heads)
+                else:
+                    kv_scales = None
                 key, k0 = jax.random.split(key)
 
                 def logits(x):
@@ -283,7 +299,7 @@ class StackedLlamaDecoder:
                         x, params, kv, pos, cos, sin,
                         num_heads=cfg.num_heads, num_kv_heads=cfg.kv_heads,
                         eps=cfg.rms_norm_eps, rope_base=cfg.rope_base,
-                        blocks=self.blocks)
+                        blocks=blocks, kv_scales=kv_scales)
                     nxt = _sample_logits(logits(x), ki, temperature, top_k,
                                          top_p)
                     return (nxt, kv, key), nxt

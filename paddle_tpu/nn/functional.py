@@ -471,6 +471,11 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     cross-attention (sq != sk) and the structured-mask extensions
     `kv_lens` / `segment_ids` / `window_size` / `alibi_slopes` (see
     ops.flash_attention).
+
+    Float `attn_mask` entries ≤ −1e9 mean "fully masked" on the Pallas
+    path (whole blocks below the threshold are skipped); keep finite soft
+    penalties well above −1e9 or the Pallas and XLA paths diverge — see
+    ops.flash_attention.scaled_dot_product_attention for details.
     """
     from paddle_tpu.ops import flash_attention as fa
     return fa.scaled_dot_product_attention(

@@ -169,6 +169,16 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     fp32 — ALiBi linear bias, score += slope·(k_pos − q_pos)). All run
     inside the Pallas kernels forward AND backward; on other backends
     they lower to dense masks/bias on the XLA path.
+
+    Float `attn_mask` caveat — the ≤ −1e9 "effectively masked" threshold:
+    the Pallas path treats additive-mask entries ≤ −1e9 as FULLY masked
+    (`_mask_block_bounds` skips blocks whose entries are all below it, and
+    such scores never survive the online softmax). Use ≤ −1e9 (or −inf)
+    to mean "masked", and keep finite soft penalties (score biases you
+    want softmax to weigh) well above it — a penalty at or below the
+    threshold is dropped exactly on the Pallas path but only
+    exponentially suppressed on the XLA path, so the two backends would
+    silently diverge.
     """
     from paddle_tpu.ops import use_pallas
     seg_q = segment_ids
@@ -904,6 +914,24 @@ def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k,
     dummy_mk = mask if flags[3] else jnp.zeros((1, 1, 1, 1), jnp.int8)
     if flags[4]:
         from paddle_tpu.core import rng as _rng
+        if not _rng.has_rng("dropout"):
+            # Under jit tracing with no bound stream the fallback key
+            # would be baked into the executable as a CONSTANT: every call
+            # of the compiled function reapplies the exact same dropout
+            # mask — silently biased training. Unlike the eager-friendly
+            # warning in next_rng_key, in-kernel dropout refuses to trace.
+            try:
+                from jax._src import core as _core
+                traced = not _core.trace_state_clean()
+            except ImportError:
+                traced = False
+            if traced:
+                raise RuntimeError(
+                    "flash_attention dropout under jit with no bound "
+                    "'dropout' rng stream: the kernel seed would become a "
+                    "compile-time constant, reusing one dropout mask for "
+                    "every call. Bind a stream with rng_guard(dropout=key)"
+                    " or functional_call(..., rngs={'dropout': key}).")
         seed = jax.random.randint(_rng.next_rng_key("dropout"),
                                   (1,), -2 ** 31, 2 ** 31 - 1, jnp.int32)
     else:

@@ -120,7 +120,8 @@ def _step_penalty(w_step):
 
 
 def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
-                      wbytes: int, q_split: Optional[int] = None) -> Dict:
+                      wbytes: int, q_split: Optional[int] = None,
+                      cache_wbytes: int = 2) -> Dict:
     """Joint plan for the fused decode kernel's weight streaming.
 
     At 7B scale (h=4096) the attention weights alone (wqkv 50 MiB + wo
@@ -132,9 +133,13 @@ def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
     128-multiple — SwiGLU pad columns contribute silu(0)*0 = 0 exactly),
     minimizing streamed bytes + grid-step overhead.
 
-    Returns {"q_split", "qblk", "ffn_blocks", "fblk", "ffn_pad"} where
-    ffn_pad >= ffn is the padded column count build_fused_params must
-    produce. `q_split` forces the split (tests).
+    Returns {"q_split", "qblk", "ffn_blocks", "fblk", "ffn_pad",
+    "cache_wbytes"} where ffn_pad >= ffn is the padded column count
+    build_fused_params must produce. `q_split` forces the split (tests).
+    `cache_wbytes` records the KV-cache element size this plan assumed
+    (1 = int8 cache mode); the kernel sizes its chunk scratch from the
+    actual cache dtype and ASSERTS it agrees with the plan, so a stale
+    bf16 plan can't silently drive an int8-cache decode (or vice versa).
     """
     budget = _vmem_budget_bytes()
     half = max((budget - 8 * 2 ** 20) // 2, 2 ** 20)
@@ -201,7 +206,7 @@ def decode_block_plan(h: int, dqkv: int, dq: int, hd: int, ffn: int,
         best = (0, qs, hd, jn, fblk, jn * fblk)
     _, qs, qblk, jn, fblk, pad = best
     return {"q_split": qs, "qblk": qblk, "ffn_blocks": jn, "fblk": fblk,
-            "ffn_pad": pad}
+            "ffn_pad": pad, "cache_wbytes": cache_wbytes}
 
 
 def _pad_ffn(stacks: Dict[str, jax.Array], ffn_pad: int):
@@ -344,6 +349,29 @@ def build_fused_params_moe(state: Dict[str, jax.Array], num_layers: int,
     return {k: jnp.stack(v) for k, v in cols.items()}
 
 
+def quantize_kv_cache(kv, num_kv_heads: int):
+    """Quantize a combined flat KV cache (L, b, S, 2*nkv*hd) to int8 with
+    per-(layer, kv-head) symmetric scales — the fused_multi_transformer_int8
+    cache_kv quant analog, calibrated from the cache contents themselves
+    (prefill acts as the calibration pass; decode-appended tokens reuse the
+    same static scales and clip outliers).
+
+    Returns (cache int8, scales (L, 1, 2*nkv*hd) fp32) — the scales are
+    lane-replicated across each head's hd lanes so both the kernel and the
+    jnp reference can apply them with a single broadcast multiply (k-half
+    scales fold into the q rows, v-half scales apply to the attention
+    output)."""
+    L, b, S, dkv2 = kv.shape
+    hd = dkv2 // (2 * num_kv_heads)
+    amax = jnp.abs(kv.astype(jnp.float32)).max(axis=(1, 2))     # (L, 2dkv)
+    amax = amax.reshape(L, 2 * num_kv_heads, hd).max(axis=-1)   # (L, 2nkv)
+    scales = jnp.maximum(amax / 127.0, 1e-8)
+    lanes = jnp.repeat(scales, hd, axis=-1)[:, None, :]         # (L,1,2dkv)
+    q = jnp.clip(jnp.round(kv.astype(jnp.float32) / lanes[:, None]),
+                 -127, 127)
+    return q.astype(jnp.int8), lanes
+
+
 def _layernorm(x, w, b, eps):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
@@ -376,7 +404,7 @@ def _rope1(x, cos, sin):
 def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
                            num_heads: int, num_kv_heads: int,
                            eps: float = 1e-5, arch: str = "llama",
-                           top_k: int = 2):
+                           top_k: int = 2, kv_scales=None):
     """One decode step through the whole stack; pure jnp.
 
     x (b, h); the KV cache is stored COMBINED and FLAT as
@@ -386,6 +414,10 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
     `pos`. Returns (x_out (b, h), kv_cache). Matches the Pallas kernel up
     to XLA fusion differences: residual stream fp32, attention over
     [0, pos] only (masked), softmax fp32.
+
+    int8 KV cache mode: kv_cache int8 + `kv_scales` (L, 1, 2*nkv*hd) fp32
+    (see quantize_kv_cache) — reads dequantize with the per-head scales,
+    the appended token is quantized with the same static scales.
     """
     L, b, S, dkv2 = kv_cache.shape
     dkv = dkv2 // 2
@@ -424,15 +456,22 @@ def fused_decode_reference(x, params, kv_cache, pos, cos, sin, *,
         if not gpt:
             q = _rope1(q, cos_b, sin_b)
             k = _rope1(k, cos_b, sin_b)
+        kv_new = jnp.concatenate(
+            [k.reshape(b, dkv), v.reshape(b, dkv)], axis=-1)
+        if kv_scales is not None:       # int8 cache: quantize the append
+            kv_new = jnp.clip(
+                jnp.round(kv_new.astype(jnp.float32) / kv_scales[l]),
+                -127, 127)
         kv_cache = lax.dynamic_update_slice(
-            kv_cache, jnp.concatenate(
-                [k.reshape(b, dkv), v.reshape(b, dkv)],
-                axis=-1).astype(kv_cache.dtype)[None, :, None],
+            kv_cache, kv_new.astype(kv_cache.dtype)[None, :, None],
             (l, 0, pos, 0))
-        kl = kv_cache[l, :, :, :dkv].astype(jnp.float32).reshape(
-            b, S, nkv, hd)
-        vl = kv_cache[l, :, :, dkv:].astype(jnp.float32).reshape(
-            b, S, nkv, hd)
+        kl = kv_cache[l, :, :, :dkv].astype(jnp.float32)
+        vl = kv_cache[l, :, :, dkv:].astype(jnp.float32)
+        if kv_scales is not None:       # dequantize with per-head scales
+            kl = kl * kv_scales[l, :, :dkv][None]
+            vl = vl * kv_scales[l, :, dkv:][None]
+        kl = kl.reshape(b, S, nkv, hd)
+        vl = vl.reshape(b, S, nkv, hd)
         qg = q.reshape(b, nkv, rep, hd) * scale
         scores = jnp.einsum("bgrd,bsgd->bgrs", qg, kl)
         valid = jnp.arange(S)[None, None, None] <= pos
@@ -515,20 +554,33 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                          num_heads: int, num_kv_heads: int, head_dim: int,
                          rope_base: float = 10000.0,
                          eps: float = 1e-5, chunk: int = 0,
-                         arch: str = "llama", blocks: Optional[Dict] = None):
+                         arch: str = "llama", blocks: Optional[Dict] = None,
+                         kv_scales=None, interpret: bool = False):
     # NOTE: not jit-wrapped — always invoked inside the caller's jit (the
     # generate() scan); a nested jit around a pallas_call trips XLA's
     # closed_call lowering cache.
     #
     # Mosaic layout rules shape this kernel (probed on v5e):
     #  * values cannot reshape the lane dim -> heads are split with lane
-    #    SLICES (static, unrolled) and per-kv-group batched matmuls
+    #    SLICES (static, unrolled); attention batches ALL heads into one
+    #    dot_general per KV block by staging q BLOCK-DIAGONALLY over the
+    #    kv-group lane blocks (row n of q_s carries head n's rope'd q in
+    #    its group's hd lanes, zeros elsewhere — zero lanes contract to
+    #    exact 0 against the KV chunk, so one (b·nh)-row matmul replaces
+    #    the old nkv unrolled per-group products)
     #  * DMA slices on the token (minor-2) dim must be 8-aligned -> the
     #    cache append is an aligned 8-token read-modify-write
     #  * HBM lane dims want 128-multiples -> the cache is stored flat as
     #    (L, b, S, nkv*hd)
     #  * bf16 relayouts through unit-dim inserts fail -> all merging math
     #    runs in fp32 with full-ref casts at the end
+    #
+    # int8 KV cache mode (kv_cache int8 + kv_scales (L, 1, 2*dkv) fp32):
+    # chunks stream from HBM as int8 (half the cache DMA), dequantized on
+    # the VMEM->MXU path — the k-half scales fold into the block-diagonal
+    # q rows (one broadcast multiply), the v-half scales apply once to the
+    # normalized attention output; the RMW append quantizes the new token
+    # with the same static per-head scales.
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -544,13 +596,20 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
     dqkv = dq + 2 * dkv
     ffn = params["wg"].shape[2]          # ffn_pad when a plan padded it
     int8 = "wqkv_s" in params
+    kvq = kv_scales is not None
+    assert kvq == (jnp.dtype(kv_cache.dtype) == jnp.int8), \
+        "int8 KV cache needs kv_scales (and vice versa)"
     gpt = arch == "gpt"
     wbytes = 1 if int8 else 2
+    cb = jnp.dtype(kv_cache.dtype).itemsize
     if blocks is not None:
         Qs, qblk = blocks["q_split"], blocks["qblk"]
         J, fblk = blocks["ffn_blocks"], blocks["fblk"]
         assert ffn == J * fblk, (ffn, blocks)
         assert not (gpt and Qs > 1), "qkv split unsupported for arch=gpt"
+        assert blocks.get("cache_wbytes", cb) == cb, \
+            (f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+             f"cache but the cache dtype is {kv_cache.dtype} ({cb} B)")
     else:
         Qs, qblk = 1, dqkv
         J, fblk = _pick_ffn_blocks(
@@ -563,12 +622,14 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             # llama2-7b sweep (SCALE.md r5) — chunk DMA granularity
             # overlaps the weight stream better than maximal chunks.
             w2 = 2 * (qblk + dq + 3 * fblk) * h * wbytes
-            scratch_fixed = (b * 8 * 2 * dkv * 2 + b * 2 * dkv * 4
-                             + b * nh * hd * 4 + b * h * 10)
+            # scratch: RMW block + kv32 staging + block-diagonal q_s and
+            # the fori_loop-carried (b, nh, dkv) fp32 attention acc
+            scratch_fixed = (b * 8 * 2 * dkv * cb + b * 2 * dkv * 4
+                             + 2 * b * nh * dkv * 4 + b * h * 10)
             order = (64, 128, 32, 16, 8) if Qs > 1 else (128, 64, 32, 16, 8)
             for cand in order:
                 if S % cand == 0 and (w2 + scratch_fixed + 6 * 2 ** 20
-                                      + 2 * b * cand * 2 * dkv * 2
+                                      + 2 * b * cand * 2 * dkv * cb
                                       <= _vmem_limit_bytes()):
                     chunk = cand
                     break
@@ -595,6 +656,9 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
         if int8:
             sqkv_ref, so_ref, sg_ref, su_ref, sd_ref = refs[i:i + 5]
             i += 5
+        if kvq:
+            kvs_ref = refs[i]            # (1, 2*dkv) per-head cache scales
+            i += 1
         kv_in = refs[i]                  # aliased with kv_ref
         x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
         (x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
@@ -636,6 +700,10 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 @pl.when(li == 0)
                 def _():
                     x_s[...] = x_in_ref[...].astype(jnp.float32)
+                    # one-time zero of the block-diagonal q staging: every
+                    # layer rewrites the same in-block lanes, so off-block
+                    # lanes stay zero for the whole stack
+                    q_s[...] = jnp.zeros_like(q_s)
                     pltpu.make_async_copy(
                         kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s,
                         wsem.at[0]).start()
@@ -664,15 +732,20 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 sin_b = jnp.sin(ang)
                 rope2 = lambda t: (t * cos_b + jnp.concatenate(
                     [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
-            # heads via lane slices (no lane reshapes): q into a 3D f32
-            # scratch; new k/v staged FLAT (b, 2*dkv) f32 for the RMW
-            # merge. A column block may straddle the q|k|v boundaries —
-            # qblk % hd == 0 keeps every slice head-aligned.
+            # heads via lane slices (no lane reshapes): q staged BLOCK-
+            # DIAGONALLY into (b, nh, dkv) f32 scratch — head n's rope'd,
+            # pre-scaled q lands in its kv-group's hd lanes (row n, lanes
+            # [g·hd, (g+1)·hd)) so attention runs as ONE dot_general per
+            # KV block for all heads; new k/v staged FLAT (b, 2*dkv) f32
+            # for the RMW merge. A column block may straddle the q|k|v
+            # boundaries — qblk % hd == 0 keeps every slice head-aligned.
             for t in range(qblk // hd):
                 col = p * qblk + t * hd
                 seg = part[:, t * hd:(t + 1) * hd]
                 if col < dq:
-                    q_s[:, col // hd, :] = rope2(seg)
+                    n = col // hd
+                    g = n // rep
+                    q_s[:, n, g * hd:(g + 1) * hd] = rope2(seg) * scale
                 elif col < dq + dkv:
                     kv32_s[:, col - dq:col - dq + hd] = rope2(seg)
                 else:
@@ -689,29 +762,36 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             rkb = pltpu.make_async_copy(
                 kv_ref.at[li, :, pl.ds(blk, 8)], kvblk_s, wsem.at[0])
 
-            def merge(carry, kmat, vmat, idx, limit, width):
-                """One online-softmax block update. kmat/vmat readers
-                return (b, width, hd) f32 for kv-group g."""
-                ms, ls, accs = carry
-                ms2, ls2, accs2 = [], [], []
-                for g in range(nkv):
-                    kg = kmat(g)
-                    vg = vmat(g)
-                    qg = q_s[:, g * rep:(g + 1) * rep, :] * scale
-                    sc = lax.dot_general(
-                        qg, kg, (((2,), (2,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32)  # (b, rep, w)
-                    sc = jnp.where(idx < limit, sc, NEG_INF)
-                    m_new = jnp.maximum(ms[g], jnp.max(sc, axis=-1))
-                    alpha = jnp.exp(ms[g] - m_new)
-                    pp = jnp.exp(sc - m_new[..., None])
-                    acc = accs[g] * alpha[..., None] + lax.dot_general(
-                        pp, vg, (((2,), (1,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32)  # (b, rep, hd)
-                    ms2.append(m_new)
-                    ls2.append(ls[g] * alpha + jnp.sum(pp, axis=-1))
-                    accs2.append(acc)
-                return ms2, ls2, accs2
+            # batched-head q: the block-diagonal (b, nh, dkv) staging; in
+            # int8-cache mode the k-half dequant scales fold in here (one
+            # broadcast multiply — off-block lanes are zero either way)
+            if kvq:
+                qbd = q_s[...] * kvs_ref[...][:, :dkv][None]
+            else:
+                qbd = q_s[...]
+
+            def merge(carry, kvblk, idx, limit):
+                """One online-softmax block update over ALL heads: kvblk
+                (b, width, 2*dkv) in cache dtype; ONE score dot_general
+                (block-diagonal q rows) + ONE weighted-value dot_general
+                replace the old nkv unrolled per-group products."""
+                m, l, acc = carry
+                kf = kvblk[:, :, :dkv].astype(jnp.float32)
+                vf = kvblk[:, :, dkv:].astype(jnp.float32)
+                sc = lax.dot_general(
+                    qbd, kf, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, w)
+                sc = jnp.where(idx < limit, sc, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+                alpha = jnp.exp(m - m_new)
+                pp = jnp.exp(sc - m_new[..., None])
+                # row n of acc holds head n's weighted v in its group's
+                # lane block (other lane blocks carry other groups' values
+                # weighted with head n's probs — masked out at the o-proj)
+                acc = acc * alpha[..., None] + lax.dot_general(
+                    pp, vf, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, dkv)
+                return m_new, l * alpha + jnp.sum(pp, axis=-1), acc
 
             def body(c, carry):
                 slot = lax.rem(c, 2)
@@ -723,54 +803,63 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
                 chunk_copy(c, slot).wait()
                 idx = c * ck + lax.broadcasted_iota(
                     jnp.int32, (1, 1, ck), 2)
-                return merge(
-                    carry,
-                    lambda g: kvch_s[slot, :, :, g * hd:(g + 1) * hd].astype(
-                        jnp.float32),
-                    lambda g: kvch_s[slot, :, :,
-                                     dkv + g * hd:dkv + (g + 1) * hd].astype(
-                        jnp.float32),
-                    idx, blk, ck)
+                return merge(carry, kvch_s[slot], idx, blk)
 
-            m0 = [jnp.full((b, rep), NEG_INF, jnp.float32)
-                  for _ in range(nkv)]
-            l0 = [jnp.zeros((b, rep), jnp.float32) for _ in range(nkv)]
-            a0 = [jnp.zeros((b, rep, hd), jnp.float32) for _ in range(nkv)]
-            carry = lax.fori_loop(0, nc, body, (m0, l0, a0))
+            carry = lax.fori_loop(0, nc, body, (
+                jnp.full((b, nh), NEG_INF, jnp.float32),
+                jnp.zeros((b, nh), jnp.float32),
+                jnp.zeros((b, nh, dkv), jnp.float32)))
 
             # merge the new token into the RMW block, attend to it from
             # VMEM, and write the block back (waited in FFN j==1)
             rkb.wait()
             sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
+            newtok = kv32_s[...]
+            if kvq:         # quantize the append with the static scales
+                newtok = jnp.clip(
+                    jnp.round(newtok / kvs_ref[...]), -127.0, 127.0)
             kvblk_s[...] = jnp.where(
-                sel, kv32_s[...][:, None, :],
+                sel, newtok[:, None, :],
                 kvblk_s[...].astype(jnp.float32)).astype(kv_cache.dtype)
             wkb = pltpu.make_async_copy(
                 kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
             wkb.start()
             bidx = blk + lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
-            ms, ls, accs = merge(
-                carry,
-                lambda g: kvblk_s[:, :, g * hd:(g + 1) * hd].astype(
-                    jnp.float32),
-                lambda g: kvblk_s[:, :,
-                                  dkv + g * hd:dkv + (g + 1) * hd].astype(
-                    jnp.float32),
-                bidx, pos + 1, 8)
+            ms, ls, accs = merge(carry, kvblk_s[...], bidx, pos + 1)
 
-            # o-proj without a lane-merge relayout: per-head partial
-            # matmuls against wo's row blocks (head = g*rep + r); int8
-            # scales apply once to the accumulated output columns
-            oacc = jnp.zeros((b, h), jnp.float32)
-            for g in range(nkv):
-                norm = accs[g] / ls[g][..., None]           # (b, rep, hd)
-                for r in range(rep):
-                    hh = g * rep + r
-                    oacc = oacc + wdot(
-                        norm[:, r, :].astype(dtype), wo_ref, None,
-                        rows=slice(hh * hd, (hh + 1) * hd))
-            if int8:
-                oacc = oacc * so_ref[...]
+            norm = accs / ls[..., None]                     # (b, nh, dkv)
+            if kvq:         # v-half dequant scales, applied once
+                norm = norm * kvs_ref[...][:, dkv:][None]
+            # o-proj without a lane-merge relayout:
+            #  * MHA (rep == 1): rows and lane blocks are 1:1 — mask to
+            #    the block diagonal and SUM over the head rows (adding
+            #    exact zeros), collapsing to flat (b, dq) for ONE full
+            #    matmul against wo
+            #  * GQA (rep > 1): heads of a group share a lane block, so
+            #    the sum would collide — one dot_general per kv group,
+            #    batched over its rep heads against wo's row blocks
+            if rep == 1:
+                bd = (lax.broadcasted_iota(jnp.int32, (1, nh, dkv), 2)
+                      // hd == lax.broadcasted_iota(
+                          jnp.int32, (1, nh, dkv), 1))
+                attn = jnp.sum(jnp.where(bd, norm, 0.0), axis=1)  # (b, dq)
+                oacc = wdot(attn.astype(dtype), wo_ref,
+                            so_ref if int8 else None)
+            else:
+                oacc = jnp.zeros((b, h), jnp.float32)
+                for g in range(nkv):
+                    ng = norm[:, g * rep:(g + 1) * rep,
+                              g * hd:(g + 1) * hd]          # (b, rep, hd)
+                    w3 = wo_ref[g * rep * hd:(g + 1) * rep * hd,
+                                :].reshape(rep, hd, h)
+                    part = lax.dot_general(
+                        ng.astype(dtype),
+                        w3.astype(dtype) if int8 else w3,
+                        (((2,), (1,)), ((1,), (0,))),
+                        preferred_element_type=jnp.float32)  # (rep, b, h)
+                    oacc = oacc + jnp.sum(part, axis=0)
+                if int8:
+                    oacc = oacc * so_ref[...]
             if gpt:
                 oacc = oacc + bo_ref[...]
             x = x_s[...] + oacc
@@ -882,7 +971,9 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pl.BlockSpec((None, 1, fblk),
                          lambda l, j: (fl(l, j), 0, jm(l, j))),     # su
             pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # sd
-        ] if int8 else []) + [
+        ] if int8 else []) + ([
+            pl.BlockSpec((None, 1, 2 * dkv), lambda l, j: (l, 0, 0)),  # kvs
+        ] if kvq else []) + [
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
         ],
         out_specs=[
@@ -897,14 +988,14 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             pltpu.VMEM((b, h), jnp.float32),          # x_s
             pltpu.VMEM((b, h), dtype),                # xn_s
             pltpu.VMEM((b, h), jnp.float32),          # acc_s
-            pltpu.VMEM((b, nh, hd), jnp.float32),     # q_s
+            pltpu.VMEM((b, nh, dkv), jnp.float32),    # q_s (block-diag)
             pltpu.VMEM((b, 2 * dkv), jnp.float32),    # kv32_s staging
             pltpu.VMEM((b, 8, 2 * dkv), kv_cache.dtype),   # kvblk_s RMW
             pltpu.VMEM((2, b, ck, 2 * dkv), kv_cache.dtype),  # kvch_s dbuf
             pltpu.SemaphoreType.DMA((1,)),            # wsem
             pltpu.SemaphoreType.DMA((2,)),            # rsem
         ],
-        input_output_aliases={(9 - gpt + 6 * gpt + 5 * int8): 1},
+        input_output_aliases={(9 - gpt + 6 * gpt + 5 * int8 + kvq): 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             # the default 16 MiB scoped limit can't hold a layer's
@@ -912,6 +1003,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
             # generation's capacity minus headroom
             vmem_limit_bytes=_vmem_limit_bytes()),
         name="fused_decode_step",
+        interpret=interpret,
     )(jnp.asarray(pos, jnp.int32).reshape(1), x,
       params["ln1"][:, None], params["wqkv"],
       params["wo"], params["ln2"][:, None], params["wg"],
@@ -922,6 +1014,7 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
          params["bg"][:, None], params["bd"][:, None]) if gpt else ()),
       *((params["wqkv_s"], params["wo_s"], params["wg_s"],
          params["wu_s"], params["wd_s"]) if int8 else ()),
+      *((jnp.asarray(kv_scales, jnp.float32),) if kvq else ()),
       kv_cache)
     return out[0], out[1]
 
@@ -954,7 +1047,8 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                              num_heads: int, num_kv_heads: int,
                              head_dim: int, top_k: int,
                              rope_base: float = 10000.0,
-                             eps: float = 1e-5, chunk: int = 0):
+                             eps: float = 1e-5, chunk: int = 0,
+                             interpret: bool = False):
     """Fused MoE decode step: llama attention block + top-k expert FFN with
     DATA-DEPENDENT weight streaming.
 
@@ -996,8 +1090,9 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
     shared = "wsg" in params
     fs = params["wsg"].shape[2] if shared else 0
     # attention weights ride the Mosaic pipeline (double-buffered), expert
-    # blocks ride the manual pipeline — both count against VMEM
-    attn_fixed = 2 * (dqkv + dq + E) * h * wbytes
+    # blocks ride the manual pipeline — both count against VMEM, as do the
+    # block-diagonal q staging and the fori_loop-carried attention acc
+    attn_fixed = 2 * (dqkv + dq + E) * h * wbytes + 2 * b * nh * dkv * 4
     J, fblk = _pick_expert_blocks(ffn, h, fixed_bytes=attn_fixed,
                                   wbytes=wbytes)
     if shared:
@@ -1060,6 +1155,9 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             @pl.when(li == 0)
             def _():
                 x_s[...] = x_in_ref[...].astype(jnp.float32)
+                # one-time zero of the block-diagonal q staging (layers
+                # rewrite the same in-block lanes; off-block lanes stay 0)
+                q_s[...] = jnp.zeros_like(q_s)
 
             blk = (pos // 8) * 8
             off = pos - blk
@@ -1081,8 +1179,13 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             sin_b = jnp.sin(ang)
             rope2 = lambda v: (v * cos_b + jnp.concatenate(
                 [-v[:, hd // 2:], v[:, :hd // 2]], axis=-1) * sin_b)
-            for g in range(nh):
-                q_s[:, g, :] = rope2(qkv[:, g * hd:(g + 1) * hd])
+            # q staged block-diagonally over kv-group lane blocks (see
+            # _fused_decode_pallas): one dot_general per KV block for all
+            # heads instead of nkv unrolled per-group products
+            for n in range(nh):
+                g = n // rep
+                q_s[:, n, g * hd:(g + 1) * hd] = rope2(
+                    qkv[:, n * hd:(n + 1) * hd]) * scale
             for g in range(nkv):
                 kv32_s[:, g * hd:(g + 1) * hd] = rope2(
                     qkv[:, dq + g * hd:dq + (g + 1) * hd])
@@ -1094,27 +1197,23 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                     kv_ref.at[li, :, pl.ds(c * ck, ck)],
                     kvch_s.at[slot], rsem.at[slot])
 
-            def merge(carry, kmat, vmat, idx, limit, width):
-                ms, ls, accs = carry
-                ms2, ls2, accs2 = [], [], []
-                for g in range(nkv):
-                    kg = kmat(g)
-                    vg = vmat(g)
-                    qg = q_s[:, g * rep:(g + 1) * rep, :] * scale
-                    sc = lax.dot_general(
-                        qg, kg, (((2,), (2,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32)
-                    sc = jnp.where(idx < limit, sc, NEG_INF)
-                    m_new = jnp.maximum(ms[g], jnp.max(sc, axis=-1))
-                    alpha = jnp.exp(ms[g] - m_new)
-                    pp = jnp.exp(sc - m_new[..., None])
-                    acc = accs[g] * alpha[..., None] + lax.dot_general(
-                        pp, vg, (((2,), (1,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32)
-                    ms2.append(m_new)
-                    ls2.append(ls[g] * alpha + jnp.sum(pp, axis=-1))
-                    accs2.append(acc)
-                return ms2, ls2, accs2
+            qbd = q_s[...]
+
+            def merge(carry, kvblk, idx, limit):
+                m, l, acc = carry
+                kf = kvblk[:, :, :dkv].astype(jnp.float32)
+                vf = kvblk[:, :, dkv:].astype(jnp.float32)
+                sc = lax.dot_general(
+                    qbd, kf, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, w)
+                sc = jnp.where(idx < limit, sc, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+                alpha = jnp.exp(m - m_new)
+                pp = jnp.exp(sc - m_new[..., None])
+                acc = acc * alpha[..., None] + lax.dot_general(
+                    pp, vf, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, dkv)
+                return m_new, l * alpha + jnp.sum(pp, axis=-1), acc
 
             nc = (blk + ck - 1) // ck
 
@@ -1132,21 +1231,12 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                 chunk_copy(c, slot).wait()
                 idx = c * ck + lax.broadcasted_iota(
                     jnp.int32, (1, 1, ck), 2)
-                return merge(
-                    carry,
-                    lambda g: kvch_s[slot, :, :,
-                                     g * hd:(g + 1) * hd].astype(
-                        jnp.float32),
-                    lambda g: kvch_s[slot, :, :,
-                                     dkv + g * hd:dkv + (g + 1) * hd].astype(
-                        jnp.float32),
-                    idx, blk, ck)
+                return merge(carry, kvch_s[slot], idx, blk)
 
-            m0 = [jnp.full((b, rep), NEG_INF, jnp.float32)
-                  for _ in range(nkv)]
-            l0 = [jnp.zeros((b, rep), jnp.float32) for _ in range(nkv)]
-            a0 = [jnp.zeros((b, rep, hd), jnp.float32) for _ in range(nkv)]
-            carry = lax.fori_loop(0, nc, body, (m0, l0, a0))
+            carry = lax.fori_loop(0, nc, body, (
+                jnp.full((b, nh), NEG_INF, jnp.float32),
+                jnp.zeros((b, nh), jnp.float32),
+                jnp.zeros((b, nh, dkv), jnp.float32)))
 
             rkb.wait()
             sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
@@ -1157,24 +1247,28 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                 kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
             wkb.start()
             bidx = blk + lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
-            ms, ls, accs = merge(
-                carry,
-                lambda g: kvblk_s[:, :, g * hd:(g + 1) * hd].astype(
-                    jnp.float32),
-                lambda g: kvblk_s[:, :,
-                                  dkv + g * hd:dkv + (g + 1) * hd].astype(
-                    jnp.float32),
-                bidx, pos + 1, 8)
+            ms, ls, accs = merge(carry, kvblk_s[...], bidx, pos + 1)
 
-            oacc = jnp.zeros((b, h), jnp.float32)
-            for g in range(nkv):
-                norm = accs[g] / ls[g][..., None]
-                for r in range(rep):
-                    hh = g * rep + r
-                    oacc = oacc + jnp.dot(
-                        norm[:, r, :].astype(dtype),
-                        wo_ref[hh * hd:(hh + 1) * hd, :],
-                        preferred_element_type=jnp.float32)
+            norm = accs / ls[..., None]                     # (b, nh, dkv)
+            if rep == 1:
+                bd = (lax.broadcasted_iota(jnp.int32, (1, nh, dkv), 2)
+                      // hd == lax.broadcasted_iota(
+                          jnp.int32, (1, nh, dkv), 1))
+                attn = jnp.sum(jnp.where(bd, norm, 0.0), axis=1)
+                oacc = jnp.dot(attn.astype(dtype), wo_ref[...],
+                               preferred_element_type=jnp.float32)
+            else:
+                oacc = jnp.zeros((b, h), jnp.float32)
+                for g in range(nkv):
+                    ng = norm[:, g * rep:(g + 1) * rep,
+                              g * hd:(g + 1) * hd]          # (b, rep, hd)
+                    w3 = wo_ref[g * rep * hd:(g + 1) * rep * hd,
+                                :].reshape(rep, hd, h)
+                    part = lax.dot_general(
+                        ng.astype(dtype), w3,
+                        (((2,), (1,)), ((1,), (0,))),
+                        preferred_element_type=jnp.float32)  # (rep, b, h)
+                    oacc = oacc + jnp.sum(part, axis=0)
             xr = x_s[...] + oacc
             x_s[...] = xr
             xn2 = _rms(xr, ln2_ref[...].reshape(h), eps).astype(dtype)
@@ -1329,7 +1423,7 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             pltpu.VMEM((b, h), jnp.float32),          # x_s
             pltpu.VMEM((b, h), dtype),                # xn_s
             pltpu.VMEM((b, h), jnp.float32),          # acc_s
-            pltpu.VMEM((b, nh, hd), jnp.float32),     # q_s
+            pltpu.VMEM((b, nh, dkv), jnp.float32),    # q_s (block-diag)
             pltpu.VMEM((b, 2 * dkv), jnp.float32),    # kv32_s
             pltpu.VMEM((b, 8, 2 * dkv), kv_cache.dtype),   # kvblk_s
             pltpu.VMEM((2, b, ck, 2 * dkv), kv_cache.dtype),  # kvch_s
@@ -1347,6 +1441,7 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=_vmem_limit_bytes()),
         name="fused_decode_moe_step",
+        interpret=interpret,
     )(jnp.asarray(pos, jnp.int32).reshape(1), x,
       params["ln1"][:, None], params["wqkv"], params["wo"],
       params["ln2"][:, None], params["gate"],
@@ -1362,31 +1457,43 @@ _fallback_logged = False
 def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                       num_heads: int, num_kv_heads: int, eps: float = 1e-5,
                       rope_base: float = 10000.0, arch: str = "llama",
-                      top_k: int = 2, blocks: Optional[Dict] = None):
+                      top_k: int = 2, blocks: Optional[Dict] = None,
+                      kv_scales=None):
     """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
 
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
     be traced (it is the scan counter inside `inference.generate`).
     `top_k` applies to arch="moe" only. `blocks` is a `decode_block_plan`
     dict (the plan that padded the params must also drive the kernel).
+    `kv_scales` enables the int8 KV-cache mode (llama/gpt archs; see
+    quantize_kv_cache).
+
+    FLAGS_pallas_interpret=1 routes the Pallas kernel through interpret
+    mode off-TPU — the CPU-CI path for kernel-logic parity tests.
     """
+    from paddle_tpu.core.flags import flag
     from paddle_tpu.ops import use_pallas
     dkv = kv_cache.shape[-1] // 2
-    if use_pallas() and dkv % 128 == 0 and kv_cache.shape[2] % 128 == 0:
+    interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
+    if kv_scales is not None and arch == "moe":
+        raise NotImplementedError(
+            "int8 KV cache is not supported for the fused MoE kernel")
+    if (use_pallas() or interp) and dkv % 128 == 0 \
+            and kv_cache.shape[2] % 128 == 0:
         try:
             if arch == "moe":
                 return _fused_decode_moe_pallas(
                     x, params, kv_cache, pos,
                     num_heads=num_heads, num_kv_heads=num_kv_heads,
                     head_dim=dkv // num_kv_heads, top_k=top_k,
-                    rope_base=rope_base, eps=eps)
+                    rope_base=rope_base, eps=eps, interpret=interp)
             return _fused_decode_pallas(
                 x, params, kv_cache, pos,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,
                 head_dim=dkv // num_kv_heads,
-                rope_base=rope_base, eps=eps, arch=arch, blocks=blocks)
+                rope_base=rope_base, eps=eps, arch=arch, blocks=blocks,
+                kv_scales=kv_scales, interpret=interp)
         except Exception as e:  # pragma: no cover - hardware-dependent
-            from paddle_tpu.core.flags import flag
             if flag("FLAGS_pallas_strict"):
                 raise
             global _fallback_logged
@@ -1400,4 +1507,4 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
     return fused_decode_reference(
         x, params, kv_cache, pos, cos, sin,
         num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps, arch=arch,
-        top_k=top_k)
+        top_k=top_k, kv_scales=kv_scales)

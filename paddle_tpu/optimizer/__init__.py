@@ -133,20 +133,43 @@ class Optimizer:
                 if masters else params)
         gf = _to_f32(grads)
         shapes = {k: v.shape for k, v in work.items()}
-        flat = lambda tree: {
-            k: (v.reshape(-1) if hasattr(v, "reshape")
-                and k in shapes and v.shape == shapes[k] else v)
-            for k, v in tree.items()}
-        unflat = lambda tree: {
-            k: (v.reshape(shapes[k]) if hasattr(v, "reshape")
-                and k in shapes and v.ndim == 1 else v)
-            for k, v in tree.items()}
-        flat_state = {k: (flat(v) if isinstance(v, dict) else v)
-                      for k, v in state.items()}
-        new_work, new_slots = self._apply(flat(gf), flat(work), flat_state,
+
+        def flat(tree):
+            """Flatten entries whose shape MATCHES the param's, recording
+            which keys were actually flattened — unflat must only undo
+            these. (A slot that is legitimately a REDUCED shape — e.g. a
+            per-row accumulator (rows,) for a 2-D param — must pass
+            through untouched in both directions.)"""
+            out, done = {}, set()
+            for k, v in tree.items():
+                if (hasattr(v, "reshape") and k in shapes
+                        and v.shape == shapes[k]):
+                    out[k] = v.reshape(-1)
+                    done.add(k)
+                else:
+                    out[k] = v
+            return out, done
+
+        def unflat(tree, done):
+            return {k: (v.reshape(shapes[k])
+                        if k in done and hasattr(v, "reshape") else v)
+                    for k, v in tree.items()}
+
+        gf, _ = flat(gf)
+        work_flat, work_done = flat(work)
+        flat_state, slot_done = {}, {}
+        for k, v in state.items():
+            if isinstance(v, dict):
+                flat_state[k], slot_done[k] = flat(v)
+            else:
+                flat_state[k] = v
+        new_work, new_slots = self._apply(gf, work_flat, flat_state,
                                           lr, step_)
-        new_work = unflat(new_work)
-        new_slots = {k: (unflat(v) if isinstance(v, dict) else v)
+        new_work = unflat(new_work, work_done)
+        # a slot dict _apply introduces this step derives from flattened
+        # params/grads, so it unflattens with the param key set
+        new_slots = {k: (unflat(v, slot_done.get(k, work_done))
+                         if isinstance(v, dict) else v)
                      for k, v in new_slots.items()}
         new_state = dict(state)
         # accumulator math runs in fp32; store back in the slot's own dtype

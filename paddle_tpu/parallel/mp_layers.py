@@ -68,6 +68,15 @@ def constrain(x, spec_for_ndim, axis: str = MP_AXIS):
         if types[axis] == AxisType.Manual or am.shape[axis] <= 1:
             return x
         return jax.lax.with_sharding_constraint(x, spec_for_ndim(x.ndim))
+    # old-jax (0.4.x) spelling of the same Manual-axis skip: inside a
+    # shard_map body the manual axes live in the trace's axis env, and a
+    # sharding constraint over one is an error, not a hint
+    try:
+        from jax._src import core as _core
+        if _core.get_axis_env().axis_exists(axis):
+            return x
+    except Exception:
+        pass
     mesh = _active_mesh(axis)
     if mesh is None:
         return x

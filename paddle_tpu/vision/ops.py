@@ -51,7 +51,9 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     boxes = jnp.asarray(boxes, jnp.float32)
     n = boxes.shape[0]
     if n == 0:
-        return jnp.zeros((0,), jnp.int64)
+        # int32 like the non-empty path below — callers indexing with the
+        # result must not see a dtype that depends on the input size
+        return jnp.zeros((0,), jnp.int32)
     if scores is None:
         order = jnp.arange(n)
     else:

@@ -14,13 +14,25 @@ import socket
 import sys
 
 
+def _pin_cpu_devices(n):
+    """jax.config spelling on 0.5+; XLA_FLAGS fallback for jax 0.4.x
+    (must run before the worker's first backend query)."""
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+
+
 def _worker(rank, port):
     # pin the platform BEFORE any backend query (the axon sitecustomize
     # imports jax at interpreter start; env vars are too late, config
     # updates are not)
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    _pin_cpu_devices(1)
 
     os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
     from paddle_tpu.parallel import collective as coll
@@ -81,7 +93,7 @@ def _pipeline_worker(rank, port, expected_loss):
     TPU-native answer to the reference's cross-host NCCL pipeline."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    _pin_cpu_devices(1)
 
     os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
     from paddle_tpu.parallel import env as penv
@@ -130,7 +142,7 @@ def _subgroup_worker(rank, port):
     send/recv."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    _pin_cpu_devices(2)
 
     os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
     from paddle_tpu.parallel import collective as coll
@@ -190,7 +202,7 @@ def _hybrid4_worker(rank, port, expected_loss):
     reproduce the single-process 4-device loss."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    _pin_cpu_devices(1)
 
     os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
     from paddle_tpu.parallel import env as penv

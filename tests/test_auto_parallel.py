@@ -94,6 +94,7 @@ def test_spawn_sets_env():
     spawn(_spawn_worker, args=(2,), nprocs=2)
 
 
+@pytest.mark.slow
 def test_engine_fit_titan_cross_section_matches_manual():
     """VERDICT r4 #9: EXECUTE the Titan cross-section through Engine.fit —
     the exact mesh of the AOT evidence (mp4 × ZeRO-2 sharding2,

@@ -142,6 +142,7 @@ def test_flags_roundtrip():
         set_flags({"FLAGS_definitely_unknown": 1})
 
 
+@pytest.mark.slow
 def test_profiler_summary_and_chrome_trace(tmp_path):
     """summary() parses real xplane protos; export produces catapult JSON."""
     import json

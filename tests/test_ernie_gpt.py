@@ -129,6 +129,7 @@ def test_gpt_pipeline_tied_embeddings_matches_single_device():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_matches_single_device():
     s = DistributedStrategy()
     s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 2,

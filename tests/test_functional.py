@@ -263,3 +263,31 @@ def test_initializer_conv_fans():
     from paddle_tpu.nn.initializer import _fan_in_out
     assert _fan_in_out((64, 3, 3, 3)) == (27, 576)
     assert _fan_in_out((8, 16)) == (8, 16)
+
+
+def test_flash_dropout_under_jit_without_rng_raises():
+    """In-kernel attention dropout traced with no bound 'dropout' rng
+    stream must RAISE (the seed would bake into the executable as a
+    constant — one dropout mask reused every call), not UserWarning."""
+    from paddle_tpu.ops import flash_attention as fa
+
+    q = jnp.zeros((1, 128, 2, 64), jnp.float32)
+
+    def run(q):
+        return fa._flash_call(q, q, q, is_causal=True, scale=None,
+                              kv_lens=None, seg_q=None, seg_k=None,
+                              dropout_p=0.5)
+
+    with pytest.raises(RuntimeError, match="dropout"):
+        jax.jit(run)(q)
+    # with a bound stream the seed draw itself is legal (tracing may
+    # still proceed into the kernels, which need a TPU — only assert the
+    # rng gate here)
+    from paddle_tpu.core.rng import rng_guard
+    try:
+        with rng_guard(dropout=jax.random.PRNGKey(0)):
+            jax.jit(run)(q)
+    except RuntimeError as e:
+        assert "dropout" not in str(e)
+    except Exception:
+        pass    # CPU cannot lower the Pallas kernels; the gate passed

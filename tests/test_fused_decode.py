@@ -126,6 +126,199 @@ def test_gpt_fused_reference_matches_unfused():
     assert np.asarray(out_ref).tolist() == np.asarray(out_fused).tolist()
 
 
+def test_quantize_kv_cache_roundtrip():
+    """int8 cache quant: shapes, per-head scales, small roundtrip error."""
+    rng = np.random.RandomState(0)
+    L, b, S, nkv, hd = 2, 3, 64, 2, 64
+    kv = jnp.asarray(rng.randn(L, b, S, 2 * nkv * hd), jnp.float32)
+    q, scales = fd.quantize_kv_cache(kv, nkv)
+    assert q.dtype == jnp.int8 and q.shape == kv.shape
+    assert scales.shape == (L, 1, 2 * nkv * hd)
+    # scales are lane-replicated per head
+    sc = np.asarray(scales).reshape(L, 2 * nkv, hd)
+    assert (sc == sc[:, :, :1]).all()
+    deq = np.asarray(q, np.float32) * np.asarray(scales)[:, None]
+    err = np.abs(deq - np.asarray(kv))
+    step = np.repeat(sc[:, None, None, :, 0], hd, axis=-1)
+    assert (err <= 0.5 * step + 1e-6).all()   # within half a quant step
+
+
+def test_decode_block_plan_cache_wbytes_recorded():
+    plan = fd.decode_block_plan(128, 256, 128, 32, 256, wbytes=2)
+    assert plan["cache_wbytes"] == 2
+    plan8 = fd.decode_block_plan(128, 256, 128, 32, 256, wbytes=2,
+                                 cache_wbytes=1)
+    assert plan8["cache_wbytes"] == 1
+
+
+def test_int8_cache_reference_cosine_parity():
+    """Reference twin, int8 KV cache (prefill = calibration) vs bf16
+    cache: same greedy token, cosine > 0.99 on the logits."""
+    cfg, m = tiny_model()
+    state = m.state_dict(include_buffers=False)
+    plan = m.fused_decode_plan(state)
+    b, prompt, S = 2, 7, 128
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, prompt)))
+    cache = m.init_cache(b, S)
+    logits, cache = m(ids, cache=cache, start_pos=0)
+    tok = jnp.argmax(logits[:, -1, :], axis=-1)
+    kv = jnp.stack([jnp.concatenate(
+        [c["k"].reshape(b, S, -1), c["v"].reshape(b, S, -1)], axis=-1)
+        for c in cache])
+    cos, sin = rope_cos_sin(S, cfg.head_dim, base=cfg.rope_base)
+    x = plan["embed"](tok, prompt)
+
+    x16, _ = fd.fused_decode_reference(
+        x, plan["params"], kv, prompt, cos[prompt:prompt + 1],
+        sin[prompt:prompt + 1], num_heads=cfg.num_heads,
+        num_kv_heads=cfg.kv_heads, eps=cfg.rms_norm_eps)
+    kv8, scales = fd.quantize_kv_cache(kv, cfg.kv_heads)
+    x8, kv8b = fd.fused_decode_reference(
+        x, plan["params"], kv8, prompt, cos[prompt:prompt + 1],
+        sin[prompt:prompt + 1], num_heads=cfg.num_heads,
+        num_kv_heads=cfg.kv_heads, eps=cfg.rms_norm_eps, kv_scales=scales)
+    assert kv8b.dtype == jnp.int8
+    l16 = np.asarray(plan["head"](x16), np.float32)
+    l8 = np.asarray(plan["head"](x8), np.float32)
+    assert np.argmax(l16, -1).tolist() == np.argmax(l8, -1).tolist()
+    for r in range(b):
+        a, c = l16[r], l8[r]
+        cossim = (a * c).sum() / (np.linalg.norm(a) * np.linalg.norm(c))
+        assert cossim > 0.99, cossim
+
+
+def test_generate_int8_cache_matches_bf16():
+    """generate(cache_dtype=int8): greedy tokens match the bf16-cache run
+    (tiny model; int8 cache noise stays below the argmax margin)."""
+    cfg, m = tiny_model()
+    rng = np.random.RandomState(1)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 9)))
+    out16 = generate(m, prompt, max_new_tokens=12, temperature=0.0)
+    m._generate_jit_cache = {}
+    out8 = generate(m, prompt, max_new_tokens=12, temperature=0.0,
+                    cache_dtype=jnp.int8)
+    assert np.asarray(out16).tolist() == np.asarray(out8).tolist()
+
+
+def test_generate_int8_cache_requires_fused_plan():
+    cfg, m = tiny_model()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    set_flags({"FLAGS_fused_decode": False})
+    with pytest.raises(ValueError, match="int8"):
+        generate(m, prompt, max_new_tokens=4, cache_dtype=jnp.int8)
+
+
+class TestInterpretKernelParity:
+    """The Pallas kernel itself, on CPU via interpret mode — the
+    CI-side guard for the batched-head attention + int8 cache paths
+    (tests_tpu/ re-runs these shapes on the real chip)."""
+
+    @pytest.fixture(autouse=True)
+    def _interp(self):
+        set_flags({"FLAGS_pallas_interpret": True,
+                   "FLAGS_pallas_strict": True})
+        yield
+        set_flags({"FLAGS_pallas_interpret": False,
+                   "FLAGS_pallas_strict": False})
+
+    @pytest.mark.parametrize("nkv", [2, 4])  # GQA (batched per-group
+    def test_llama_generate_token_exact(self, nkv):  # o-proj) and MHA
+        cfg, m = tiny_model(nkv)                     # (sum-trick o-proj)
+        rng = np.random.RandomState(1)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 9)))
+        set_flags({"FLAGS_pallas_interpret": False})
+        out_ref = generate(m, prompt, max_new_tokens=12, temperature=0.0)
+        m._generate_jit_cache = {}
+        set_flags({"FLAGS_pallas_interpret": True})
+        out_k = generate(m, prompt, max_new_tokens=12, temperature=0.0)
+        assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
+
+    def test_llama_int8_cache_token_exact(self):
+        cfg, m = tiny_model()
+        rng = np.random.RandomState(2)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 9)))
+        set_flags({"FLAGS_pallas_interpret": False})
+        out_ref = generate(m, prompt, max_new_tokens=12, temperature=0.0,
+                           cache_dtype=jnp.int8)
+        m._generate_jit_cache = {}
+        set_flags({"FLAGS_pallas_interpret": True})
+        out_k = generate(m, prompt, max_new_tokens=12, temperature=0.0,
+                         cache_dtype=jnp.int8)
+        assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
+
+    def test_gpt_generate_token_exact(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+
+        paddle_tpu.seed(0)
+        cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                        num_heads=2, max_position_embeddings=256,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        g = GPTPretrainModel(cfg)
+        g.eval()
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (2, 7)))
+        set_flags({"FLAGS_pallas_interpret": False})
+        out_ref = generate(g, prompt, max_new_tokens=10, temperature=0.0)
+        g._generate_jit_cache = {}
+        set_flags({"FLAGS_pallas_interpret": True})
+        out_k = generate(g, prompt, max_new_tokens=10, temperature=0.0)
+        assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
+
+    def test_moe_generate_token_exact(self):
+        from paddle_tpu.models.mixtral import (MixtralConfig,
+                                               MixtralForCausalLM)
+
+        paddle_tpu.seed(0)
+        cfg = MixtralConfig(vocab_size=256, hidden_size=128,
+                            intermediate_size=256, num_layers=2,
+                            num_heads=4, num_kv_heads=2,
+                            max_position_embeddings=512, num_experts=8,
+                            top_k=2)
+        mm = MixtralForCausalLM(cfg).bfloat16()
+        mm.eval()
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (1, 7)))
+        set_flags({"FLAGS_pallas_interpret": False})
+        out_ref = generate(mm, prompt, max_new_tokens=8, temperature=0.0)
+        mm._generate_jit_cache = {}
+        set_flags({"FLAGS_pallas_interpret": True})
+        out_k = generate(mm, prompt, max_new_tokens=8, temperature=0.0)
+        assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
+
+    def test_qsplit_int8_weights_kernel(self):
+        """The 7B code path (qkv column split + int8 weights) through the
+        interpret-mode kernel, single step vs the reference."""
+        L, b, S, hd, h, ffn = 2, 4, 256, 64, 256, 384
+        nh = nkv = 4
+        dq, dkv = nh * hd, nkv * hd
+        blocks = {"q_split": 2, "qblk": 384, "ffn_blocks": 2, "fblk": 256,
+                  "ffn_pad": 512}
+        r = np.random.RandomState(0)
+        params = {"ln1": jnp.ones((L, h), jnp.bfloat16),
+                  "ln2": jnp.ones((L, h), jnp.bfloat16)}
+        shapes = {"wqkv": (L, h, dq + 2 * dkv), "wo": (L, dq, h),
+                  "wg": (L, h, ffn), "wu": (L, h, ffn), "wd": (L, ffn, h)}
+        for k, s in shapes.items():
+            params[k] = jnp.asarray(r.randint(-127, 128, s), jnp.int8)
+            params[f"{k}_s"] = jnp.full((L, 1, s[-1]), 4e-4, jnp.float32)
+        params = fd._pad_ffn(params, blocks["ffn_pad"])
+        x = jnp.asarray(r.randn(b, h) * 0.05, jnp.bfloat16)
+        kv = jnp.asarray(r.randn(L, b, S, 2 * dkv) * 0.05, jnp.bfloat16)
+        pos = 77
+        cos, sin = rope_cos_sin(S, hd)
+        xr, _ = jax.jit(lambda *a: fd.fused_decode_reference(
+            *a, num_heads=nh, num_kv_heads=nkv, eps=1e-5))(
+            x, params, kv, pos, cos[pos:pos + 1], sin[pos:pos + 1])
+        xp, _ = jax.jit(lambda x, p, kv: fd._fused_decode_pallas(
+            x, p, kv, pos, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+            eps=1e-5, blocks=blocks, interpret=True))(x, params, kv)
+        np.testing.assert_allclose(np.asarray(xp, np.float32),
+                                   np.asarray(xr, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
 def test_vmem_mib_flag_dispatch():
     """FLAGS_vmem_mib: >0 overrides; -1 asks the Mosaic probe (which
     raises off-TPU, so the kind table wins here on CPU); 0 = table."""

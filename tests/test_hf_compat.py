@@ -14,6 +14,7 @@ from paddle_tpu.utils.hf_compat import (convert_hf_llama_state_dict,
                                         load_hf_llama)
 
 
+@pytest.mark.slow
 def test_hf_llama_logits_match():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -120,6 +120,7 @@ def test_mixtral_ep_sharded_matches_dense(ep_fleet):
     np.testing.assert_allclose(sharded, ref_loss, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_mixtral_training_decreases_loss():
     cfg = MixtralConfig.tiny()
     paddle_tpu.seed(0)
@@ -151,6 +152,7 @@ def test_mixtral_training_decreases_loss():
     assert float(jnp.abs(gate_g).max()) > 0
 
 
+@pytest.mark.slow
 def test_mixtral_pipeline_matches_microbatched_eager():
     s = DistributedStrategy()
     s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
@@ -185,6 +187,7 @@ def test_mixtral_pipeline_matches_microbatched_eager():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_alltoall_composes_with_mp():
     """alltoall dispatch under mp_degree > 1: the expert FFN contraction
     is mp-sharded inside the shard_map (psum on the down-proj) and must
@@ -225,6 +228,7 @@ def test_alltoall_composes_with_mp():
                                    err_msg=k)
 
 
+@pytest.mark.slow
 def test_alltoall_dispatch_matches_per_shard_local():
     """dispatch_mode='alltoall' (explicit shard_map all_to_all — the
     global_scatter mechanism) must equal running the capacity path
@@ -293,6 +297,7 @@ def test_alltoall_dispatch_matches_per_shard_local():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_alltoall_multi_axis_ep():
     """EP spanning TWO mesh axes (dp × sharding): the all_to_all treats
     the tuple as one flattened axis; result must equal the single-axis

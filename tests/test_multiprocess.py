@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 DRIVER = os.path.join(HERE, "mp_driver.py")
 
@@ -31,6 +33,7 @@ def test_two_process_cpu_collectives():
     assert "DRIVER_OK" in out, out
 
 
+@pytest.mark.slow
 def test_two_process_subgroup_and_multidevice():
     """Eager ProcessGroup completeness (VERDICT r2 #6): 3 processes × 2
     devices each, an OFFSET size-2 subgroup {0,2} via new_group (global
@@ -96,6 +99,7 @@ def _expected_pp2_loss():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_pipeline_across_two_processes():
     """The 1F1B pipeline train step as ONE multi-controller SPMD program
     over a mesh spanning two OS processes (stage per process) must
@@ -147,6 +151,7 @@ def _expected_dp2pp2_loss():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_hybrid_dp2pp2_across_four_processes():
     """4-process leg (VERDICT r3 #8): dp2 × pp2 hybrid train step over four
     OS processes == single-process 4-device loss; plus the storeless

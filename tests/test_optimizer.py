@@ -145,3 +145,55 @@ def test_jit_update():
     p1, st1 = step(p, st, g)
     p2, _ = step(p1, st1, g)
     assert float(jnp.abs(p2["w"] - p["w"]).sum()) > 0
+
+
+def test_reduced_shape_slot_survives_unflat():
+    """Regression (ADVICE r5): unflat used to reshape ANY 1-D slot keyed
+    by a param name to the param's shape — a slot that is legitimately a
+    REDUCED shape (e.g. a per-row accumulator (rows,) for a 2-D param)
+    crashed or silently mis-shaped. flat() now records which keys it
+    flattened and unflat() only undoes those."""
+
+    class RowNorm(opt_mod.Optimizer):
+        """Toy optimizer with a (rows,) running row-norm slot per 2-D
+        param — the reduced-slot pattern (Adafactor-style factored
+        second moments)."""
+
+        def _init_slots(self, params):
+            return {"rownorm": {
+                k: jnp.zeros(p.shape[:1], jnp.float32) if p.ndim == 2
+                else jnp.zeros(p.shape, jnp.float32)
+                for k, p in params.items()}}
+
+        def _apply(self, grads, params, state, lr, step):
+            new_rn = {}
+            new_p = {}
+            for k, g in grads.items():
+                rn = state["rownorm"][k]
+                if rn.shape != g.shape:      # reduced slot: per-row norm
+                    g2 = g.reshape(rn.shape[0], -1)
+                    rn = 0.9 * rn + 0.1 * jnp.sqrt(
+                        jnp.mean(jnp.square(g2), axis=1))
+                    denom = jnp.repeat(rn + 1e-8,
+                                       g.shape[0] // rn.shape[0])
+                else:
+                    rn = 0.9 * rn + 0.1 * jnp.abs(g)
+                    denom = rn + 1e-8
+                new_rn[k] = rn
+                new_p[k] = params[k] - lr * g / denom
+            return new_p, {"rownorm": new_rn}
+
+    p = {"w": jnp.ones((4, 6)), "b": jnp.zeros((6,))}
+    opt = RowNorm(learning_rate=0.1, multi_precision=False)
+    st = opt.init_state(p)
+    assert st["rownorm"]["w"].shape == (4,)
+    g = {"w": jnp.full((4, 6), 0.5), "b": jnp.full((6,), 0.5)}
+    newp, newst = opt.update(g, st, p)
+    # the reduced slot kept its reduced shape; params kept theirs
+    assert newst["rownorm"]["w"].shape == (4,)
+    assert newst["rownorm"]["b"].shape == (6,)
+    assert newp["w"].shape == (4, 6)
+    assert float(jnp.abs(newp["w"] - p["w"]).sum()) > 0
+    # second step consumes the round-tripped state (shape stability)
+    newp2, newst2 = opt.update(g, newst, newp)
+    assert newst2["rownorm"]["w"].shape == (4,)

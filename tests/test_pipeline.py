@@ -224,6 +224,13 @@ def _run_schedule(schedule, vpp=1, acc=4, n_layers=2, steps=2):
         set_hybrid_communicate_group(None)
 
 
+_OLD_JAX = pytest.mark.skipif(
+    __import__("paddle_tpu.core.jaxcompat", fromlist=["active"]).active(),
+    reason="grad through partial-manual shard_map needs jax 0.9 (0.4.x "
+    "cannot spec scalar device-varying residuals of the transposed body)")
+
+
+@_OLD_JAX
 def test_1f1b_matches_gpipe_and_single_device():
     ref_g, losses_g, st_g = _run_schedule("FThenB")
     ref_f, losses_f, st_f = _run_schedule("1F1B")
@@ -235,6 +242,7 @@ def test_1f1b_matches_gpipe_and_single_device():
                                    err_msg=k)
 
 
+@_OLD_JAX
 def test_interleaved_matches_gpipe():
     S, v = 2, 2
     ref_g, losses_g, st_g = _run_schedule("FThenB", n_layers=4)
@@ -265,6 +273,7 @@ def test_unknown_schedule_raises(pp_fleet):
         make_pipeline_train_step(model, AdamW(learning_rate=1e-3), strategy=s)
 
 
+@pytest.mark.slow
 def test_lazy_guard_aot_matches_eager():
     """LazyGuard (meta-init) models: no parameter buffer is allocated,
     the pipeline AOT lower() path produces byte-identical memory

@@ -1,6 +1,7 @@
 """SD UNet: shapes, conditioning, training objective descends, dp sharding."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,6 +16,7 @@ from paddle_tpu.models.unet import (
 from paddle_tpu.nn.layer import functional_call
 
 
+@pytest.mark.slow
 def test_forward_shape_and_conditioning():
     cfg = UNetConfig.tiny()
     paddle_tpu.seed(0)
@@ -34,6 +36,7 @@ def test_forward_shape_and_conditioning():
     assert float(jnp.abs(e[0] - e[1]).max()) > 0.1
 
 
+@pytest.mark.slow
 def test_ddpm_training_descends():
     cfg = UNetConfig.tiny()
     paddle_tpu.seed(0)

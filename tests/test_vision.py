@@ -2,6 +2,7 @@
 transforms."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -75,6 +76,7 @@ def test_transforms_pipeline():
     assert -1.01 <= out.min() and out.max() <= 1.01
 
 
+@pytest.mark.slow
 def test_backbone_tail_forward_shapes():
     """Round-5 backbones (reference paddle.vision.models
     {densenet,squeezenet,shufflenetv2}): forward shape + param count

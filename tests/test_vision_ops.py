@@ -52,6 +52,16 @@ def test_nms_matches_numpy_oracle():
     np.testing.assert_array_equal(got2, ref2)
 
 
+def test_nms_empty_dtype_matches_nonempty():
+    """ADVICE r5: the n == 0 early-return used int64 while the compacted
+    path returns int32 — callers must see one dtype regardless of size."""
+    empty = V.nms(np.zeros((0, 4), np.float32), 0.5)
+    assert empty.shape == (0,)
+    boxes = np.asarray([[0, 0, 1, 1], [10, 10, 11, 11]], np.float32)
+    nonempty = V.nms(boxes, 0.5)
+    assert empty.dtype == nonempty.dtype == jnp.int32
+
+
 def test_nms_per_category_never_crosses():
     r = np.random.RandomState(1)
     base = np.array([[0, 0, 10, 10]], np.float32)
