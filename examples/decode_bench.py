@@ -189,9 +189,6 @@ def main():
     else:
         state = model.trainable_state()
 
-    if ns.cache_int8 and moe:
-        raise SystemExit("--cache_int8 is not supported for MoE decode "
-                         "(the fused MoE kernel streams a bf16 cache)")
     cache_dtype = jnp.int8 if ns.cache_int8 else jnp.bfloat16
 
     rng = np.random.RandomState(0)

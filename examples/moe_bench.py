@@ -1,9 +1,13 @@
-"""Single-chip MoE training throughput (Mixtral-style, scatter dispatch).
+"""Single-chip MoE training throughput (Mixtral-style).
 
-Exercises the O(T·k) scatter token-dispatch path (the global_scatter/
-gather mechanism analog — SURVEY.md §2.6-EP) under real training on one
-chip. MFU uses activated FLOPs (top-k experts per token, not all E), the
-standard MoE accounting.
+Exercises the token-dispatch hot path (the global_scatter/gather
+mechanism analog — SURVEY.md §2.6-EP) under real training on one chip;
+the default 'fused' dispatch gathers expert input blocks directly from
+the token rows and combines with an inverse-gather segment-sum (the r5
+dispatch-residual redesign). MFU uses activated FLOPs (top-k experts per
+token, not all E), the standard MoE accounting. `--xplane_breakdown`
+dumps the bucketed per-op attribution (dispatch / expert matmul /
+optimizer / attention) so the residual can be tracked across rounds.
 
 Run: python examples/moe_bench.py [--layers 12 --experts 8]
 """
@@ -34,8 +38,13 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--dispatch", default="sort",
-                    choices=["scatter", "sort", "einsum", "alltoall", "dropless"])
+    ap.add_argument("--dispatch", default="fused",
+                    choices=["scatter", "sort", "fused", "einsum",
+                             "alltoall", "dropless"])
+    ap.add_argument("--xplane_breakdown", action="store_true",
+                    help="dump the per-op residual attribution (dispatch / "
+                         "expert matmul / optimizer / attention) from an "
+                         "xplane trace of the timed step")
     # cf=1.0 in this parametrization (cap = cf*k*T/E) IS the GShard top-2
     # capacity convention (2.0*T/E); 1.25 adds headroom at 25% extra
     # expert compute
@@ -116,6 +125,30 @@ def main():
         except Exception:
             pass
 
+    # --xplane_breakdown: bucketed per-op attribution so the next round
+    # can verify the dispatch residual shrank (works on the CPU sim too —
+    # host planes are used when no device plane exists)
+    breakdown = top_ops = None
+    if ns.xplane_breakdown:
+        try:
+            import shutil
+            from paddle_tpu.profiler import xplane
+            shutil.rmtree("/tmp/moe_bench_bd", ignore_errors=True)
+            with jax.profiler.trace("/tmp/moe_bench_bd"):
+                state, opt_state, losses = run(state, opt_state)
+                float(losses[-1])
+            planes = xplane.load_latest("/tmp/moe_bench_bd")
+            rows = xplane.op_summary(planes)
+            if not rows:            # CPU sim: no TPU/GPU plane
+                rows = xplane.op_summary(planes, device_only=False)
+            breakdown = {k: round(v / ns.steps, 3) for k, v in
+                         xplane.bucket_summary(rows).items()}
+            top_ops = [{"name": r["name"][:64],
+                        "total_ms": round(r["total_ms"], 3),
+                        "pct": round(r["pct"], 2)} for r in rows[:10]]
+        except Exception as e:
+            breakdown = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     tok_s = ns.batch * ns.seq * ns.steps / (dt_dev or dt)
     # activated params: attention + top_k of E experts + embeddings
     h, f, e, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts, \
@@ -139,6 +172,8 @@ def main():
         "wall_step_time_ms": round(1000 * dt / ns.steps, 2),
         "timing": "device(xplane)" if dt_dev else "wall",
         "final_loss": round(loss, 4),
+        **({"xplane_breakdown_ms_per_step": breakdown,
+            "xplane_top_ops": top_ops} if ns.xplane_breakdown else {}),
     }))
 
 

@@ -126,8 +126,28 @@ def main():
         sync = lambda out: float(jnp.sum(out.astype(jnp.float32)))
         rebind = lambda out: (state, x0)
 
-    out = runner(args)
-    sync(out)                       # compile + warmup
+    # compile + warmup, guarded: the b4 training program reproducibly
+    # crashed the compiler (ROADMAP r5). Report WHICH config died — with
+    # the bisect pointer — instead of dying with a bare traceback; a
+    # hard compiler abort (SIGABRT) still kills the process, which is
+    # what examples/unet_b4_repro.py's subprocess bisect is for.
+    try:
+        out = runner(args)
+        sync(out)
+    except Exception as e:
+        print(json.dumps({
+            "metric": "sd15-unet COMPILER/RUNTIME CRASH",
+            "crash_config": {
+                "batch": ns.batch, "train": bool(ns.train), "res": res,
+                "attention_levels": list(cfg.attention_levels),
+                "channel_mult": list(cfg.channel_mult),
+                "device": dev.device_kind,
+            },
+            "error": f"{type(e).__name__}: {e}"[:400],
+            "bisect": "python examples/unet_b4_repro.py --max_batch "
+                      f"{ns.batch}",
+        }))
+        sys.exit(1)
     args = rebind(out)
 
     t0 = time.perf_counter()

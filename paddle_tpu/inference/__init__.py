@@ -82,7 +82,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     bf16 and acts as the calibration pass, the stacked cache is quantized
     with per-(layer, kv-head) scales, and every decode step streams int8
     KV + dequantizes on the compute path. Requires the fused decode plan
-    (llama/gpt archs).
+    (llama, gpt and moe archs).
     """
     from paddle_tpu.core.flags import flag
 
@@ -100,11 +100,10 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             and hasattr(model, "fused_decode_plan") else None)
     if plan is not None and b > plan.get("max_batch", b):
         plan = None     # e.g. MoE no-drop bound b ≤ per-expert capacity
-    if kv_int8 and (plan is None or plan.get("arch") == "moe"):
+    if kv_int8 and plan is None:
         raise ValueError(
-            "cache_dtype=int8 requires the fused decode path (llama/gpt "
-            "archs with an eligible fused_decode_plan); this model/config "
-            "cannot ride it")
+            "cache_dtype=int8 requires the fused decode path (an eligible "
+            "fused_decode_plan); this model/config cannot ride it")
     if plan is not None:
         total = -(-total // 128) * 128
     # int8 mode prefills through the layered path in bf16 (the

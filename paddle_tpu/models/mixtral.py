@@ -37,7 +37,8 @@ class MixtralConfig(LlamaConfig):
     aux_loss_weight: float = 0.01
     num_shared_experts: int = 0       # DeepSeekMoE: always-on experts
     moe_gate: str = "gshard"          # 'gshard' (top-k) | 'switch' (top-1)
-    moe_dispatch: str = "scatter"     # 'scatter'|'sort'|'einsum'|'alltoall'
+    moe_dispatch: str = "scatter"     # 'scatter'|'sort'|'fused'|'einsum'
+                                      # |'alltoall'
     moe_dropless: bool = False        # sort + ragged_dot, no capacity drops
     ep_axes: tuple = ("dp",)          # mesh axes the expert dim shards over
 
@@ -201,11 +202,21 @@ class MixtralForCausalLM(CausalLMBase):
                 break
         if max_batch == 0:
             return None
+        from paddle_tpu.ops import fused_decode as fd
+        hd = cfg.head_dim
+        dq = cfg.num_heads * hd
+        # decode_block_plan records cache_wbytes for the kernel's chunk
+        # sizing + consistency assert; the MoE kernel plans its own
+        # expert blocks, so the qkv/ffn split fields are informational
+        blocks = fd.decode_block_plan(
+            cfg.hidden_size, dq + 2 * cfg.kv_heads * hd, dq, hd,
+            cfg.intermediate_size, wbytes=2)
         meta = {
             "num_heads": cfg.num_heads, "num_kv_heads": cfg.kv_heads,
             "head_dim": cfg.head_dim, "eps": cfg.rms_norm_eps,
             "rope_base": cfg.rope_base, "arch": "moe",
             "top_k": gate.top_k, "max_batch": max_batch,
+            "blocks": blocks,
         }
         if probe:
             return meta

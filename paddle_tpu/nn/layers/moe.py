@@ -129,6 +129,82 @@ def _permute_rows_bwd(res, g):
 _permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
 
 
+def _f0(a):
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _gather_dispatch(xt, buf_src, hit, slot_cl, keep, k):
+    """out[s] = hit[s] ? xt[buf_src[s] // k] : 0 — dispatch straight from
+    the (t, h) token rows into the flat (E·cap, h) per-expert blocks.
+
+    Unlike `_forward_sort`'s two-step (materialize (t·k, h) row copies,
+    then permute them), the token index is recovered from the copy index
+    in the gather itself, so the expensive row movement is ONE gather per
+    direction and the (t·k, h) intermediate never exists. Backward is the
+    inverse gather (slot_cl/keep) followed by a contiguous segment-sum
+    over each token's k copy rows — no row scatter anywhere."""
+    out = jnp.take(xt, jnp.where(hit, buf_src // k, 0), axis=0)
+    return jnp.where(hit[:, None], out, 0)
+
+
+def _gather_dispatch_fwd(xt, buf_src, hit, slot_cl, keep, k):
+    return _gather_dispatch(xt, buf_src, hit, slot_cl, keep, k), \
+        (buf_src, hit, slot_cl, keep)
+
+
+def _gather_dispatch_bwd(k, res, g):
+    buf_src, hit, slot_cl, keep = res
+    rows = jnp.take(g, jnp.where(keep, slot_cl, 0), axis=0)
+    rows = jnp.where(keep[:, None], rows, 0)            # (t·k, h)
+    t = keep.shape[0] // k
+    dx = rows.reshape(t, k, -1).sum(axis=1)             # segment-sum
+    return dx, _f0(buf_src), _f0(hit), _f0(slot_cl), _f0(keep)
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(ye, w, slot_cl, keep, buf_src, hit):
+    """yt[t] = Σ_c w[t, c] · ye[slot(t, c)] — the combine as one
+    inverse-permutation gather plus a per-token segment-sum over the k
+    contiguous copy rows (the einsum below contracts exactly that).
+
+    Backward re-disperses the incoming grad into the expert blocks with
+    the FORWARD maps — d_ye[s] = w[token(s), choice(s)] · g[token(s)],
+    again one gather — so neither direction lowers to an XLA row scatter
+    (TPU row scatters serialize; gathers run near bandwidth)."""
+    t, k = w.shape
+    rows = jnp.take(ye, jnp.where(keep, slot_cl, 0), axis=0)
+    rows = jnp.where(keep[:, None], rows, 0).reshape(t, k, -1)
+    return jnp.einsum("tk,tkh->th", w, rows)
+
+
+def _combine_gather_fwd(ye, w, slot_cl, keep, buf_src, hit):
+    return _combine_gather(ye, w, slot_cl, keep, buf_src, hit), \
+        (ye, w, slot_cl, keep, buf_src, hit)
+
+
+def _combine_gather_bwd(res, g):
+    ye, w, slot_cl, keep, buf_src, hit = res
+    t, k = w.shape
+    # d_ye: expert slot s receives its token's grad row scaled by its
+    # gate weight — a gather over the forward copy→slot map
+    src = jnp.where(hit, buf_src, 0)
+    w_slot = jnp.where(hit, jnp.take(w.reshape(-1), src), 0)
+    d_ye = (jnp.take(g, src // k, axis=0)
+            * w_slot[:, None]).astype(ye.dtype)
+    # d_w recomputes the gathered rows (cheap vs carrying (t·k, h))
+    rows = jnp.take(ye, jnp.where(keep, slot_cl, 0), axis=0)
+    rows = jnp.where(keep[:, None], rows, 0).reshape(t, k, -1)
+    dw = jnp.einsum("th,tkh->tk", g, rows).astype(w.dtype)
+    return d_ye, dw, _f0(slot_cl), _f0(keep), _f0(buf_src), _f0(hit)
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
+
+
 def topk_routing(logits, k: int, capacity: int, normalize_topk: bool = True):
     """GShard-style top-k routing with static capacity — compact form.
 
@@ -299,7 +375,8 @@ class MoELayer(Layer):
         gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate]
         if gate == "switch" and top_k not in (None, 1):
             raise ValueError(f"gate='switch' is top-1 routing; got top_k={top_k}")
-        if dispatch_mode not in ("scatter", "sort", "einsum", "alltoall"):
+        if dispatch_mode not in ("scatter", "sort", "fused", "einsum",
+                                 "alltoall"):
             raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
         self.gate = gate_cls(hidden_size, num_experts,
                              capacity_factor=capacity_factor)
@@ -332,7 +409,9 @@ class MoELayer(Layer):
         inverse copy→slot map (_perm_maps), then dispatch and combine run
         as row gathers in forward AND backward (custom-VJP
         inverse-permutation) — no ROW scatter anywhere. TPU row-scatters
-        serialize; gathers run near bandwidth. Single-chip default."""
+        serialize; gathers run near bandwidth. Kept as the A/B baseline
+        for 'fused', which removes this path's (t·k, h) copy
+        materialization and one permutation pass per direction."""
         e = self.num_experts
         t, h = xt.shape
         idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)
@@ -347,6 +426,34 @@ class MoELayer(Layer):
         gathered = _permute_rows(ye, slot_cl, keep_f, buf_src, hit)
         w = (vals * keep).astype(dtype)
         yt = jnp.einsum("tk,tkh->th", w, gathered.reshape(t, k, h))
+        return yt, aux, stats
+
+    def _forward_fused(self, xt, dtype):
+        """Fused permutation dispatch — the r5 dispatch-residual redesign.
+
+        'sort' runs FOUR row passes per direction (materialize the
+        (t·k, h) token copies, permute them into the expert buffer;
+        permute the outputs back, weighted-sum them). Here the dispatch
+        permutation is fused with the expert matmul input staging: the
+        (E, cap, h) blocks are gathered DIRECTLY from the (t, h) token
+        rows (token index recovered from the inverse copy→slot map inside
+        the gather), and the combine is one inverse gather + per-token
+        segment-sum with the gate weights. Two row passes per direction,
+        no (t·k, h) intermediate, still zero row scatters (custom VJPs
+        mirror each gather with its inverse)."""
+        e = self.num_experts
+        t, h = xt.shape
+        idx, vals, pos, keep, aux, stats, cap = self.gate.route(xt)
+        k = idx.shape[1]
+        keep_f = keep.reshape(-1)
+        slot = _slots(idx, pos, keep, cap, e)
+        slot_cl = jnp.clip(slot, 0, e * cap - 1)
+        buf_src, hit = _perm_maps(slot, e, cap, t * k)
+        buf = _gather_dispatch(xt.astype(dtype), buf_src, hit, slot_cl,
+                               keep_f, k)
+        ye = self.experts(buf.reshape(e, cap, h)).reshape(e * cap, h)
+        w = (vals * keep).astype(dtype)
+        yt = _combine_gather(ye, w, slot_cl, keep_f, buf_src, hit)
         return yt, aux, stats
 
     def _forward_einsum(self, xt, dtype):
@@ -488,6 +595,8 @@ class MoELayer(Layer):
             yt, aux, stats = self._forward_capacity(xt, x.dtype)
         elif self.dispatch_mode == "sort":
             yt, aux, stats = self._forward_sort(xt, x.dtype)
+        elif self.dispatch_mode == "fused":
+            yt, aux, stats = self._forward_fused(xt, x.dtype)
         elif self.dispatch_mode == "alltoall":
             yt, aux, stats = self._forward_alltoall(xt, x.dtype)
         else:

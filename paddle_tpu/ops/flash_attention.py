@@ -175,10 +175,11 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     (`_mask_block_bounds` skips blocks whose entries are all below it, and
     such scores never survive the online softmax). Use ≤ −1e9 (or −inf)
     to mean "masked", and keep finite soft penalties (score biases you
-    want softmax to weigh) well above it — a penalty at or below the
-    threshold is dropped exactly on the Pallas path but only
-    exponentially suppressed on the XLA path, so the two backends would
-    silently diverge.
+    want softmax to weigh) well above it. CONCRETE masks holding finite
+    entries at or below the threshold that are not −inf (e.g. a −1e10
+    soft penalty) are routed to the XLA path automatically so the two
+    backends agree; a TRACED mask (built inside jit) can't be inspected,
+    so there the threshold convention above is on the caller.
     """
     from paddle_tpu.ops import use_pallas
     seg_q = segment_ids
@@ -223,7 +224,20 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     # on (seed, b, h, q-block, k-block), identical fwd/bwd masks).
     eff_dropout = float(dropout_p) if training else 0.0
     kmask = _kernel_mask(attn_mask, q.shape, k.shape)
-    if use_pallas() and (attn_mask is None or kmask is not None):
+    pallas_ok = use_pallas() and (attn_mask is None or kmask is not None)
+    if (pallas_ok and kmask is not None
+            and jnp.issubdtype(kmask.dtype, jnp.floating)
+            and not isinstance(kmask, jax.core.Tracer)):
+        # Finite soft penalties at/below the −1e9 "effectively masked"
+        # threshold (e.g. −1e10) would be block-skipped EXACTLY on the
+        # Pallas path but only exponentially suppressed by XLA's softmax.
+        # A concrete mask can be inspected: route such masks to the XLA
+        # path so the backends agree (−inf means "masked" and stays
+        # kernel-eligible). The reduction runs ON DEVICE — only the bool
+        # verdict syncs to host, not the (b, h, sq, sk) mask itself.
+        if bool(jnp.any((kmask <= -1e9) & ~jnp.isneginf(kmask))):
+            pallas_ok = False
+    if pallas_ok:
         padded = _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k)
         if padded is not None:
             qp, kp, vp, scale_p, klp, skp, hd = padded
@@ -923,7 +937,9 @@ def _flash_call(q, k, v, is_causal, scale, kv_lens, seg_q, seg_k,
             try:
                 from jax._src import core as _core
                 traced = not _core.trace_state_clean()
-            except ImportError:
+            except (ImportError, AttributeError):
+                # private probe symbol: module or attribute may be gone
+                # on other jax versions — treat as eager (warn path)
                 traced = False
             if traced:
                 raise RuntimeError(

@@ -1021,10 +1021,11 @@ def _fused_decode_pallas(x, params, kv_cache, pos, *,
 
 
 def _pick_expert_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
-                        budget: Optional[int] = None):
+                        budget: Optional[int] = None, nbuf: int = 2):
     """Smallest J (ffn % J == 0, block a 128-lane multiple — expert-weight
-    DMAs slice the lane dim) whose double-buffered expert blocks fit the
-    VMEM budget on top of `fixed_bytes`."""
+    DMAs slice the lane dim) whose `nbuf`-buffered expert blocks fit the
+    VMEM budget on top of `fixed_bytes` (nbuf=3 for the prefetch-two-ahead
+    routed-expert pipeline)."""
     if budget is None:
         budget = _vmem_budget_bytes()
     best = None
@@ -1032,7 +1033,7 @@ def _pick_expert_blocks(ffn: int, h: int, fixed_bytes: int, wbytes: int,
         if ffn % j or (ffn // j) % 128:
             continue
         fblk = ffn // j
-        need = fixed_bytes + 2 * 3 * fblk * h * wbytes + 8 * 2 ** 20
+        need = fixed_bytes + nbuf * 3 * fblk * h * wbytes + 8 * 2 ** 20
         best = (j, fblk)              # smallest valid block so far
         if need <= budget:
             return j, fblk
@@ -1048,6 +1049,8 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                              head_dim: int, top_k: int,
                              rope_base: float = 10000.0,
                              eps: float = 1e-5, chunk: int = 0,
+                             blocks: Optional[Dict] = None,
+                             kv_scales=None,
                              interpret: bool = False):
     """Fused MoE decode step: llama attention block + top-k expert FFN with
     DATA-DEPENDENT weight streaming.
@@ -1056,18 +1059,33 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
     BlockSpecs — impossible here because which expert's weights are needed
     is decided by the router *inside* the kernel. Instead the expert
     stacks stay in HBM (`pl.ANY`) and the kernel hand-rolls a
-    double-buffered async-copy pipeline over b·top_k slots per layer,
-    fetching ONLY the routed experts' weights — decode is
+    PREFETCH-TWO-AHEAD async-copy pipeline over b·top_k slots per layer
+    (3 VMEM buffers, copies for steps u+1 AND u+2 in flight while step u
+    computes), fetching ONLY the routed experts' weights — decode is
     weight-bandwidth-bound, so per-token traffic drops from E experts to
     top_k (the TPU-native analog of the reference's fused MoE inference:
     fused_multi_transformer + global_scatter, SURVEY §2.2 fusion + §2.6
-    EP).
+    EP). The depth-2 prefetch is the b=1 bubble fix (r5: 72% of
+    roofline): with double buffering, slot u+1's weights were only
+    requested when slot u's matmul began, so small b·k left the DMA
+    engine idle across the slot turnaround; now the attention/router
+    phase launches slots 0 and 1 together and every FFN step keeps two
+    fetches in flight.
 
-    Grid (L, 1 + b·k·J): phase 0 = attention + router (argmax top-k into
-    SMEM so the DMA engine can address expert slices); phases 1.. = one
-    (row, choice, ffn-block) expert matmul each, weights for step t+1 in
-    flight during step t. Requires b·top_k ≤ routing capacity (no-drop —
-    the eligibility gate) and E % 8 == 0.
+    Grid (L, 1 + Js + b·k·J): phase 0 = attention + router (argmax top-k
+    into SMEM so the DMA engine can address expert slices); phases 1.. =
+    one (row, choice, ffn-block) expert matmul each. Requires b·top_k ≤
+    routing capacity (no-drop — the eligibility gate) and E % 8 == 0.
+
+    int8 KV cache mode (kv_cache int8 + kv_scales (L, 1, 2*dkv) fp32 —
+    see `quantize_kv_cache`): same folding as the llama/gpt kernel — the
+    k-half scales fold into the block-diagonal q rows, the v-half scales
+    apply once to the normalized attention output, and the RMW append
+    quantizes the new token with the static per-head scales. `blocks`
+    (a `decode_block_plan` dict) is consistency-checked: the plan's
+    `cache_wbytes` must match the actual cache dtype, and the KV chunk
+    is sized from the CACHE element size, so an int8 cache streams
+    double-length chunks at unchanged chunk bytes.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -1087,25 +1105,53 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
     k = top_k
     nslots = b * k
     wbytes = 2
+    kvq = kv_scales is not None
+    assert kvq == (jnp.dtype(kv_cache.dtype) == jnp.int8), \
+        "int8 KV cache needs kv_scales (and vice versa)"
+    cb = jnp.dtype(kv_cache.dtype).itemsize
+    if blocks is not None:
+        assert blocks.get("cache_wbytes", cb) == cb, \
+            (f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+             f"cache but the cache dtype is {kv_cache.dtype} ({cb} B)")
     shared = "wsg" in params
     fs = params["wsg"].shape[2] if shared else 0
+    NBUF, PF = 3, 2        # prefetch-two-ahead triple-buffered pipeline
     # attention weights ride the Mosaic pipeline (double-buffered), expert
     # blocks ride the manual pipeline — both count against VMEM, as do the
     # block-diagonal q staging and the fori_loop-carried attention acc
     attn_fixed = 2 * (dqkv + dq + E) * h * wbytes + 2 * b * nh * dkv * 4
     J, fblk = _pick_expert_blocks(ffn, h, fixed_bytes=attn_fixed,
-                                  wbytes=wbytes)
+                                  wbytes=wbytes, nbuf=NBUF)
     if shared:
         # DeepSeekMoE dense shared experts: Mosaic-pipelined column
         # blocks like the llama FFN, budgeted AFTER the expert buffers
         Js, fsblk = _pick_expert_blocks(
-            fs, h, fixed_bytes=attn_fixed + 2 * 3 * fblk * h * wbytes,
+            fs, h, fixed_bytes=attn_fixed + NBUF * 3 * fblk * h * wbytes,
             wbytes=wbytes)
     else:
         Js, fsblk = 0, 0
     nsteps = nslots * J
     if not chunk:
+        # KV chunk sized from the CACHE element size: candidates are
+        # equal-BYTE chunks, so the int8 cache (cb=1) streams 256-token
+        # chunks where bf16 streamed 128 — half the DMA turnarounds on
+        # the same chunk bytes (the cache_wbytes accounting the plan
+        # records). Capped by the scoped-VMEM limit next to the
+        # attention weights + expert buffers.
         chunk = 128
+        wfix = (2 * (dqkv + dq + E) * h * wbytes
+                + NBUF * 3 * fblk * h * wbytes
+                + (2 * 3 * fsblk * h * wbytes if shared else 0))
+        scratch_fixed = (b * 8 * 2 * dkv * cb + b * 2 * dkv * 4
+                         + 2 * b * nh * dkv * 4 + b * h * 10)
+        order = (256, 128, 64, 32, 16, 8) if cb == 1 else \
+            (128, 64, 32, 16, 8)
+        for cand in order:
+            if S % cand == 0 and (wfix + scratch_fixed + 6 * 2 ** 20
+                                  + 2 * b * cand * 2 * dkv * cb
+                                  <= _vmem_limit_bytes()):
+                chunk = cand
+                break
     ck = min(chunk, S)
     assert S % ck == 0, f"cache len {S} not a multiple of chunk {ck}"
     assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
@@ -1120,6 +1166,9 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
         if shared:
             wsg_ref, wsu_ref, wsd_ref = refs[i:i + 3]
             i += 3
+        if kvq:
+            kvs_ref = refs[i]            # (1, 2*dkv) per-head cache scales
+            i += 1
         kv_in = refs[i]
         x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
         (x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
@@ -1197,7 +1246,13 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                     kv_ref.at[li, :, pl.ds(c * ck, ck)],
                     kvch_s.at[slot], rsem.at[slot])
 
-            qbd = q_s[...]
+            # batched-head q; in int8-cache mode the k-half dequant
+            # scales fold in here (one broadcast multiply — off-block
+            # lanes are zero either way)
+            if kvq:
+                qbd = q_s[...] * kvs_ref[...][:, :dkv][None]
+            else:
+                qbd = q_s[...]
 
             def merge(carry, kvblk, idx, limit):
                 m, l, acc = carry
@@ -1240,8 +1295,12 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
 
             rkb.wait()
             sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off
+            newtok = kv32_s[...]
+            if kvq:         # quantize the append with the static scales
+                newtok = jnp.clip(
+                    jnp.round(newtok / kvs_ref[...]), -127.0, 127.0)
             kvblk_s[...] = jnp.where(
-                sel, kv32_s[...][:, None, :],
+                sel, newtok[:, None, :],
                 kvblk_s[...].astype(jnp.float32)).astype(kv_cache.dtype)
             wkb = pltpu.make_async_copy(
                 kvblk_s, kv_ref.at[li, :, pl.ds(blk, 8)], wsem.at[0])
@@ -1250,6 +1309,8 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             ms, ls, accs = merge(carry, kvblk_s[...], bidx, pos + 1)
 
             norm = accs / ls[..., None]                     # (b, nh, dkv)
+            if kvq:         # v-half dequant scales, applied once
+                norm = norm * kvs_ref[...][:, dkv:][None]
             if rep == 1:
                 bd = (lax.broadcasted_iota(jnp.int32, (1, nh, dkv), 2)
                       // hd == lax.broadcasted_iota(
@@ -1301,8 +1362,15 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             for c in range(k):
                 egw_s[:, c] = vals[c] / tot
             acc_s[...] = jnp.zeros_like(acc_s)
+            # prime the prefetch-two-ahead pipeline: steps 0 AND 1 go out
+            # together, so slot 1's weights stream during the shared-FFN
+            # phases and slot 0's matmul instead of waiting for slot 0 to
+            # finish (the b=1 slot-turnaround bubble)
             for cp in expert_copies(0, 0):
                 cp.start()
+            if nsteps > 1:
+                for cp in expert_copies(1, 1):
+                    cp.start()
 
         @pl.when(t == 1)
         def prefetch_next_layer():
@@ -1341,14 +1409,18 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
         @pl.when(t > Js)
         def ffn_phase():
             u = t - 1 - Js
-            buf = lax.rem(u, 2)
+            buf = lax.rem(u, NBUF)
 
             for cp in expert_copies(u, buf):
                 cp.wait()
 
-            @pl.when(u + 1 < nsteps)
+            # steps u+1's copies are already in flight (issued at step
+            # u-1, or primed by the router phase); top up the pipeline
+            # with step u+PF. Buffer (u+PF) % NBUF was last read at step
+            # u-1 (NBUF = PF+1), which this sequential grid has finished.
+            @pl.when(u + PF < nsteps)
             def _():
-                for cp in expert_copies(u + 1, 1 - buf):
+                for cp in expert_copies(u + PF, lax.rem(u + PF, NBUF)):
                     cp.start()
 
             s = u // J
@@ -1408,7 +1480,9 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
                          lambda l, t: (sl(l, t), 0, sjm(l, t))),    # wsu
             pl.BlockSpec((None, fsblk, h),
                          lambda l, t: (sl(l, t), sjm(l, t), 0)),    # wsd
-        ] if shared else []) + [
+        ] if shared else []) + ([
+            pl.BlockSpec((None, 1, 2 * dkv), lambda l, t: (l, 0, 0)),  # kvs
+        ] if kvq else []) + [
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv_cache
         ],
         out_specs=[
@@ -1431,12 +1505,12 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
             pltpu.SemaphoreType.DMA((2,)),            # rsem
             pltpu.SMEM((b, k), jnp.int32),            # eid_s
             pltpu.VMEM((b, k), jnp.float32),          # egw_s
-            pltpu.VMEM((2, h, fblk), dtype),          # ewg_s
-            pltpu.VMEM((2, h, fblk), dtype),          # ewu_s
-            pltpu.VMEM((2, fblk, h), dtype),          # ewd_s
-            pltpu.SemaphoreType.DMA((2, 3)),          # esem
+            pltpu.VMEM((NBUF, h, fblk), dtype),       # ewg_s
+            pltpu.VMEM((NBUF, h, fblk), dtype),       # ewu_s
+            pltpu.VMEM((NBUF, fblk, h), dtype),       # ewd_s
+            pltpu.SemaphoreType.DMA((NBUF, 3)),       # esem
         ],
-        input_output_aliases={10 + 3 * shared: 1},
+        input_output_aliases={10 + 3 * shared + kvq: 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=_vmem_limit_bytes()),
@@ -1447,6 +1521,7 @@ def _fused_decode_moe_pallas(x, params, kv_cache, pos, *,
       params["ln2"][:, None], params["gate"],
       params["weg"], params["weu"], params["wed"],
       *((params["wsg"], params["wsu"], params["wsd"]) if shared else ()),
+      *((jnp.asarray(kv_scales, jnp.float32),) if kvq else ()),
       kv_cache)
     return out[0], out[1]
 
@@ -1464,9 +1539,10 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
     be traced (it is the scan counter inside `inference.generate`).
     `top_k` applies to arch="moe" only. `blocks` is a `decode_block_plan`
-    dict (the plan that padded the params must also drive the kernel).
-    `kv_scales` enables the int8 KV-cache mode (llama/gpt archs; see
-    quantize_kv_cache).
+    dict (the plan that padded the params must also drive the kernel; for
+    arch="moe" only its `cache_wbytes` is consumed — consistency-checked
+    against the cache dtype). `kv_scales` enables the int8 KV-cache mode
+    (all three archs; see quantize_kv_cache).
 
     FLAGS_pallas_interpret=1 routes the Pallas kernel through interpret
     mode off-TPU — the CPU-CI path for kernel-logic parity tests.
@@ -1475,18 +1551,28 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
     from paddle_tpu.ops import use_pallas
     dkv = kv_cache.shape[-1] // 2
     interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
-    if kv_scales is not None and arch == "moe":
-        raise NotImplementedError(
-            "int8 KV cache is not supported for the fused MoE kernel")
     if (use_pallas() or interp) and dkv % 128 == 0 \
             and kv_cache.shape[2] % 128 == 0:
+        # plan/cache consistency is a CONTRACT error, not a hardware
+        # failure: check it before the fallback try so a stale plan can't
+        # silently demote every kernel-eligible step to the jnp reference
+        # path. (The reference path itself ignores `blocks` — an f32
+        # cache on a non-kernel backend stays valid.)
+        cb = jnp.dtype(kv_cache.dtype).itemsize
+        if blocks is not None and blocks.get("cache_wbytes", cb) != cb:
+            raise ValueError(
+                f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+                f"cache but the cache dtype is {kv_cache.dtype} ({cb} B); "
+                f"rebuild the plan with decode_block_plan(cache_wbytes="
+                f"{cb})")
         try:
             if arch == "moe":
                 return _fused_decode_moe_pallas(
                     x, params, kv_cache, pos,
                     num_heads=num_heads, num_kv_heads=num_kv_heads,
                     head_dim=dkv // num_kv_heads, top_k=top_k,
-                    rope_base=rope_base, eps=eps, interpret=interp)
+                    rope_base=rope_base, eps=eps, blocks=blocks,
+                    kv_scales=kv_scales, interpret=interp)
             return _fused_decode_pallas(
                 x, params, kv_cache, pos,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,

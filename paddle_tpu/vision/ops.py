@@ -81,44 +81,17 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return jnp.asarray(kept, jnp.int32)
 
 
-def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True):
-    """paddle.vision.ops.roi_align (NCHW): average of bilinear samples on
-    a static grid per output bin.
+_roi_adaptive_warned = False
 
-    Divergence note: the reference's default sampling_ratio<=0 ADAPTS the
-    grid per RoI (ceil(roi_h/pooled_h) samples) — a data-dependent shape
-    jit cannot express; here the default is a fixed 2 samples/bin (the
-    common configured value). Pass sampling_ratio explicitly for exact
-    parity with a configured reference model. Samples falling more than
-    one pixel outside the image contribute ZERO (reference semantics),
-    nearer out-of-range samples clamp to the border."""
-    x = jnp.asarray(x)
-    boxes = jnp.asarray(boxes, jnp.float32)
-    if isinstance(output_size, int):
-        output_size = (output_size, output_size)
-    ph, pw = output_size
+
+def _roi_align_grid(x, batch_idx, x1, y1, rw, rh, ph, pw, sry, srx):
+    """roi_align over one group of RoIs with a fixed (sry, srx)
+    samples/bin grid (static shapes — vmap-able)."""
     n, c, h, w = x.shape
-    nb = boxes.shape[0]
-    # batch index per roi from boxes_num
-    bn = np.asarray(boxes_num)
-    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
-    off = 0.5 if aligned else 0.0
-    x1 = boxes[:, 0] * spatial_scale - off
-    y1 = boxes[:, 1] * spatial_scale - off
-    x2 = boxes[:, 2] * spatial_scale - off
-    y2 = boxes[:, 3] * spatial_scale - off
-    rw = x2 - x1
-    rh = y2 - y1
-    if not aligned:
-        rw = jnp.maximum(rw, 1.0)
-        rh = jnp.maximum(rh, 1.0)
-    sr = sampling_ratio if sampling_ratio > 0 else 2
-    # sample grid: (nb, ph*sr) y coords, (nb, pw*sr) x coords
-    ys = (y1[:, None] + (jnp.arange(ph * sr) + 0.5)[None, :]
-          * (rh[:, None] / (ph * sr)))
-    xs = (x1[:, None] + (jnp.arange(pw * sr) + 0.5)[None, :]
-          * (rw[:, None] / (pw * sr)))
+    ys = (y1[:, None] + (jnp.arange(ph * sry) + 0.5)[None, :]
+          * (rh[:, None] / (ph * sry)))
+    xs = (x1[:, None] + (jnp.arange(pw * srx) + 0.5)[None, :]
+          * (rw[:, None] / (pw * srx)))
 
     def bilinear(img, yy, xx):
         """img (c, h, w); yy (P,), xx (Q,) → (c, P, Q)."""
@@ -141,11 +114,79 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     def one(bi, yy, xx):
         img = x[bi]
-        s = bilinear(img, yy, xx)                    # (c, ph*sr, pw*sr)
-        s = s.reshape(c, ph, sr, pw, sr)
+        s = bilinear(img, yy, xx)                   # (c, ph*sry, pw*srx)
+        s = s.reshape(c, ph, sry, pw, srx)
         return s.mean(axis=(2, 4))
 
     return jax.vmap(one)(batch_idx, ys, xs)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """paddle.vision.ops.roi_align (NCHW): average of bilinear samples on
+    a static grid per output bin.
+
+    sampling_ratio<=0 reproduces the reference's ADAPTIVE grid —
+    ceil(roi_h/pooled_h) × ceil(roi_w/pooled_w) samples per bin, per
+    RoI — whenever the boxes are concrete (the common eager/predictor
+    case): RoIs are grouped by grid size and each group runs the static
+    vmap kernel. Under jit the boxes are traced (data-dependent shapes
+    cannot be expressed), so the default falls back to a fixed 2
+    samples/bin with a ONE-TIME warning; pass sampling_ratio explicitly
+    for exact traced parity with a configured reference model. Samples
+    falling more than one pixel outside the image contribute ZERO
+    (reference semantics), nearer out-of-range samples clamp to the
+    border."""
+    x = jnp.asarray(x)
+    concrete_boxes = not isinstance(boxes, jax.core.Tracer)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    # batch index per roi from boxes_num
+    bn = np.asarray(boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    if sampling_ratio > 0:
+        return _roi_align_grid(x, batch_idx, x1, y1, rw, rh, ph, pw,
+                               sampling_ratio, sampling_ratio)
+    if not concrete_boxes:
+        global _roi_adaptive_warned
+        if not _roi_adaptive_warned:
+            _roi_adaptive_warned = True
+            import warnings
+            warnings.warn(
+                "roi_align: sampling_ratio<=0 under jit uses a fixed 2 "
+                "samples/bin (the reference's adaptive ceil(roi/pooled) "
+                "grid needs concrete boxes); pass sampling_ratio "
+                "explicitly to pin the grid and silence this warning")
+        return _roi_align_grid(x, batch_idx, x1, y1, rw, rh, ph, pw, 2, 2)
+    # reference-exact adaptive grid: group RoIs by their
+    # (ceil(rh/ph), ceil(rw/pw)) sample counts, run each group static
+    rh_np, rw_np = np.asarray(rh), np.asarray(rw)
+    sry = np.maximum(np.ceil(rh_np / ph), 1).astype(np.int64)
+    srx = np.maximum(np.ceil(rw_np / pw), 1).astype(np.int64)
+    # same output dtype as the fixed-grid paths (f32 coords promote the
+    # bilinear math), so eager/adaptive and jit/fallback results agree
+    odt = jnp.result_type(x.dtype, jnp.float32)
+    out = jnp.zeros((boxes.shape[0], c, ph, pw), odt)
+    for sy, sx in sorted(set(zip(sry.tolist(), srx.tolist()))):
+        sel = np.where((sry == sy) & (srx == sx))[0]
+        idx = jnp.asarray(sel, jnp.int32)
+        sub = _roi_align_grid(x, batch_idx[idx], x1[idx], y1[idx],
+                              rw[idx], rh[idx], ph, pw, int(sy), int(sx))
+        out = out.at[idx].set(sub.astype(out.dtype))
+    return out
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):

@@ -107,6 +107,41 @@ def test_attention_gqa():
     assert out.shape == (b, s, hq, d)
 
 
+def test_attention_soft_penalty_mask_routes_to_xla(monkeypatch):
+    """A concrete float mask with FINITE entries <= -1e9 that are not
+    -inf (a -1e10 soft penalty) must skip the Pallas path — the kernel
+    would block-skip it exactly while XLA suppresses it exponentially.
+    Force use_pallas() True with strict mode on: the penalty mask must
+    come back via XLA (no kernel error), while an eligible bool mask
+    proves the patch really drives the kernel path (raises off-TPU)."""
+    import paddle_tpu.ops as ops_pkg
+    from paddle_tpu.core.flags import set_flags
+
+    b, s, h, d = 1, 1024, 2, 64       # >= 1024: kernel-eligible seq
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    penalty = jnp.zeros((1, 1, s, s), jnp.float32).at[..., s // 2:].set(
+        -1e10)
+    ref = F.scaled_dot_product_attention(q, q, q, attn_mask=penalty)
+    monkeypatch.setattr(ops_pkg, "use_pallas", lambda: True)
+    set_flags({"FLAGS_pallas_strict": True})
+    try:
+        out = F.scaled_dot_product_attention(q, q, q, attn_mask=penalty)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        # -1e10 entries are NOT fully masked on the XLA path: they must
+        # still contribute (exp(-1e10 - max) == 0 in fp32 — but rows
+        # fully under the penalty keep finite outputs, no NaNs)
+        assert np.isfinite(np.asarray(out)).all()
+        with pytest.raises(Exception):
+            # an eligible bool mask heads INTO the kernel path — which
+            # cannot lower off-TPU, proving the routing check (not the
+            # patch) is what saved the penalty mask above
+            F.scaled_dot_product_attention(
+                q, q, q, attn_mask=jnp.ones((1, 1, s, s), bool))
+    finally:
+        set_flags({"FLAGS_pallas_strict": False})
+
+
 def test_attention_kv_lens_masks_padding():
     """kv_lens=L must equal slicing k/v to length L."""
     b, s, h, d = 2, 16, 2, 8

@@ -151,6 +151,68 @@ def test_decode_block_plan_cache_wbytes_recorded():
     assert plan8["cache_wbytes"] == 1
 
 
+def test_moe_plan_threads_cache_wbytes():
+    """arch='moe' plans carry a decode_block_plan whose cache_wbytes the
+    kernel consistency-checks against the actual cache dtype."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle_tpu.seed(0)
+    cfg = MixtralConfig(vocab_size=256, hidden_size=128,
+                        intermediate_size=256, num_layers=2, num_heads=4,
+                        num_kv_heads=2, max_position_embeddings=512,
+                        num_experts=8, top_k=2)
+    m = MixtralForCausalLM(cfg).bfloat16()
+    plan = m.fused_decode_plan(m.state_dict(include_buffers=False),
+                               probe=True)
+    assert plan["arch"] == "moe"
+    assert plan["blocks"]["cache_wbytes"] == 2
+    # a bf16 plan driving an int8 cache (or vice versa) must be refused
+    r = np.random.RandomState(0)
+    f = lambda *s: jnp.asarray(r.randn(*s) * 0.05, jnp.bfloat16)
+    L, h, hd, nkv, nh, E, ffn = 2, 256, 64, 2, 4, 8, 256
+    params = {"ln1": jnp.ones((L, h), jnp.bfloat16),
+              "wqkv": f(L, h, (nh + 2 * nkv) * hd), "wo": f(L, nh * hd, h),
+              "ln2": jnp.ones((L, h), jnp.bfloat16), "gate": f(L, E, h),
+              "weg": f(L, E, h, ffn), "weu": f(L, E, h, ffn),
+              "wed": f(L, E, ffn, h)}
+    kv = f(L, 1, 128, 2 * nkv * hd)
+    with pytest.raises(AssertionError, match="cache"):
+        fd._fused_decode_moe_pallas(
+            f(1, h), params, kv, 5, num_heads=nh, num_kv_heads=nkv,
+            head_dim=hd, top_k=2, blocks={"cache_wbytes": 1},
+            interpret=True)
+    # on a kernel-eligible backend the dispatcher refuses BEFORE its
+    # Pallas-failure fallback, so a stale plan can never silently demote
+    # decode to the jnp reference (the pure-reference CPU path ignores
+    # `blocks` — checked by the fused-path tests running f32 caches)
+    cos = jnp.zeros((1, hd), jnp.float32)
+    set_flags({"FLAGS_pallas_interpret": True})
+    try:
+        with pytest.raises(ValueError, match="cache"):
+            fd.fused_decode_step(
+                f(1, h), params, kv, 5, cos, cos, num_heads=nh,
+                num_kv_heads=nkv, arch="moe", top_k=2,
+                blocks={"cache_wbytes": 1})
+    finally:
+        set_flags({"FLAGS_pallas_interpret": False})
+
+
+def test_pick_expert_blocks_nbuf_accounting():
+    """The triple-buffered (prefetch-two-ahead) pipeline budgets 3 expert
+    block sets: under a tight budget nbuf=3 must pick blocks no larger
+    than nbuf=2 would, and both stay 128-lane multiples."""
+    h, ffn = 1024, 4096
+    j2, f2 = fd._pick_expert_blocks(ffn, h, fixed_bytes=0, wbytes=2,
+                                    budget=40 * 2 ** 20, nbuf=2)
+    j3, f3 = fd._pick_expert_blocks(ffn, h, fixed_bytes=0, wbytes=2,
+                                    budget=40 * 2 ** 20, nbuf=3)
+    assert f3 <= f2 and f3 % 128 == 0 and j3 * f3 == ffn
+    # roomy budget: whole-ffn blocks either way
+    j, fb = fd._pick_expert_blocks(512, 256, fixed_bytes=0, wbytes=2,
+                                   nbuf=3)
+    assert (j, fb) == (1, 512)
+
+
 def test_int8_cache_reference_cosine_parity():
     """Reference twin, int8 KV cache (prefill = calibration) vs bf16
     cache: same greedy token, cosine > 0.99 on the logits."""
@@ -285,6 +347,96 @@ class TestInterpretKernelParity:
         mm._generate_jit_cache = {}
         set_flags({"FLAGS_pallas_interpret": True})
         out_k = generate(mm, prompt, max_new_tokens=8, temperature=0.0)
+        assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
+
+    @staticmethod
+    def _moe_setup(b, ffn=512, E=8, k=2, L=3):
+        S, hd, h = 256, 64, 256
+        nkv, rep = 2, 2
+        nh = nkv * rep
+        r = np.random.RandomState(0)
+        f = lambda *s: jnp.asarray(r.randn(*s) * 0.05, jnp.bfloat16)
+        params = {"ln1": jnp.ones((L, h), jnp.bfloat16),
+                  "wqkv": f(L, h, (nh + 2 * nkv) * hd),
+                  "wo": f(L, nh * hd, h),
+                  "ln2": jnp.ones((L, h), jnp.bfloat16),
+                  "gate": f(L, E, h),
+                  "weg": f(L, E, h, ffn), "weu": f(L, E, h, ffn),
+                  "wed": f(L, E, ffn, h)}
+        return params, f(b, h), f(L, b, S, 2 * nkv * hd), nh, nkv, hd, S
+
+    @pytest.mark.parametrize("b", [1, 2])
+    def test_moe_int8_cache_kernel_parity(self, b):
+        """The MoE kernel's int8 KV-cache mode (k-scales folded into the
+        block-diagonal q, v-scales on the attention output, quantized RMW
+        append) vs the jnp reference — b=1 and b=2, CPU interpret."""
+        params, x, kv, nh, nkv, hd, S = self._moe_setup(b)
+        pos = 130
+        cos, sin = rope_cos_sin(S, hd)
+        kv8, scales = fd.quantize_kv_cache(kv, nkv)
+        xr, kvr = jax.jit(lambda *a: fd.fused_decode_reference(
+            *a, num_heads=nh, num_kv_heads=nkv, eps=1e-5, arch="moe",
+            top_k=2, kv_scales=scales))(
+            x, params, kv8, pos, cos[pos:pos + 1], sin[pos:pos + 1])
+        xp, kvp = jax.jit(lambda x, p, kv: fd._fused_decode_moe_pallas(
+            x, p, kv, pos, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+            top_k=2, eps=1e-5, kv_scales=scales,
+            blocks={"cache_wbytes": 1}, interpret=True))(x, params, kv8)
+        assert kvp.dtype == jnp.int8
+        np.testing.assert_allclose(np.asarray(xp, np.float32),
+                                   np.asarray(xr, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+        # the appended int8 rows must match the reference EXACTLY and no
+        # other cache row may be touched
+        d = np.abs(np.asarray(kvr, np.int32) - np.asarray(kvp, np.int32))
+        touched = sorted(set(np.argwhere(d > 0)[:, 2].tolist()))
+        assert touched == [], touched
+
+    def test_moe_prefetch_pipeline_many_slots(self):
+        """k=4 over E=16 at b=2 → 8 expert-FFN steps: every buffer of the
+        prefetch-two-ahead triple-buffered pipeline is reused at least
+        twice, so a wait/start ordering bug would corrupt a slot matmul."""
+        params, x, kv, nh, nkv, hd, S = self._moe_setup(
+            2, ffn=256, E=16, k=4, L=2)
+        pos = 77
+        cos, sin = rope_cos_sin(S, hd)
+        xr, _ = jax.jit(lambda *a: fd.fused_decode_reference(
+            *a, num_heads=nh, num_kv_heads=nkv, eps=1e-5, arch="moe",
+            top_k=4))(x, params, kv, pos, cos[pos:pos + 1],
+                      sin[pos:pos + 1])
+        xp, _ = jax.jit(lambda x, p, kv: fd._fused_decode_moe_pallas(
+            x, p, kv, pos, num_heads=nh, num_kv_heads=nkv, head_dim=hd,
+            top_k=4, eps=1e-5, interpret=True))(x, params, kv)
+        np.testing.assert_allclose(np.asarray(xp, np.float32),
+                                   np.asarray(xr, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_moe_generate_int8_cache_token_exact(self):
+        """generate(cache_dtype=int8) on Mixtral through the interpret-mode
+        kernel == the jnp-reference int8 run, token for token."""
+        from paddle_tpu.models.mixtral import (MixtralConfig,
+                                               MixtralForCausalLM)
+
+        paddle_tpu.seed(0)
+        cfg = MixtralConfig(vocab_size=256, hidden_size=128,
+                            intermediate_size=256, num_layers=2,
+                            num_heads=4, num_kv_heads=2,
+                            max_position_embeddings=512, num_experts=8,
+                            top_k=2)
+        mm = MixtralForCausalLM(cfg).bfloat16()
+        mm.eval()
+        # decisive routing: near-tie experts can flip on one bf16 ulp
+        for layer in mm.model.layers:
+            layer.moe.gate.proj.weight = layer.moe.gate.proj.weight * 8.0
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (2, 7)))
+        set_flags({"FLAGS_pallas_interpret": False})
+        out_ref = generate(mm, prompt, max_new_tokens=8, temperature=0.0,
+                           cache_dtype=jnp.int8)
+        mm._generate_jit_cache = {}
+        set_flags({"FLAGS_pallas_interpret": True})
+        out_k = generate(mm, prompt, max_new_tokens=8, temperature=0.0,
+                         cache_dtype=jnp.int8)
         assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
 
     def test_qsplit_int8_weights_kernel(self):

@@ -99,6 +99,48 @@ def test_roi_align_constant_and_linear():
     np.testing.assert_allclose(out[0, 0, 0], expect, rtol=1e-5)
 
 
+def test_roi_align_adaptive_default_grid():
+    """sampling_ratio<=0 with CONCRETE boxes reproduces the reference's
+    adaptive ceil(roi/pooled) grid per RoI; under jit it falls back to the
+    fixed 2 samples/bin with a one-time warning."""
+    import warnings
+    import jax
+
+    from paddle_tpu.vision import ops as vops
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 16, 16),
+                    jnp.float32)
+    boxes = jnp.asarray([[0., 0., 8., 8.],      # 8x8 roi / 4 -> 2x2 grid
+                         [1., 1., 15., 13.],    # 14x12 -> srx 4, sry 3
+                         [2., 2., 4., 4.]], jnp.float32)
+    bn = [2, 1]
+    out = V.roi_align(x, boxes, bn, 4)
+    # roi exactly 2x pooled: adaptive == explicit sampling_ratio=2
+    ref2 = V.roi_align(x, boxes, bn, 4, sampling_ratio=2)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref2[0]),
+                               rtol=1e-6)
+    # the big roi really uses the (sry=3, srx=4) grid
+    off = 0.5
+    man = vops._roi_align_grid(
+        x, jnp.asarray([0], jnp.int32), boxes[1:2, 0] - off,
+        boxes[1:2, 1] - off, boxes[1:2, 2] - boxes[1:2, 0],
+        boxes[1:2, 3] - boxes[1:2, 1], 4, 4, 3, 4)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(man[0]),
+                               rtol=1e-6)
+    assert not np.allclose(np.asarray(out[1]), np.asarray(ref2[1]))
+    # traced boxes: fixed-2 fallback + exactly one warning
+    vops._roi_adaptive_warned = False
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        f = jax.jit(lambda b: V.roi_align(x, b, bn, 4))
+        outj = f(boxes)
+        f(boxes * 1.0)
+    np.testing.assert_allclose(np.asarray(outj), np.asarray(ref2),
+                               rtol=1e-5, atol=1e-6)
+    msgs = [w for w in rec if "roi_align" in str(w.message)]
+    assert len(msgs) == 1
+
+
 def test_roi_pool_max_semantics():
     x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 3, 3].set(9.0)
     boxes = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
