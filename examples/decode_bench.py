@@ -137,6 +137,24 @@ def main():
                     "scales, decode streams int8 KV — the long-context "
                     "(s >= 2048) row where cache bytes dominate runs "
                     "--prompt_len 2048 --cache_int8")
+    ap.add_argument("--traced", action="store_true",
+                    help="attach an observability.Tracer for the final "
+                    "timed run: emits request spans (TTFT/TPOT/per-chunk "
+                    "decode) into the BENCH json and "
+                    "/tmp/decode_bench_spans.jsonl — the per-phase "
+                    "evidence the SCALE.md re-measure rows ask for")
+    ap.add_argument("--report_plan", default=None, metavar="PATH",
+                    help="write the analytic roofline plan here; feed it "
+                    "to `python examples/scale_report.py --report "
+                    "/tmp/decode_bench_prof --plan PATH` for the "
+                    "per-phase %%-of-roofline table")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="wall-timing repetitions (CI smoke uses 1)")
+    ap.add_argument("--device_time", action="store_true",
+                    help="force the xplane device-clock pass off-TPU "
+                    "(on TPU it always runs; the CPU backend yields no "
+                    "device plane and trace start/stop costs ~15 s on "
+                    "the bare container, so CPU smoke skips it)")
     ns = ap.parse_args()
 
     import paddle_tpu
@@ -219,7 +237,7 @@ def main():
     # the two decode lengths to cancel prefill + fixed costs
     # wall reps run UNTRACED (the r2 methodology, clean fallback); one
     # traced pair afterwards supplies the device-clock numbers
-    reps = 3
+    reps = max(ns.reps, 1)
     t_short, t_long = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -239,7 +257,15 @@ def main():
         return xplane.device_total_seconds(d, "jit_run")
 
     try:
-        d_short, d_long = device_time(n_short), device_time(ns.new_tokens)
+        # any accelerator gets the device-clock pass (the xplane parser
+        # reads GPU planes too); only the CPU backend — which yields no
+        # device plane and pays ~15 s of trace start/stop on the bare
+        # container — skips it unless forced
+        if dev.platform != "cpu" or ns.device_time:
+            d_short, d_long = (device_time(n_short),
+                               device_time(ns.new_tokens))
+        else:
+            d_short = d_long = None
     except Exception:
         d_short = d_long = None
     if d_short is not None and d_long is not None:
@@ -285,21 +311,79 @@ def main():
     bw = HBM_BW.get(dev.device_kind, 819e9 if on_tpu else 50e9)
     roofline_tok_s = ns.batch * bw / step_bytes
 
+    # ---- unified telemetry: BENCH schema + roofline plan + spans ----------
+    from paddle_tpu import observability as obs
+
+    # the analytic per-phase plan scale_report --report joins against an
+    # xplane capture (decode_bench's own trace lands in
+    # /tmp/decode_bench_prof); substring attribution is best-effort, so
+    # the catch-all phases keep the unmatched time visible
+    roofline_plan = {
+        "hbm_gbps": round(bw / 1e9, 1),
+        "steps": ns.new_tokens,
+        "phases": [
+            {"name": "decode_kernel",
+             "match": ["fused_decode", "pallas", "custom-call"],
+             "bytes_per_step": step_bytes},
+            {"name": "glue_matmul", "match": ["dot", "einsum", "convolution"],
+             "bytes_per_step": 0},
+            {"name": "sampling_glue",
+             "match": ["argmax", "reduce", "iota", "sort", "top-k", "top_k",
+                       "select", "compare"],
+             "bytes_per_step": 0},
+        ],
+    }
+    if ns.report_plan:
+        with open(ns.report_plan, "w") as f:
+            json.dump(roofline_plan, f)
+
+    spans = None
+    if ns.traced:
+        # traced run: generate() switches to prefill + chunked decode
+        # dispatches so TTFT/TPOT are host-measured; tokens unchanged.
+        # The first traced call compiles the prefill/chunk programs (the
+        # untraced warmups above cached only the single-dispatch
+        # program), so warm up once and measure the second request. The
+        # measured request runs INSIDE a jax.profiler capture into the
+        # --report dir, so the decode.request/prefill/chunk
+        # TraceAnnotations land in the same xplane the roofline join
+        # reads (skipped on bare CPU unless --device_time: trace
+        # start/stop costs ~15 s there and yields no device plane).
+        import contextlib
+        import shutil
+        with obs.trace(decode_chunk=32):
+            timed(ns.new_tokens)
+        if dev.platform != "cpu" or ns.device_time:
+            shutil.rmtree("/tmp/decode_bench_prof", ignore_errors=True)
+            capture = jax.profiler.trace("/tmp/decode_bench_prof")
+        else:
+            capture = contextlib.nullcontext()
+        with capture, obs.trace(decode_chunk=32) as tracer:
+            timed(ns.new_tokens)
+        spans = tracer.span_dicts()
+        obs.validate_spans(spans, require_request=True)
+        tracer.export_jsonl("/tmp/decode_bench_spans.jsonl")
+
     tag = (" int8" if ns.int8 else "") + (" kv8" if ns.cache_int8 else "")
-    print(json.dumps({
-        "metric": f"{name}{tag} decode tokens/s (batch={ns.batch})",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "tokens_per_sec_per_seq": round(per_seq, 1),
-        "roofline_tokens_per_sec": round(roofline_tok_s, 1),
-        "frac_of_roofline": round(tok_s / roofline_tok_s, 3),
-        "params": n_params,
-        "device": dev.device_kind,
-        "batch": ns.batch, "prompt_len": ns.prompt_len,
-        "new_tokens": ns.new_tokens,
-        "step_time_ms": round(1000 * dt / n_eff, 3),
-        "timing": timing,
-    }))
+    rec = obs.bench_record(
+        f"{name}{tag} decode tokens/s (batch={ns.batch})",
+        round(tok_s, 1), "tokens/s",
+        device=dev.device_kind,
+        tokens_per_sec_per_seq=round(per_seq, 1),
+        roofline_tokens_per_sec=round(roofline_tok_s, 1),
+        frac_of_roofline=round(tok_s / roofline_tok_s, 3),
+        params=n_params,
+        batch=ns.batch, prompt_len=ns.prompt_len,
+        new_tokens=ns.new_tokens,
+        step_time_ms=round(1000 * dt / n_eff, 3),
+        timing=timing,
+        roofline_plan=roofline_plan,
+        memory=obs.memory.memory_snapshot(),
+        **({"request_span": next(
+            s["attrs"] for s in spans if s["name"] == "decode.request")}
+           if spans else {}),
+    )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

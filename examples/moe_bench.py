@@ -158,23 +158,27 @@ def main():
     flops_tok = 6 * act_params + 12 * L * h * ns.seq
     mfu = tok_s * flops_tok / PEAK.get(dev.device_kind,
                                        197e12 if on_tpu else 1e12)
-    print(json.dumps({
-        "dispatch": ns.dispatch,
-        "metric": f"mixtral-{ns.layers}L-{ns.experts}e train tokens/s/chip",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "mfu_activated": round(mfu, 4),
-        "params_total": n_params,
-        "params_activated": act_params,
-        "device": dev.device_kind,
-        "batch": ns.batch, "seq": ns.seq, "steps": ns.steps,
-        "step_time_ms": round(1000 * (dt_dev or dt) / ns.steps, 2),
-        "wall_step_time_ms": round(1000 * dt / ns.steps, 2),
-        "timing": "device(xplane)" if dt_dev else "wall",
-        "final_loss": round(loss, 4),
+    from paddle_tpu import observability as obs
+
+    rec = obs.bench_record(
+        f"mixtral-{ns.layers}L-{ns.experts}e train tokens/s/chip",
+        round(tok_s, 1), "tokens/s",
+        device=dev.device_kind,
+        dispatch=ns.dispatch,
+        mfu=round(mfu, 4),
+        mfu_basis="activated",
+        params=n_params,
+        params_activated=act_params,
+        batch=ns.batch, seq=ns.seq, steps=ns.steps,
+        step_time_ms=round(1000 * (dt_dev or dt) / ns.steps, 2),
+        wall_step_time_ms=round(1000 * dt / ns.steps, 2),
+        timing="device(xplane)" if dt_dev else "wall",
+        final_loss=round(loss, 4),
+        memory=obs.memory.memory_snapshot(),
         **({"xplane_breakdown_ms_per_step": breakdown,
             "xplane_top_ops": top_ops} if ns.xplane_breakdown else {}),
-    }))
+    )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

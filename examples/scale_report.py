@@ -72,6 +72,8 @@ def report(name, cfg, mesh_dims, n_micro, seq, batch, zero_stage=2,
         # (verified: 7B AdamW multi-precision ⇒ 94.5 GB global state, XLA
         # reports 11.4 GiB args with 8 devices)
         ma = compiled.memory_analysis()
+        from paddle_tpu.observability import memory as obs_memory
+        obs_memory.record_executable_memory(ma, name=name)
         n_dev = 1
         for v in mesh_dims.values():
             n_dev *= max(v, 1)
@@ -255,8 +257,55 @@ def execute_titan_step(steps=6, seq=128, batch=1):
           if per_step_ms else "  (no xplane device time)")
 
 
+def report_roofline(log_dir, plan_path):
+    """--report: join an xplane capture against an analytic roofline plan
+    → the per-phase "% of roofline, named residual" table. `plan_path` is
+    either a raw plan json or a BENCH json line (schema-validated, plan
+    taken from its `roofline_plan` field — decode_bench embeds one and
+    also writes it standalone via --report_plan)."""
+    import json
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import profiler
+
+    with open(plan_path) as f:
+        text = f.read().strip()
+    if not text:
+        raise SystemExit(f"{plan_path} is empty")
+    try:
+        doc = json.loads(text)              # raw plan (any formatting)
+    except json.JSONDecodeError:
+        # JSONL: take the last line (a bench's stdout capture may hold
+        # several records)
+        doc = json.loads(text.splitlines()[-1])
+    if "phases" not in doc:                 # a BENCH record, not a raw plan
+        doc = obs.validate_bench(doc).get("roofline_plan")
+        if doc is None:
+            raise SystemExit(f"{plan_path} is a BENCH record without a "
+                             "roofline_plan field")
+    rep = profiler.roofline_report(log_dir, doc)
+    print(rep["table"])
+    return rep
+
+
 def main():
     from paddle_tpu.models.llama import LlamaConfig
+
+    if "--report" in sys.argv:
+        # examples/scale_report.py --report <xplane_log_dir> --plan <json>
+        usage = ("usage: scale_report.py --report <xplane_log_dir> --plan "
+                 "<plan-or-BENCH json> (decode_bench --report_plan writes "
+                 "a plan)")
+        try:
+            log_dir = sys.argv[sys.argv.index("--report") + 1]
+            plan = (sys.argv[sys.argv.index("--plan") + 1]
+                    if "--plan" in sys.argv else None)
+        except IndexError:
+            raise SystemExit(usage)
+        if plan is None or log_dir.startswith("--"):
+            raise SystemExit(usage)
+        report_roofline(log_dir, plan)
+        return
 
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which == "ernie-titan-step":

@@ -187,20 +187,23 @@ def main():
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12 if on_tpu else 1e12)
     mfu = tok_s * flops_per_token / peak
 
-    print(json.dumps({
-        "metric": f"{name} train tokens/sec/chip",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "mfu": round(mfu, 4),
-        "vs_baseline": round(mfu / 0.45, 4),
-        "params": n_params,
-        "device": dev.device_kind,
-        "batch": ns.batch, "seq": ns.seq, "steps": ns.steps,
-        "step_time_ms": round(1000 * (dt_dev or dt) / ns.steps, 2),
-        "wall_step_time_ms": round(1000 * dt / ns.steps, 2),
-        "timing": "device(xplane)" if dt_dev else "wall",
-        "final_loss": round(loss, 4),
-    }))
+    from paddle_tpu import observability as obs
+
+    rec = obs.bench_record(
+        f"{name} train tokens/sec/chip", round(tok_s, 1), "tokens/s",
+        device=dev.device_kind,
+        mfu=round(mfu, 4),
+        mfu_basis="dense_6n",
+        vs_baseline=round(mfu / 0.45, 4),
+        params=n_params,
+        batch=ns.batch, seq=ns.seq, steps=ns.steps,
+        step_time_ms=round(1000 * (dt_dev or dt) / ns.steps, 2),
+        wall_step_time_ms=round(1000 * dt / ns.steps, 2),
+        timing="device(xplane)" if dt_dev else "wall",
+        final_loss=round(loss, 4),
+        memory=obs.memory.memory_snapshot(),
+    )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

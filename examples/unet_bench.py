@@ -225,22 +225,26 @@ def main():
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12 if on_tpu else 1e12)
     mfu = flops / step_s / peak if flops else None
 
+    from paddle_tpu import observability as obs
+
     mode = "train" if ns.train else "denoise"
-    print(json.dumps({
-        "metric": f"sd15-unet {mode} steps/s (batch={ns.batch})",
-        "value": round(1.0 / step_s, 2),
-        "unit": "steps/s",
-        "images_per_sec": round(ns.batch / step_s, 2),
-        "step_time_ms": round(step_s * 1e3, 2),
-        "wall_step_time_ms": round(dt / ns.steps * 1e3, 2),
-        "timing": "device(xplane)" if dt_dev else "wall",
-        "mfu_xla_counted": round(mfu, 4) if mfu else None,
-        "params": int(n_params),
-        "device": dev.device_kind,
-        "batch": ns.batch, "res": res, "steps": ns.steps,
-        "attention_ms_of_step": (round(attn_ms, 2)
-                                 if attn_ms is not None else None),
-    }))
+    rec = obs.bench_record(
+        f"sd15-unet {mode} steps/s (batch={ns.batch})",
+        round(1.0 / step_s, 2), "steps/s",
+        device=dev.device_kind,
+        images_per_sec=round(ns.batch / step_s, 2),
+        step_time_ms=round(step_s * 1e3, 2),
+        wall_step_time_ms=round(dt / ns.steps * 1e3, 2),
+        timing="device(xplane)" if dt_dev else "wall",
+        mfu=round(mfu, 4) if mfu else None,
+        mfu_basis="xla_counted",
+        params=int(n_params),
+        batch=ns.batch, res=res, steps=ns.steps,
+        attention_ms_of_step=(round(attn_ms, 2)
+                              if attn_ms is not None else None),
+        memory=obs.memory.memory_snapshot(),
+    )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -100,6 +100,12 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             and hasattr(model, "fused_decode_plan") else None)
     if plan is not None and b > plan.get("max_batch", b):
         plan = None     # e.g. MoE no-drop bound b ≤ per-expert capacity
+    if plan is not None and not kv_int8 \
+            and jnp.dtype(cache_dtype).itemsize != 2:
+        # the fused kernel's cache layouts are 2-byte (bf16) or int8; an
+        # fp32 cache would trip the kernel's cache_wbytes contract check
+        # on a kernel-eligible config — ride the layered path instead
+        plan = None
     if kv_int8 and plan is None:
         raise ValueError(
             "cache_dtype=int8 requires the fused decode path (an eligible "
@@ -117,101 +123,164 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     # cache is not donated: the program returns only tokens, so there is no
     # output buffer to alias — XLA frees the cache after its last in-scan
     # use regardless.
+    #
+    # Telemetry (paddle_tpu.observability): with NO tracer attached the
+    # whole request stays the single-dispatch `run` program below — the
+    # only added cost is the `active_tracer()` read. With a tracer
+    # attached, the SAME prefill/decode impls are compiled as a prefill
+    # program + a chunked decode program, so TTFT and per-chunk TPOT are
+    # real host-observed measurements; tokens are identical (same step
+    # function, split scan).
+    from paddle_tpu import observability as obs
+
+    tracer = obs.active_tracer()
     jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
     jit_key = (b, prompt_len, max_new_tokens, float(temperature),
                int(top_k), float(top_p), eos, jnp.dtype(cache_dtype).name,
                model.training, plan is not None)
     run = jit_cache.get(jit_key)
-    if run is None and plan is not None:
-        from paddle_tpu.ops import rope as rope_ops
-        from paddle_tpu.ops.fused_decode import (fused_decode_step,
-                                                 quantize_kv_cache)
+    traced_fns = jit_cache.get(jit_key + ("traced",))
+    if (run is None if tracer is None else traced_fns is None):
+        if plan is not None:
+            from paddle_tpu.ops import rope as rope_ops
+            from paddle_tpu.ops.fused_decode import (fused_decode_step,
+                                                     quantize_kv_cache)
 
-        cos_tab, sin_tab = rope_ops.rope_cos_sin(
-            total, plan["head_dim"], base=plan["rope_base"])
+            cos_tab, sin_tab = rope_ops.rope_cos_sin(
+                total, plan["head_dim"], base=plan["rope_base"])
 
-        def run_impl(state, cache, ids, key):
-            # rebuild the plan from the traced state so the stacked weights
-            # flow from the `state` argument (not baked-in constants)
-            plan_t = model.fused_decode_plan(state)
-            # prefill on the layered path, then stack caches for the kernel
-            out, cache = functional_call(model, state, ids, cache=cache,
-                                         start_pos=0)
-            # fused kernel cache layout: combined flat (L, b, S, 2*nkv*hd)
-            kv = jnp.stack([jnp.concatenate(
-                [c["k"].reshape(b, total, -1), c["v"].reshape(b, total, -1)],
-                axis=-1) for c in cache])
-            if kv_int8:     # prefill was the calibration pass
-                kv, kv_scales = quantize_kv_cache(
-                    kv, plan_t["num_kv_heads"])
-            else:
-                kv_scales = None
-            key, k0 = jax.random.split(key)
-            tok = _sample_logits(out[:, -1, :], k0, temperature, top_k,
-                                 top_p)
-            finished = jnp.zeros((b,), bool)
+            def _prefill_impl(state, cache, ids, key):
+                # rebuild the plan from the traced state so the stacked
+                # weights flow from the `state` argument (not constants)
+                plan_t = model.fused_decode_plan(state)
+                # prefill on the layered path, then stack for the kernel
+                with jax.named_scope("decode.prefill"):
+                    out, cache = functional_call(model, state, ids,
+                                                 cache=cache, start_pos=0)
+                    # fused cache layout: combined flat (L, b, S, 2*nkv*hd)
+                    kv = jnp.stack([jnp.concatenate(
+                        [c["k"].reshape(b, total, -1),
+                         c["v"].reshape(b, total, -1)],
+                        axis=-1) for c in cache])
+                if kv_int8:     # prefill was the calibration pass
+                    with jax.named_scope("decode.cache_quantize"):
+                        kv, kv_scales = quantize_kv_cache(
+                            kv, plan_t["num_kv_heads"])
+                else:
+                    kv_scales = None
+                key, k0 = jax.random.split(key)
+                with jax.named_scope("decode.sample"):
+                    tok = _sample_logits(out[:, -1, :], k0, temperature,
+                                         top_k, top_p)
+                finished = jnp.zeros((b,), bool)
+                return (tok, kv, key, finished), kv_scales
 
-            def step(carry, i):
-                tok, kv, key, finished = carry
-                finished = finished | (tok == eos)
-                key, ki = jax.random.split(key)
-                pos = prompt_len + i - 1
-                x = plan_t["embed"](tok, pos)
-                cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
-                sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
+            def _decode_impl(state, carry, kv_scales, i0, nsteps):
+                plan_t = model.fused_decode_plan(state)
                 blocks = plan_t.get("blocks")
                 if kv_int8 and blocks is not None:
                     blocks = dict(blocks, cache_wbytes=1)
-                x, kv = fused_decode_step(
-                    x, plan_t["params"], kv, pos, cos, sin,
-                    num_heads=plan_t["num_heads"],
-                    num_kv_heads=plan_t["num_kv_heads"], eps=plan_t["eps"],
-                    rope_base=plan_t["rope_base"],
-                    arch=plan_t.get("arch", "llama"),
-                    top_k=plan_t.get("top_k", 2),
-                    blocks=blocks, kv_scales=kv_scales)
-                nxt = _sample_logits(plan_t["head"](x), ki, temperature,
-                                     top_k, top_p)
-                nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
-                return (nxt, kv, key, finished), nxt
 
-            (tok_last, kv, key, finished), toks = jax.lax.scan(
-                step, (tok, kv, key, finished),
-                jnp.arange(1, max_new_tokens))
-            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+                def step(carry, i):
+                    tok, kv, key, finished = carry
+                    finished = finished | (tok == eos)
+                    key, ki = jax.random.split(key)
+                    pos = prompt_len + i - 1
+                    x = plan_t["embed"](tok, pos)
+                    cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
+                    sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1, axis=0)
+                    x, kv = fused_decode_step(
+                        x, plan_t["params"], kv, pos, cos, sin,
+                        num_heads=plan_t["num_heads"],
+                        num_kv_heads=plan_t["num_kv_heads"],
+                        eps=plan_t["eps"], rope_base=plan_t["rope_base"],
+                        arch=plan_t.get("arch", "llama"),
+                        top_k=plan_t.get("top_k", 2),
+                        blocks=blocks, kv_scales=kv_scales)
+                    with jax.named_scope("decode.sample"):
+                        nxt = _sample_logits(plan_t["head"](x), ki,
+                                             temperature, top_k, top_p)
+                    nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
+                    return (nxt, kv, key, finished), nxt
 
-        run = jax.jit(run_impl)
-        jit_cache[jit_key] = run
-    if run is None:
-        def run_impl(state, cache, ids, key):
-            out, cache = functional_call(model, state, ids, cache=cache,
-                                         start_pos=0)
-            key, k0 = jax.random.split(key)
-            tok = _sample_logits(out[:, -1, :], k0, temperature, top_k,
-                                 top_p)
-            finished = jnp.zeros((b,), bool)
+                return lax.scan(step, carry, i0 + jnp.arange(nsteps))
+        else:
+            def _prefill_impl(state, cache, ids, key):
+                with jax.named_scope("decode.prefill"):
+                    out, cache = functional_call(model, state, ids,
+                                                 cache=cache, start_pos=0)
+                key, k0 = jax.random.split(key)
+                with jax.named_scope("decode.sample"):
+                    tok = _sample_logits(out[:, -1, :], k0, temperature,
+                                         top_k, top_p)
+                finished = jnp.zeros((b,), bool)
+                return (tok, cache, key, finished), None
 
-            def step(carry, i):
-                tok, cache, key, finished = carry
-                finished = finished | (tok == eos)
-                key, ki = jax.random.split(key)
-                out, cache = functional_call(model, state, tok[:, None],
-                                             cache=cache,
-                                             start_pos=prompt_len + i - 1)
-                nxt = _sample_logits(out[:, -1, :], ki, temperature, top_k,
-                                     top_p)
-                nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
-                return (nxt, cache, key, finished), nxt
+            def _decode_impl(state, carry, _aux, i0, nsteps):
+                def step(carry, i):
+                    tok, cache, key, finished = carry
+                    finished = finished | (tok == eos)
+                    key, ki = jax.random.split(key)
+                    out, cache = functional_call(
+                        model, state, tok[:, None], cache=cache,
+                        start_pos=prompt_len + i - 1)
+                    with jax.named_scope("decode.sample"):
+                        nxt = _sample_logits(out[:, -1, :], ki, temperature,
+                                             top_k, top_p)
+                    nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
+                    return (nxt, cache, key, finished), nxt
 
-            (tok_last, cache, key, finished), toks = jax.lax.scan(
-                step, (tok, cache, key, finished),
-                jnp.arange(1, max_new_tokens))
-            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+                return lax.scan(step, carry, i0 + jnp.arange(nsteps))
 
-        run = jax.jit(run_impl)
-        jit_cache[jit_key] = run
+        if tracer is None:
+            def run_impl(state, cache, ids, key):
+                carry, aux = _prefill_impl(state, cache, ids, key)
+                tok = carry[0]
+                carry, toks = _decode_impl(state, carry, aux, 1,
+                                           max_new_tokens - 1)
+                return jnp.concatenate([tok[:, None], toks.T], axis=1)
 
-    new_tokens = run(state, cache, input_ids, jax.random.PRNGKey(seed))
+            run = jax.jit(run_impl)
+            jit_cache[jit_key] = run
+        else:
+            # donate the cache/carry across the chunk dispatches so XLA
+            # aliases the KV buffer instead of copying it per chunk (a 7B
+            # cache copied every 32 tokens would skew the TPOT this mode
+            # measures and double peak HBM). CPU never implements
+            # donation — skip there to avoid per-program warnings.
+            don = jax.default_backend() != "cpu"
+            traced_fns = (
+                jax.jit(_prefill_impl,
+                        donate_argnums=(1,) if don else ()),
+                jax.jit(_decode_impl, static_argnums=(4,),
+                        donate_argnums=(1,) if don else ()))
+            jit_cache[jit_key + ("traced",)] = traced_fns
+
+    key0 = jax.random.PRNGKey(seed)
+    if tracer is None:
+        new_tokens = run(state, cache, input_ids, key0)
+    else:
+        # analytic cache accounting for the request span: total allocated
+        # KV bytes at the cache dtype, and the avg bytes a decode step
+        # streams (cache fill averaged over the decode window)
+        leaves = jax.tree_util.tree_leaves(cache)
+        itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
+        kv_cache_bytes = int(sum(l.size * itemsize for l in leaves))
+        avg_len = min(prompt_len + max_new_tokens / 2.0, total)
+        pf, dc = traced_fns
+        pieces = obs.run_traced_decode(
+            tracer,
+            lambda: pf(state, cache, input_ids, key0),
+            lambda carry, aux, i0, c: dc(state, carry, aux, i0, c),
+            batch=b, max_new_tokens=max_new_tokens,
+            attrs=dict(
+                arch=(plan.get("arch", "llama") if plan is not None
+                      else type(model).__name__),
+                fused=plan is not None, prompt_len=prompt_len,
+                kv_cache_dtype=jnp.dtype(cache_dtype).name,
+                kv_cache_bytes=kv_cache_bytes,
+                kv_bytes_per_step=int(kv_cache_bytes * avg_len / total)))
+        new_tokens = jnp.concatenate(pieces, axis=1)
     if eos_token_id is not None:
         # trim columns where every row is already past its eos
         arr = np.asarray(new_tokens)

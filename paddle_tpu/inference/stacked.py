@@ -253,6 +253,7 @@ class StackedLlamaDecoder:
         and the fused kernel streams int8 KV chunks — halving the
         per-step cache DMA, the long-context (s >= 2048) decode regime
         where cache bytes dominate the roofline."""
+        from paddle_tpu import observability as obs
         from paddle_tpu.inference import _sample_logits
 
         input_ids = jnp.asarray(input_ids)
@@ -260,34 +261,48 @@ class StackedLlamaDecoder:
         total = -(-(prompt_len + max_new_tokens) // 128) * 128
         cfg = self.cfg
         kv_int8 = jnp.dtype(cache_dtype) == jnp.int8
+        if not kv_int8 and jnp.dtype(cache_dtype).itemsize != 2:
+            raise ValueError(
+                "StackedLlamaDecoder decodes against a bf16 or int8 KV "
+                f"cache; got cache_dtype={jnp.dtype(cache_dtype).name}")
         key0 = jax.random.PRNGKey(seed)
+        tracer = obs.active_tracer()
         jk = (b, prompt_len, max_new_tokens, float(temperature), int(top_k),
               float(top_p), jnp.dtype(cache_dtype).name)
         run = self._jit_cache.get(jk)
-        if run is None:
+        traced_fns = self._jit_cache.get(jk + ("traced",))
+        if (run is None if tracer is None else traced_fns is None):
             cos_tab, sin_tab = rope_cos_sin(total, cfg.head_dim,
                                             base=cfg.rope_base)
             blocks = (dict(self.blocks, cache_wbytes=1) if kv_int8
                       else self.blocks)
 
-            def run_impl(params, embed_w, norm_w, head_arrays, ids, key):
-                x, kv = self.prefill(
-                    params, ids, total,
-                    jnp.bfloat16 if kv_int8 else cache_dtype,
-                    embed_w=embed_w)
+            def logits(x, embed_w, norm_w, head_arrays):
+                return self._head_logits(
+                    self._final_norm(x, norm_w), embed_w, head_arrays)
+
+            def _prefill_impl(params, embed_w, norm_w, head_arrays, ids,
+                              key):
+                with jax.named_scope("decode.prefill"):
+                    x, kv = self.prefill(
+                        params, ids, total,
+                        jnp.bfloat16 if kv_int8 else cache_dtype,
+                        embed_w=embed_w)
                 if kv_int8:
-                    kv, kv_scales = fd.quantize_kv_cache(kv, cfg.kv_heads)
+                    with jax.named_scope("decode.cache_quantize"):
+                        kv, kv_scales = fd.quantize_kv_cache(kv,
+                                                             cfg.kv_heads)
                 else:
                     kv_scales = None
                 key, k0 = jax.random.split(key)
+                with jax.named_scope("decode.sample"):
+                    tok = _sample_logits(
+                        logits(x, embed_w, norm_w, head_arrays), k0,
+                        temperature, top_k, top_p)
+                return (tok, kv, key), kv_scales
 
-                def logits(x):
-                    return self._head_logits(
-                        self._final_norm(x, norm_w), embed_w, head_arrays)
-
-                tok = _sample_logits(logits(x), k0, temperature, top_k,
-                                     top_p)
-
+            def _decode_impl(params, embed_w, norm_w, head_arrays, carry,
+                             kv_scales, i0, nsteps):
                 def step(carry, i):
                     tok, kv, key = carry
                     key, ki = jax.random.split(key)
@@ -300,18 +315,64 @@ class StackedLlamaDecoder:
                         num_heads=cfg.num_heads, num_kv_heads=cfg.kv_heads,
                         eps=cfg.rms_norm_eps, rope_base=cfg.rope_base,
                         blocks=blocks, kv_scales=kv_scales)
-                    nxt = _sample_logits(logits(x), ki, temperature, top_k,
-                                         top_p)
+                    with jax.named_scope("decode.sample"):
+                        nxt = _sample_logits(
+                            logits(x, embed_w, norm_w, head_arrays), ki,
+                            temperature, top_k, top_p)
                     return (nxt, kv, key), nxt
 
-                (tok_last, kv, key), toks = lax.scan(
-                    step, (tok, kv, key), jnp.arange(1, max_new_tokens))
-                return jnp.concatenate([tok[:, None], toks.T], axis=1)
+                return lax.scan(step, carry, i0 + jnp.arange(nsteps))
 
-            run = jax.jit(run_impl)
-            self._jit_cache[jk] = run
-        new = run(self.params, self.embed_w, self.norm_w,
-                  tuple(self.head[1:]), input_ids, key0)
+            if tracer is None:
+                def run_impl(params, embed_w, norm_w, head_arrays, ids,
+                             key):
+                    carry, kv_scales = _prefill_impl(
+                        params, embed_w, norm_w, head_arrays, ids, key)
+                    tok = carry[0]
+                    carry, toks = _decode_impl(
+                        params, embed_w, norm_w, head_arrays, carry,
+                        kv_scales, 1, max_new_tokens - 1)
+                    return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+                run = jax.jit(run_impl)
+                self._jit_cache[jk] = run
+            else:
+                # donate the KV carry across chunk dispatches (see
+                # inference.generate: avoids a full-cache copy per chunk
+                # on accelerators; CPU skips — donation unimplemented)
+                don = jax.default_backend() != "cpu"
+                traced_fns = (
+                    jax.jit(_prefill_impl),
+                    jax.jit(_decode_impl, static_argnums=(7,),
+                            donate_argnums=(4,) if don else ()))
+                self._jit_cache[jk + ("traced",)] = traced_fns
+
+        head_arrays = tuple(self.head[1:])
+        if tracer is None:
+            new = run(self.params, self.embed_w, self.norm_w, head_arrays,
+                      input_ids, key0)
+        else:
+            dkv = cfg.kv_heads * cfg.head_dim
+            itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
+            kv_cache_bytes = cfg.num_layers * b * total * 2 * dkv * itemsize
+            avg_len = min(prompt_len + max_new_tokens / 2.0, total)
+            pf, dc = traced_fns
+            pieces = obs.run_traced_decode(
+                tracer,
+                lambda: pf(self.params, self.embed_w, self.norm_w,
+                           head_arrays, input_ids, key0),
+                lambda carry, aux, i0, c: dc(
+                    self.params, self.embed_w, self.norm_w, head_arrays,
+                    carry, aux, i0, c),
+                batch=b, max_new_tokens=max_new_tokens,
+                attrs=dict(
+                    arch="llama-stacked", fused=True,
+                    prompt_len=prompt_len,
+                    kv_cache_dtype=jnp.dtype(cache_dtype).name,
+                    kv_cache_bytes=int(kv_cache_bytes),
+                    kv_bytes_per_step=int(kv_cache_bytes * avg_len
+                                          / total)))
+            new = jnp.concatenate(pieces, axis=1)
         return jnp.concatenate([input_ids, new], axis=1)
 
     def num_params(self):

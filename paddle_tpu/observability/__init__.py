@@ -1,0 +1,45 @@
+"""paddle_tpu.observability — unified telemetry across the stack.
+
+One subsystem, four pieces (docs/OBSERVABILITY.md has the full story):
+
+* **Metrics registry** (`registry.py`): process-wide counters / gauges /
+  fixed-bucket histograms, allocation-free on the hot path, exported as
+  JSONL or Prometheus text. Subsumes and backs `profiler.MetricsLogger`
+  / `profiler.StepTimer`.
+* **Request tracing** (`tracing.py`): attach a `Tracer` and
+  `inference.generate` / `StackedLlamaDecoder.generate` emit per-request
+  spans — prefill, per-chunk decode — with TTFT/TPOT/tokens-per-sec and
+  KV-cache bytes/dtype, nested in `jax.profiler.TraceAnnotation` so they
+  land in xplane captures. No tracer attached → the single-dispatch
+  decode path runs untouched (<1% overhead: one global read per call).
+* **Schemas** (`schema.py`): the shared `paddle_tpu.bench/v1` BENCH
+  record all benches emit + span validation.
+* **Memory telemetry** (`memory.py`): live-HBM / allocator stats /
+  compiled-executable accounting as registry gauges.
+
+Roofline attribution lives with the xplane parser:
+`paddle_tpu.profiler.roofline_report(log_dir, plan)`.
+"""
+
+from paddle_tpu.observability.registry import (   # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS,
+    registry, set_default_labels,
+)
+from paddle_tpu.observability.tracing import (    # noqa: F401
+    Span, Tracer, attach, detach, active_tracer, trace, run_traced_decode,
+)
+from paddle_tpu.observability.schema import (     # noqa: F401
+    BENCH_SCHEMA, bench_record, validate_bench, validate_spans,
+    validate_roofline_plan,
+)
+from paddle_tpu.observability import memory       # noqa: F401
+from paddle_tpu.observability import schema       # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "registry", "set_default_labels",
+    "Span", "Tracer", "attach", "detach", "active_tracer", "trace",
+    "run_traced_decode",
+    "BENCH_SCHEMA", "bench_record", "validate_bench", "validate_spans",
+    "validate_roofline_plan", "memory", "schema",
+]

@@ -1,0 +1,86 @@
+"""Memory telemetry: live HBM, device allocator stats, executable memory.
+
+Three sources, all surfaced as gauges in the metrics registry:
+
+* ``live_array_bytes()`` — sum over ``jax.live_arrays()`` (host view of
+  every live jax.Array buffer; works on every backend).
+* ``device_memory_stats()`` — the device allocator's own counters
+  (``bytes_in_use`` / ``peak_bytes_in_use``) where the backend exposes
+  them (TPU does; CPU typically returns nothing).
+* ``record_executable_memory(ma)`` — XLA's compiled-module accounting
+  (``compiled.memory_analysis()``: argument/temp/output bytes), the
+  number scale_report's feasibility tables are built on.
+"""
+
+from typing import Dict, Optional
+
+from paddle_tpu.observability.registry import registry as default_registry
+
+__all__ = ["live_array_bytes", "device_memory_stats", "record_memory",
+           "record_executable_memory", "memory_snapshot"]
+
+
+def live_array_bytes() -> int:
+    """Total bytes of live jax.Arrays (0 if the runtime can't enumerate)."""
+    import jax
+
+    try:
+        return sum(int(a.nbytes) for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """The backend allocator's stats for `device` (default: device 0);
+    {} when the backend doesn't expose them (e.g. CPU)."""
+    import jax
+
+    try:
+        dev = device or jax.devices()[0]
+        stats = dev.memory_stats()
+        return dict(stats) if stats else {}
+    except Exception:
+        return {}
+
+
+def memory_snapshot(device=None) -> Dict[str, int]:
+    """One dict joining both host-side and allocator views."""
+    snap = {"live_array_bytes": live_array_bytes()}
+    stats = device_memory_stats(device)
+    for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if k in stats:
+            snap[k] = int(stats[k])
+    return snap
+
+
+def record_memory(registry=None, device=None, **labels) -> Dict[str, int]:
+    """Gauge the current memory snapshot into `registry` (default: the
+    process-wide one) as ``memory.<key>``; returns the snapshot."""
+    reg = registry or default_registry()
+    snap = memory_snapshot(device)
+    for k, v in snap.items():
+        reg.gauge(f"memory.{k}", **labels).set(v)
+    return snap
+
+
+def record_executable_memory(ma, registry=None, name: str = "",
+                             **labels) -> Optional[Dict[str, int]]:
+    """Gauge a compiled executable's memory_analysis() into the registry
+    as ``executable.{argument,temp,output}_bytes`` (labelled name=...).
+    `ma` is `compiled.memory_analysis()` (or the compiled object itself,
+    in which case memory_analysis() is called here)."""
+    reg = registry or default_registry()
+    if hasattr(ma, "memory_analysis"):
+        try:
+            ma = ma.memory_analysis()
+        except Exception:
+            return None
+    out = {}
+    for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("output_size_in_bytes", "output_bytes")):
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[key] = int(v)
+            reg.gauge(f"executable.{key}", name=name, **labels).set(int(v))
+    return out or None

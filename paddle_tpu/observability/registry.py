@@ -1,0 +1,274 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The reference stack treats MFU/tokens-per-sec as first-class outputs
+(SURVEY.md §5, BASELINE north star) but the seed left every producer to
+invent its own ad-hoc JSON. This registry is the one sink: near-zero
+overhead on the hot path (a counter inc is one int add; a histogram
+observe is one bisect + int add — no allocation, no I/O), exporters pay
+their cost only when called.
+
+Label model: every metric is keyed by (name, sorted label items). The
+registry carries *default labels* (e.g. ``rank`` — set by ``fleet.init``
+under ``parallel/launch.py``) merged under per-call labels, so the same
+call site emits distinguishable series per rank without threading rank
+through every caller.
+"""
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "registry", "set_default_labels", "DEFAULT_BUCKETS",
+]
+
+# Latency-shaped default buckets (seconds): decode steps sit in the
+# 100 µs – 100 ms band on TPU, whole requests in the 10 ms – 10 s band.
+DEFAULT_BUCKETS = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+                   5e-2, 1e-1, 2.5e-1, 5e-1, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is allocation-free (one lock + add —
+    concurrent requests against an attached tracer share these
+    objects, and ``+=`` alone can lose updates between bytecodes)."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Tuple = ()):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": dict(self.labels), "value": self.value}
+
+
+class Gauge:
+    """Last-value gauge."""
+
+    __slots__ = ("name", "labels", "value")
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Tuple = ()):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = v
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": dict(self.labels), "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus-style cumulative export).
+
+    ``observe`` is allocation-free: the bucket counts list is
+    preallocated at construction; one bisect + two int adds + one float
+    add per observation.
+    """
+
+    __slots__ = ("name", "labels", "bounds", "counts", "sum", "count",
+                 "_lock")
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Tuple = (),
+                 buckets: Optional[Tuple] = None):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.counts[bisect.bisect_left(self.bounds, v)] += 1
+            self.sum += v
+            self.count += 1
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": dict(self.labels),
+                "buckets": {("%g" % b): c
+                            for b, c in zip(self.bounds, self.counts)},
+                "inf": self.counts[-1], "sum": self.sum, "count": self.count}
+
+
+def _label_key(labels: Dict) -> Tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def append_jsonl_lines(path: str, lines) -> int:
+    """Append pre-serialized JSON lines with ONE O_APPEND write — POSIX
+    appends are atomic per write, so concurrent per-rank writers sharing
+    a path can't interleave partial lines. The one shared implementation
+    behind MetricsRegistry/Tracer/MetricsLogger JSONL sinks."""
+    lines = list(lines)
+    if not lines:
+        return 0
+    buf = memoryview(("\n".join(lines) + "\n").encode())
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        # loop on short writes: a truncated write would leave exactly the
+        # torn partial line this helper exists to prevent
+        while buf:
+            buf = buf[os.write(fd, buf):]
+    finally:
+        os.close(fd)
+    return len(lines)
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics, keyed by (name, labels)."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+        self._default_labels: Dict[str, str] = {}
+
+    # -- creation ----------------------------------------------------------
+
+    def set_default_labels(self, **labels):
+        """Merge `labels` into the labels every metric created AFTER this
+        call carries (per-rank tagging: fleet.init sets rank=...)."""
+        self._default_labels.update({k: str(v) for k, v in labels.items()})
+
+    @property
+    def default_labels(self) -> Dict[str, str]:
+        return dict(self._default_labels)
+
+    def _get(self, cls, name, labels, **kw):
+        merged = dict(self._default_labels)
+        merged.update(labels)
+        key = (name, cls.kind, _label_key(merged))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, _label_key(merged), **kw)
+                    self._metrics[key] = m
+        if kw.get("buckets") is not None \
+                and m.bounds != tuple(sorted(kw["buckets"])):
+            # get-or-create must not silently hand back a histogram with
+            # a DIFFERENT bucket layout than the caller asked for
+            raise ValueError(
+                f"histogram {name!r}{dict(merged)} already exists with "
+                f"buckets {m.bounds}; requested {tuple(sorted(kw['buckets']))}")
+        return m
+
+    # positional-only metric names: labels may legitimately be called
+    # "name" (e.g. executable.*_bytes{name=...})
+    def counter(self, name: str, /, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, /, buckets: Optional[Tuple] = None,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        return [m.snapshot() for m in list(self._metrics.values())]
+
+    def export_jsonl(self, path: str, extra: Optional[Dict] = None) -> int:
+        """Append one JSON line per metric (single O_APPEND write per line
+        — safe under concurrent per-rank writers). Returns lines written."""
+        ts = time.time()
+        lines = []
+        for snap in self.snapshot():
+            snap["ts"] = ts
+            if extra:
+                snap.update(extra)
+            lines.append(json.dumps(snap))
+        return append_jsonl_lines(path, lines)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of the current state."""
+        out = []
+        seen_types = set()
+        for snap in self.snapshot():
+            name = _prom_name(snap["name"])
+            if name not in seen_types:
+                out.append(f"# TYPE {name} {snap['type']}")
+                seen_types.add(name)
+            labels = snap["labels"]
+            if snap["type"] == "histogram":
+                cum = 0
+                for bound, cnt in snap["buckets"].items():
+                    cum += cnt
+                    out.append(f"{name}_bucket"
+                               f"{_prom_labels(labels, le=bound)} {cum}")
+                cum += snap["inf"]
+                out.append(f"{name}_bucket"
+                           f"{_prom_labels(labels, le='+Inf')} {cum}")
+                out.append(f"{name}_sum{_prom_labels(labels)} {snap['sum']}")
+                out.append(f"{name}_count{_prom_labels(labels)} "
+                           f"{snap['count']}")
+            else:
+                out.append(f"{name}{_prom_labels(labels)} {snap['value']}")
+        return "\n".join(out) + ("\n" if out else "")
+
+    def reset(self):
+        """Drop all metrics and default labels (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+            self._default_labels.clear()
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_value(v: str) -> str:
+    """Escape per the Prometheus exposition format: backslash, double
+    quote and newline inside label values."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _prom_labels(labels: Dict, **extra) -> str:
+    items = dict(labels)
+    items.update({k: str(v) for k, v in extra.items()})
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(k)}="{_prom_value(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+_default_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _default_registry
+
+
+def set_default_labels(**labels):
+    """Tag every metric subsequently created in the default registry
+    (e.g. ``set_default_labels(rank=3)`` from fleet.init)."""
+    _default_registry.set_default_labels(**labels)

@@ -1,0 +1,186 @@
+"""Unified BENCH / span schemas + validation.
+
+Every bench (bench.py, examples/{decode,moe,train,unet}_bench.py) emits
+ONE JSON line in the shared ``paddle_tpu.bench/v1`` shape, validated by
+``validate_bench`` — the same helper ``examples/scale_report.py
+--report`` uses before trusting a bench record's embedded roofline
+plan. Spans from ``observability.Tracer`` follow the span schema below,
+validated by ``validate_spans``.
+"""
+
+import numbers
+from typing import Dict, List
+
+__all__ = ["BENCH_SCHEMA", "bench_record", "validate_bench",
+           "validate_spans", "validate_roofline_plan"]
+
+BENCH_SCHEMA = "paddle_tpu.bench/v1"
+
+# required field -> accepted types
+_BENCH_REQUIRED = {
+    "schema": str,
+    "metric": str,
+    "value": numbers.Real,
+    "unit": str,
+    "device": str,
+}
+# optional well-known fields -> accepted types (None always allowed)
+_BENCH_OPTIONAL = {
+    "timing": str,           # "device(xplane)" | "wall" | ...
+    "batch": numbers.Integral,
+    "seq": numbers.Integral,
+    "steps": numbers.Integral,
+    "prompt_len": numbers.Integral,
+    "new_tokens": numbers.Integral,
+    "params": numbers.Integral,
+    "step_time_ms": numbers.Real,
+    "wall_step_time_ms": numbers.Real,
+    "mfu": numbers.Real,
+    # what the mfu denominator/flop count means — the shared key would
+    # otherwise conflate activated-params MoE MFU, XLA-counted-flops MFU
+    # and the dense 6N estimate: "dense_6n" | "activated" | "xla_counted"
+    "mfu_basis": str,
+    "final_loss": numbers.Real,
+    "roofline_plan": dict,
+    "memory": dict,
+}
+
+
+def validate_bench(rec: Dict) -> Dict:
+    """Validate a BENCH record; raises ValueError listing EVERY problem
+    (not just the first). Returns the record unchanged on success."""
+    problems = []
+    if not isinstance(rec, dict):
+        raise ValueError(f"bench record must be a dict, got {type(rec)}")
+    for field, typ in _BENCH_REQUIRED.items():
+        if field not in rec:
+            problems.append(f"missing required field {field!r}")
+        elif not isinstance(rec[field], typ) or isinstance(rec[field], bool):
+            problems.append(
+                f"field {field!r} must be {getattr(typ, '__name__', typ)}, "
+                f"got {type(rec[field]).__name__}")
+    if rec.get("schema") not in (None, BENCH_SCHEMA):
+        problems.append(
+            f"schema must be {BENCH_SCHEMA!r}, got {rec.get('schema')!r}")
+    for field, typ in _BENCH_OPTIONAL.items():
+        v = rec.get(field)
+        if v is not None and field in rec and not isinstance(v, typ):
+            problems.append(
+                f"field {field!r} must be {getattr(typ, '__name__', typ)} "
+                f"or null, got {type(v).__name__}")
+    if "roofline_plan" in rec and isinstance(rec["roofline_plan"], dict):
+        try:
+            validate_roofline_plan(rec["roofline_plan"])
+        except ValueError as e:
+            problems.append(f"roofline_plan: {e}")
+    if problems:
+        raise ValueError("invalid BENCH record: " + "; ".join(problems))
+    return rec
+
+
+def bench_record(metric: str, value, unit: str, *, device: str,
+                 **extra) -> Dict:
+    """Build + validate a BENCH record and mirror its headline value into
+    the default registry (gauge ``bench.value{metric=...}``, counter
+    ``bench.records``) so the exporters see bench outputs too."""
+    rec = {"schema": BENCH_SCHEMA, "metric": metric, "value": value,
+           "unit": unit, "device": device}
+    rec.update(extra)
+    validate_bench(rec)
+    try:
+        from paddle_tpu.observability.registry import registry as _reg
+        r = _reg()
+        r.gauge("bench.value", metric=metric, unit=unit).set(value)
+        r.counter("bench.records").inc()
+    except Exception:
+        pass
+    return rec
+
+
+# ---- roofline plan ---------------------------------------------------------
+
+def validate_roofline_plan(plan: Dict) -> Dict:
+    """A roofline plan joins measured xplane buckets against analytic
+    floors (see profiler.xplane.roofline_report):
+
+      {"hbm_gbps": 819.0, "peak_tflops": 197.0, "steps": 256,
+       "phases": [{"name": "decode", "match": ["fused_decode", ...],
+                   "bytes_per_step": 1.2e9, "flops_per_step": 0.0}]}
+    """
+    problems = []
+    hbm = plan.get("hbm_gbps")
+    if not isinstance(hbm, numbers.Real) or isinstance(hbm, bool) \
+            or hbm <= 0:
+        problems.append("hbm_gbps (GB/s, positive number) is required")
+    if not isinstance(plan.get("steps", 1), numbers.Real):
+        problems.append("steps must be a number")
+    phases = plan.get("phases")
+    if not isinstance(phases, (list, tuple)) or not phases:
+        problems.append("phases must be a non-empty list")
+    else:
+        for i, p in enumerate(phases):
+            if not isinstance(p, dict) or not isinstance(p.get("name"), str):
+                problems.append(f"phases[{i}].name (str) is required")
+                continue
+            m = p.get("match")
+            if not isinstance(m, (list, tuple)) or not all(
+                    isinstance(s, str) for s in m):
+                problems.append(f"phases[{i}].match must be a list of "
+                                "substrings")
+            if not isinstance(p.get("bytes_per_step", 0), numbers.Real):
+                problems.append(f"phases[{i}].bytes_per_step must be a "
+                                "number")
+            if not isinstance(p.get("flops_per_step", 0), numbers.Real):
+                problems.append(f"phases[{i}].flops_per_step must be a "
+                                "number")
+    if problems:
+        raise ValueError("; ".join(problems))
+    return plan
+
+
+# ---- spans -----------------------------------------------------------------
+
+_SPAN_REQUIRED = {"name": str, "ts": numbers.Real, "dur_s": numbers.Real}
+# attrs the decode.request span must carry (the acceptance contract)
+REQUEST_SPAN_ATTRS = ("ttft_s", "tokens_per_sec", "kv_cache_dtype",
+                      "kv_cache_bytes")
+
+
+def validate_spans(spans: List[Dict], require_request: bool = False) -> List:
+    """Validate a list of span dicts (``Tracer.span_dicts()`` output).
+    With require_request=True additionally asserts a ``decode.request``
+    span carrying the TTFT/TPOT/tokens-per-sec + cache attrs."""
+    problems = []
+    names = set()
+    for i, s in enumerate(spans):
+        if not isinstance(s, dict):
+            problems.append(f"spans[{i}] is not a dict")
+            continue
+        for field, typ in _SPAN_REQUIRED.items():
+            if not isinstance(s.get(field), typ):
+                problems.append(f"spans[{i}].{field} must be "
+                                f"{typ.__name__}")
+        if s.get("dur_s", 0) < 0:
+            problems.append(f"spans[{i}].dur_s is negative")
+        p = s.get("parent")
+        if p is not None and not isinstance(p, str):
+            problems.append(f"spans[{i}].parent must be str or null")
+        if not isinstance(s.get("attrs", {}), dict):
+            problems.append(f"spans[{i}].attrs must be a dict")
+        names.add(s.get("name"))
+    if require_request:
+        reqs = [s for s in spans if isinstance(s, dict)
+                and s.get("name") == "decode.request"]
+        if not reqs:
+            problems.append("no decode.request span present")
+        for s in reqs:
+            attrs = s.get("attrs", {})
+            for a in REQUEST_SPAN_ATTRS:
+                if a not in attrs:
+                    problems.append(f"decode.request missing attr {a!r}")
+            if s.get("attrs", {}).get("max_new_tokens", 2) > 1 \
+                    and attrs.get("tpot_s") is None:
+                problems.append("decode.request missing attr 'tpot_s'")
+    if problems:
+        raise ValueError("invalid spans: " + "; ".join(problems))
+    return spans

@@ -1,0 +1,193 @@
+"""Request-level span tracing for the decode path (and anything else).
+
+A `Tracer` collects `Span` records (name, start, duration, parent,
+attrs). `Tracer.span(...)` nests a `jax.profiler.TraceAnnotation` so
+host-side spans land in xplane captures alongside the device planes —
+the RecordEvent analog (SURVEY.md §5), but attached to a *request*, not
+a training step.
+
+Zero-overhead contract: nothing in this module runs on the hot path
+unless a tracer is attached (`active_tracer()` is one global read).
+`inference.generate` keeps its single-dispatch program when no tracer
+is attached; with a tracer it switches to a prefill program + chunked
+decode programs so TTFT and per-chunk TPOT are real measurements, not
+estimates (the chunked scan applies the identical step function, so
+tokens are unchanged — pinned by tests/test_observability.py).
+"""
+
+import contextlib
+import json
+import threading
+import time
+from typing import Callable, List, Optional
+
+from paddle_tpu.observability.registry import (MetricsRegistry,
+                                               append_jsonl_lines,
+                                               registry as default_registry)
+
+__all__ = ["Span", "Tracer", "attach", "detach", "active_tracer", "trace",
+           "run_traced_decode"]
+
+
+class Span:
+    __slots__ = ("name", "ts", "dur_s", "parent", "attrs")
+
+    def __init__(self, name, ts, parent=None, attrs=None):
+        self.name = name
+        self.ts = ts
+        self.dur_s = 0.0
+        self.parent = parent
+        self.attrs = attrs if attrs is not None else {}
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ts": self.ts, "dur_s": self.dur_s,
+                "parent": self.parent, "attrs": self.attrs}
+
+
+class Tracer:
+    """Collects spans; mirrors request metrics into a registry.
+
+    decode_chunk: tokens per decode dispatch in traced generate() —
+    each chunk is one span (and one device dispatch), so smaller chunks
+    trade dispatch overhead for span resolution.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 decode_chunk: int = 32, max_spans: int = 100_000):
+        self.registry = registry or default_registry()
+        self.decode_chunk = int(decode_chunk)
+        self.max_spans = max_spans
+        self.spans: List[Span] = []
+        # per-THREAD open-span stack: concurrent requests against one
+        # attached tracer must not cross-parent each other's spans; the
+        # completed-spans list is shared, appended under a lock
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        import jax
+
+        stack = self._stack()
+        s = Span(name, time.time(),
+                 parent=stack[-1].name if stack else None,
+                 attrs=attrs)
+        stack.append(s)
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield s
+        finally:
+            s.dur_s = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                if len(self.spans) < self.max_spans:
+                    self.spans.append(s)
+
+    def span_dicts(self) -> List[dict]:
+        return [s.to_dict() for s in self.spans]
+
+    def export_jsonl(self, path: str) -> int:
+        """Append one JSON line per span (single O_APPEND write)."""
+        return append_jsonl_lines(
+            path, (json.dumps(d) for d in self.span_dicts()))
+
+
+_active: Optional[Tracer] = None
+
+
+def attach(tracer: Tracer) -> Tracer:
+    """Make `tracer` the process-wide active tracer."""
+    global _active
+    _active = tracer
+    return tracer
+
+
+def detach() -> Optional[Tracer]:
+    global _active
+    t, _active = _active, None
+    return t
+
+
+def active_tracer() -> Optional[Tracer]:
+    return _active
+
+
+@contextlib.contextmanager
+def trace(**tracer_kwargs):
+    """`with observability.trace() as t:` — attach a fresh Tracer for the
+    block; spans/metrics collected on `t`. Reentrant: a nested trace()
+    restores the ENCLOSING tracer on exit (it does not end it)."""
+    global _active
+    prev = _active
+    t = Tracer(**tracer_kwargs)
+    attach(t)
+    try:
+        yield t
+    finally:
+        if _active is t:
+            _active = prev
+
+
+# ---- the traced decode driver ---------------------------------------------
+
+def run_traced_decode(tracer: Tracer, prefill_call: Callable,
+                      decode_call: Callable, *, batch: int,
+                      max_new_tokens: int, attrs: dict):
+    """Drive a split decode under spans; returns the list of token pieces
+    (each (b, n)) to concatenate along axis 1.
+
+    prefill_call() -> (carry, aux); carry[0] is the first sampled token
+    (b,). decode_call(carry, aux, i0, nsteps) -> (carry, toks) with toks
+    (nsteps, b). Records TTFT (request start → first token *on the
+    host*), TPOT (decode span / (new-1)), tokens/s into the tracer's
+    registry and onto the request span's attrs.
+
+    Sync discipline: each phase is fenced by PULLING token values to the
+    host (np.asarray of the tiny token arrays), not block_until_ready —
+    through the remote-TPU tunnel block_until_ready returns early (the
+    decode_bench methodology), and a dependent host transfer is the only
+    fence that holds everywhere.
+    """
+    import numpy as np
+
+    reg = tracer.registry
+    t0 = time.perf_counter()
+    with tracer.span("decode.request", batch=batch,
+                     max_new_tokens=max_new_tokens, **attrs) as req:
+        with tracer.span("decode.prefill",
+                         tokens=attrs.get("prompt_len")):
+            carry, aux = prefill_call()
+            np.asarray(carry[0])          # host pull == completion fence
+        ttft = time.perf_counter() - t0
+        pieces = [carry[0][:, None]]
+        i, chunk = 1, max(tracer.decode_chunk, 1)
+        while i < max_new_tokens:
+            c = min(chunk, max_new_tokens - i)
+            with tracer.span("decode.chunk", start=i, tokens=c) as cs:
+                carry, toks = decode_call(carry, aux, i, c)
+                np.asarray(toks[-1])      # host pull == completion fence
+            cs.attrs["tokens_per_sec"] = round(batch * c / cs.dur_s, 1) \
+                if cs.dur_s else None
+            pieces.append(toks.T)
+            i += c
+        dur = time.perf_counter() - t0
+        tok_s = batch * max_new_tokens / dur if dur else 0.0
+        tpot = ((dur - ttft) / (max_new_tokens - 1)
+                if max_new_tokens > 1 else None)
+        req.attrs.update(ttft_s=round(ttft, 6),
+                         tpot_s=round(tpot, 6) if tpot is not None else None,
+                         tokens_per_sec=round(tok_s, 1))
+        reg.histogram("decode.ttft_seconds").observe(ttft)
+        if tpot is not None:
+            reg.histogram("decode.tpot_seconds").observe(tpot)
+        reg.counter("decode.requests").inc()
+        reg.counter("decode.tokens").inc(batch * max_new_tokens)
+        reg.gauge("decode.tokens_per_sec").set(round(tok_s, 1))
+    return pieces

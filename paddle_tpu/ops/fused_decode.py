@@ -361,15 +361,16 @@ def quantize_kv_cache(kv, num_kv_heads: int):
     jnp reference can apply them with a single broadcast multiply (k-half
     scales fold into the q rows, v-half scales apply to the attention
     output)."""
-    L, b, S, dkv2 = kv.shape
-    hd = dkv2 // (2 * num_kv_heads)
-    amax = jnp.abs(kv.astype(jnp.float32)).max(axis=(1, 2))     # (L, 2dkv)
-    amax = amax.reshape(L, 2 * num_kv_heads, hd).max(axis=-1)   # (L, 2nkv)
-    scales = jnp.maximum(amax / 127.0, 1e-8)
-    lanes = jnp.repeat(scales, hd, axis=-1)[:, None, :]         # (L,1,2dkv)
-    q = jnp.clip(jnp.round(kv.astype(jnp.float32) / lanes[:, None]),
-                 -127, 127)
-    return q.astype(jnp.int8), lanes
+    with jax.named_scope("fused_decode.quantize_kv_cache"):
+        L, b, S, dkv2 = kv.shape
+        hd = dkv2 // (2 * num_kv_heads)
+        amax = jnp.abs(kv.astype(jnp.float32)).max(axis=(1, 2))   # (L, 2dkv)
+        amax = amax.reshape(L, 2 * num_kv_heads, hd).max(axis=-1)  # (L, 2nkv)
+        scales = jnp.maximum(amax / 127.0, 1e-8)
+        lanes = jnp.repeat(scales, hd, axis=-1)[:, None, :]       # (L,1,2dkv)
+        q = jnp.clip(jnp.round(kv.astype(jnp.float32) / lanes[:, None]),
+                     -127, 127)
+        return q.astype(jnp.int8), lanes
 
 
 def _layernorm(x, w, b, eps):
@@ -1566,19 +1567,23 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                 f"rebuild the plan with decode_block_plan(cache_wbytes="
                 f"{cb})")
         try:
+            # named scopes mark the kernel phase boundary in xplane
+            # captures (trace-time only — no runtime cost)
             if arch == "moe":
-                return _fused_decode_moe_pallas(
+                with jax.named_scope("fused_decode.kernel_moe"):
+                    return _fused_decode_moe_pallas(
+                        x, params, kv_cache, pos,
+                        num_heads=num_heads, num_kv_heads=num_kv_heads,
+                        head_dim=dkv // num_kv_heads, top_k=top_k,
+                        rope_base=rope_base, eps=eps, blocks=blocks,
+                        kv_scales=kv_scales, interpret=interp)
+            with jax.named_scope("fused_decode.kernel"):
+                return _fused_decode_pallas(
                     x, params, kv_cache, pos,
                     num_heads=num_heads, num_kv_heads=num_kv_heads,
-                    head_dim=dkv // num_kv_heads, top_k=top_k,
-                    rope_base=rope_base, eps=eps, blocks=blocks,
+                    head_dim=dkv // num_kv_heads,
+                    rope_base=rope_base, eps=eps, arch=arch, blocks=blocks,
                     kv_scales=kv_scales, interpret=interp)
-            return _fused_decode_pallas(
-                x, params, kv_cache, pos,
-                num_heads=num_heads, num_kv_heads=num_kv_heads,
-                head_dim=dkv // num_kv_heads,
-                rope_base=rope_base, eps=eps, arch=arch, blocks=blocks,
-                kv_scales=kv_scales, interpret=interp)
         except Exception as e:  # pragma: no cover - hardware-dependent
             if flag("FLAGS_pallas_strict"):
                 raise
@@ -1590,7 +1595,8 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                     "Pallas fused decode failed (%s: %s); using the jnp "
                     "reference path. FLAGS_pallas_strict=1 to raise.",
                     type(e).__name__, e)
-    return fused_decode_reference(
-        x, params, kv_cache, pos, cos, sin,
-        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps, arch=arch,
-        top_k=top_k, kv_scales=kv_scales)
+    with jax.named_scope("fused_decode.reference"):
+        return fused_decode_reference(
+            x, params, kv_cache, pos, cos, sin,
+            num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
+            arch=arch, top_k=top_k, kv_scales=kv_scales)

@@ -43,6 +43,13 @@ class Fleet:
                                            devices=devices)
         set_hybrid_communicate_group(self._hcg)
         self._is_initialized = True
+        # per-rank metric tagging: every metric created after fleet.init
+        # carries this host's rank label, so per-rank writers under
+        # parallel/launch.py emit distinguishable series into shared
+        # JSONL/Prometheus sinks
+        import os
+        from paddle_tpu.observability.registry import set_default_labels
+        set_default_labels(rank=os.environ.get("PADDLE_TRAINER_ID", "0"))
         return self
 
     @property

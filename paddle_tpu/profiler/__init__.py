@@ -7,6 +7,7 @@ RecordEvent maps to jax.profiler ranges. MFU/tokens-per-sec metrics are
 first-class (BASELINE.md north star) via `StepTimer`/`MetricsLogger`.
 """
 
+import atexit
 import contextlib
 import json
 import os
@@ -29,6 +30,9 @@ class Profiler:
         self.timer_only = timer_only
         self._active = False
         self._step = 0
+        self._atexit_registered = False
+        self._window_started = False
+        self._window_active = False   # the WINDOW opened the live trace
         self.scheduler = scheduler  # (start_batch, end_batch) window
         self.on_trace_ready = on_trace_ready
 
@@ -37,21 +41,50 @@ class Profiler:
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
+            if not self._atexit_registered:
+                # a trace left open at process exit is never flushed —
+                # guard against callers that exit inside the scheduler
+                # window (or never call stop())
+                self._atexit_registered = True
+                atexit.register(self._atexit_stop)
 
     def stop(self):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._window_active = False
+            if self._atexit_registered:
+                # atexit holds a strong ref to self (and anything the
+                # on_trace_ready closure captured) — release it, or every
+                # Profiler ever started leaks until process exit
+                self._atexit_registered = False
+                atexit.unregister(self._atexit_stop)
             if self.on_trace_ready is not None:
                 self.on_trace_ready(self)
+
+    def _atexit_stop(self):
+        try:
+            self.stop()
+        except Exception:   # interpreter teardown: never raise from atexit
+            pass
 
     def step(self):
         self._step += 1
         if self.scheduler and not self.timer_only:
             start, end = self.scheduler
-            if self._step == start and not self._active:
+            # range (not ==) checks so a counter that jumps PAST a window
+            # boundary can't leave the trace open forever; the
+            # started-this-window flag keeps the window one-shot — a
+            # manual stop() mid-window must not re-arm on the next step
+            if start <= self._step < end and not self._active \
+                    and not self._window_started:
+                self._window_started = True
                 self.start()
-            elif self._step == end and self._active:
+                self._window_active = True
+            elif self._step >= end and self._active \
+                    and self._window_active:
+                # only close the trace the WINDOW opened — a manual
+                # post-window start() stays under the caller's control
                 self.stop()
 
     def __enter__(self):
@@ -124,7 +157,11 @@ def detect_peak_flops(default=197e12):
 
 
 class StepTimer:
-    """Per-step wall timing with warmup discard; reports tokens/s/chip + MFU."""
+    """Per-step wall timing with warmup discard; reports tokens/s/chip + MFU.
+
+    Each completed step's duration is also observed into the process-wide
+    metrics registry (histogram ``train.step_seconds``) so exporters see
+    training cadence without a second timer."""
 
     def __init__(self, model_flops_per_token: Optional[float] = None,
                  warmup: int = 2):
@@ -138,36 +175,81 @@ class StepTimer:
         return self
 
     def __exit__(self, *exc):
-        self.times.append(time.perf_counter() - self._t0)
+        dt = time.perf_counter() - self._t0
+        self.times.append(dt)
+        # get-or-create each time (one dict lookup): caching the
+        # Histogram object would orphan it across registry().reset()
+        from paddle_tpu.observability.registry import registry
+        registry().histogram("train.step_seconds").observe(dt)
 
     def mean_step_time(self):
+        """Mean post-warmup step seconds; None before any step completes
+        (a 0.0 here used to propagate into ZeroDivisionError in
+        tokens_per_sec/mfu)."""
         xs = self.times[self.warmup:] or self.times
-        return sum(xs) / max(len(xs), 1)
+        if not xs:
+            return None
+        return sum(xs) / len(xs)
 
     def tokens_per_sec(self, tokens_per_step, n_chips=1):
-        return tokens_per_step / self.mean_step_time() / n_chips
+        mst = self.mean_step_time()
+        if not mst:
+            return None     # no completed step yet (or 0-duration steps)
+        return tokens_per_step / mst / n_chips
 
     def mfu(self, tokens_per_step, n_chips=1, peak=None):
         if self.flops_per_token is None:
             return None
+        mst = self.mean_step_time()
+        if not mst:
+            return None     # no completed step yet
         peak = peak or detect_peak_flops()
-        achieved = self.flops_per_token * tokens_per_step / self.mean_step_time()
+        achieved = self.flops_per_token * tokens_per_step / mst
         return achieved / (peak * n_chips)
 
 
 class MetricsLogger:
     """Structured JSONL metrics (SURVEY.md §5-metrics: step time, tokens/s/chip,
-    MFU as first-class outputs)."""
+    MFU as first-class outputs).
 
-    def __init__(self, path="metrics.jsonl"):
+    Each line is written with ONE ``os.write`` on an ``O_APPEND`` fd —
+    POSIX appends are atomic per write, so per-rank writers under
+    ``parallel/launch.py`` sharing a path can't interleave partial JSON
+    (the old buffered ``open(..., "a").write`` could split a line across
+    stdio flushes). Numeric fields are mirrored into the process-wide
+    metrics registry as ``metrics.<key>`` gauges."""
+
+    def __init__(self, path="metrics.jsonl", mirror_to_registry=True):
         self.path = path
+        self.mirror_to_registry = mirror_to_registry
 
     def log(self, **metrics):
+        from paddle_tpu.observability.registry import append_jsonl_lines
         metrics.setdefault("ts", time.time())
-        with open(self.path, "a") as f:
-            f.write(json.dumps(metrics) + "\n")
+        append_jsonl_lines(self.path, [json.dumps(metrics)])
+        if self.mirror_to_registry:
+            from paddle_tpu.observability.registry import registry
+            reg = registry()
+            for k, v in metrics.items():
+                if k != "ts" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    reg.gauge(f"metrics.{k}").set(v)
+            reg.counter("metrics.lines").inc()
 
 
 def model_flops_per_token(n_params: int) -> float:
     """Transformer ≈ 6 * N flops/token for fwd+bwd (standard estimate)."""
     return 6.0 * n_params
+
+
+def roofline_report(log_dir: str, plan):
+    """Join the latest xplane capture in `log_dir` against an analytic
+    roofline plan → per-phase "% of roofline, named residual" table (the
+    artifact the SCALE.md re-measure items ask for). See
+    `profiler.xplane.roofline_report` for the plan shape; benches embed
+    one as `roofline_plan` in their BENCH json, and
+    `examples/scale_report.py --report <log_dir> --plan <json>` prints
+    the table from the command line."""
+    from paddle_tpu.profiler import xplane
+
+    return xplane.roofline_report(log_dir, plan)

@@ -24,6 +24,7 @@ def _strategy(dtype):
     return s
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
 def test_amp_o2_trains_with_masters(dtype):
     s = _strategy(dtype)

@@ -143,6 +143,7 @@ def test_new_activations():
     assert y.shape == (2, 4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_new_losses_match_torch():
     torch = pytest.importorskip("torch")
     x = R.standard_normal((4, 5)).astype(np.float32)

@@ -33,6 +33,7 @@ def test_shard_tensor_placements_roundtrip():
     assert r.sharding.spec == P("y", None)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_engine_fit_decreases_loss():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW

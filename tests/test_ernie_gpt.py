@@ -15,6 +15,7 @@ from paddle_tpu.parallel.strategy import DistributedStrategy
 from paddle_tpu.parallel.topology import set_hybrid_communicate_group
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_ernie_mlm_branch_trains():
     cfg = ErnieConfig.tiny()
     paddle_tpu.seed(0)
@@ -69,6 +70,7 @@ def test_ernie_nlg_branch_is_causal():
     assert float(jnp.abs(out1n[:, 0] - out2n[:, 0]).max()) > 1e-6
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_ernie_semi_auto_engine():
     from paddle_tpu.parallel.auto_parallel import Engine
     s = DistributedStrategy()
@@ -92,6 +94,7 @@ def test_ernie_semi_auto_engine():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_gpt_pipeline_tied_embeddings_matches_single_device():
     """SharedLayerDesc parity: tied wte unembedding through the pipeline."""
     s = DistributedStrategy()

@@ -42,6 +42,7 @@ def test_hf_llama_logits_match():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_hf_gpt2_logits_match():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -17,6 +17,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 DRIVER = os.path.join(HERE, "mp_driver.py")
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_two_process_cpu_collectives():
     env = dict(os.environ)
     # children pin their own platform/device count; the parent suite's

@@ -32,6 +32,7 @@ def pp_fleet():
     set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_pipeline_matches_single_device(pp_fleet):
     f, s = pp_fleet
     cfg = LlamaConfig.tiny()

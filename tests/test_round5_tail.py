@@ -57,6 +57,7 @@ def test_histogram_bin_edges_matches_numpy():
     np.testing.assert_allclose(got, np.linspace(-1, 1, 5), atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): shape/range smoke, low risk
 def test_random_tail_shapes_and_ranges():
     paddle_tpu.seed(0)
     b = tensor.binomial(jnp.full((100,), 10), jnp.full((100,), 0.5))
@@ -188,6 +189,7 @@ def _np_rnnt(logits, labels, tl, ul, blank=0):
     return np.asarray(out)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_rnnt_loss_matches_numpy_dp():
     r = np.random.RandomState(0)
     B, T, U, V = 3, 7, 4, 9

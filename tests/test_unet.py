@@ -67,6 +67,7 @@ def test_ddpm_training_descends():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_unet_param_scale_sd15():
     # SD 1.5 UNet ≈ 860M params: sanity-check the architecture wiring by
     # parameter count of the full config without instantiating (too slow) —

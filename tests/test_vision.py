@@ -112,6 +112,7 @@ def test_backbone_tail_forward_shapes():
     assert 0.3e6 < nsh < 1.5e6, nsh        # published ≈ 1.37M @1000 cls
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_backbone_tail_trains_one_step():
     import numpy as np
 

@@ -155,6 +155,7 @@ def test_new_transforms():
     (lambda: vision.LeNet(num_classes=10), (2, 1, 28, 28)),
     (lambda: vision.MobileNetV2(scale=0.25, num_classes=7), (1, 3, 32, 32)),
 ])
+@pytest.mark.slow  # tier-1 budget (PR 3 offset): sibling coverage stays tier-1
 def test_small_vision_models_forward(ctor, shape):
     paddle_tpu.seed(0)
     m = ctor()
