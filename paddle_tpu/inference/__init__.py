@@ -10,6 +10,7 @@ there is no separate "optimized program" artifact because jit compilation
 IS the optimization pass.
 """
 
+import logging
 from typing import Callable, Dict, Optional
 
 import jax
@@ -18,6 +19,8 @@ import numpy as np
 from jax import lax
 
 from paddle_tpu.nn.layer import functional_call
+
+logger = logging.getLogger("paddle_tpu.inference")
 
 
 def _inference_state(model):
@@ -66,7 +69,9 @@ def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
              top_p=1.0, eos_token_id: Optional[int] = None, seed: int = 0,
-             state: Optional[Dict] = None, cache_dtype=jnp.bfloat16):
+             state: Optional[Dict] = None, cache_dtype=jnp.bfloat16,
+             deadline_s: Optional[float] = None, _kv_chunk: int = 0,
+             _force_layered: bool = False):
     """Autoregressive generation with a preallocated KV cache.
 
     model must expose forward(ids, cache=..., start_pos=...) and
@@ -83,6 +88,27 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     with per-(layer, kv-head) scales, and every decode step streams int8
     KV + dequantizes on the compute path. Requires the fused decode plan
     (llama, gpt and moe archs).
+
+    Resilience (paddle_tpu.resilience; docs/RESILIENCE.md):
+
+    * ``deadline_s`` — per-request wall-clock budget. The request runs
+      as a prefill + chunked-decode program pair (the traced-decode
+      machinery) so the deadline is checked at chunk boundaries; on
+      expiry the tokens produced so far come back (≥ 1) and
+      ``resilience.deadline_exceeded`` increments. ``None`` (default)
+      keeps the single-dispatch program untouched.
+    * Accelerator OOM (RESOURCE_EXHAUSTED) triggers the degradation
+      ladder: retry with a HALVED KV chunk (less VMEM scratch), then
+      fall back to the layered (non-fused) decode path; each rung
+      increments ``resilience.decode_degraded{stage=...}``. An int8
+      cache stops at the halved-chunk rung (the layered path cannot
+      stream a quantized cache — and a bf16 refill would only grow the
+      footprint that just OOM'd). ``_kv_chunk``/``_force_layered`` are
+      the ladder's internal knobs, not API.
+
+    With no fault plan armed and no deadline, the request takes the
+    exact code path it always did — bit-identical tokens, no added
+    dispatches (pinned by tests/test_resilience.py).
     """
     from paddle_tpu.core.flags import flag
 
@@ -96,7 +122,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     # one stacked jnp program elsewhere. The cache length is padded to the
     # kernel's 128-token chunk size (attention masks the tail either way).
     plan = (model.fused_decode_plan(state, probe=True)
-            if flag("FLAGS_fused_decode")
+            if flag("FLAGS_fused_decode") and not _force_layered
             and hasattr(model, "fused_decode_plan") else None)
     if plan is not None and b > plan.get("max_batch", b):
         plan = None     # e.g. MoE no-drop bound b ≤ per-expert capacity
@@ -134,10 +160,15 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     from paddle_tpu import observability as obs
 
     tracer = obs.active_tracer()
+    if tracer is None and deadline_s is not None:
+        # a deadline needs chunk boundaries to check the clock at: ride
+        # the traced split programs (token-identical to the single
+        # dispatch) under a local, un-attached tracer
+        tracer = obs.Tracer()
     jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
     jit_key = (b, prompt_len, max_new_tokens, float(temperature),
                int(top_k), float(top_p), eos, jnp.dtype(cache_dtype).name,
-               model.training, plan is not None)
+               model.training, plan is not None, int(_kv_chunk))
     run = jit_cache.get(jit_key)
     traced_fns = jit_cache.get(jit_key + ("traced",))
     if (run is None if tracer is None else traced_fns is None):
@@ -196,7 +227,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                         eps=plan_t["eps"], rope_base=plan_t["rope_base"],
                         arch=plan_t.get("arch", "llama"),
                         top_k=plan_t.get("top_k", 2),
-                        blocks=blocks, kv_scales=kv_scales)
+                        blocks=blocks, kv_scales=kv_scales,
+                        kv_chunk=_kv_chunk)
                     with jax.named_scope("decode.sample"):
                         nxt = _sample_logits(plan_t["head"](x), ki,
                                              temperature, top_k, top_p)
@@ -257,30 +289,65 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             jit_cache[jit_key + ("traced",)] = traced_fns
 
     key0 = jax.random.PRNGKey(seed)
-    if tracer is None:
-        new_tokens = run(state, cache, input_ids, key0)
-    else:
-        # analytic cache accounting for the request span: total allocated
-        # KV bytes at the cache dtype, and the avg bytes a decode step
-        # streams (cache fill averaged over the decode window)
-        leaves = jax.tree_util.tree_leaves(cache)
-        itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
-        kv_cache_bytes = int(sum(l.size * itemsize for l in leaves))
-        avg_len = min(prompt_len + max_new_tokens / 2.0, total)
-        pf, dc = traced_fns
-        pieces = obs.run_traced_decode(
-            tracer,
-            lambda: pf(state, cache, input_ids, key0),
-            lambda carry, aux, i0, c: dc(state, carry, aux, i0, c),
-            batch=b, max_new_tokens=max_new_tokens,
-            attrs=dict(
-                arch=(plan.get("arch", "llama") if plan is not None
-                      else type(model).__name__),
-                fused=plan is not None, prompt_len=prompt_len,
-                kv_cache_dtype=jnp.dtype(cache_dtype).name,
-                kv_cache_bytes=kv_cache_bytes,
-                kv_bytes_per_step=int(kv_cache_bytes * avg_len / total)))
-        new_tokens = jnp.concatenate(pieces, axis=1)
+    from paddle_tpu.resilience import faults as _faults
+    from paddle_tpu.resilience import (is_resource_exhausted, record_event,
+                                       remaining_deadline)
+
+    import time as _time
+    t_request = _time.perf_counter()
+    try:
+        # injectable accelerator-OOM site (one global read when disarmed)
+        _faults.maybe_fire("decode.dispatch")
+        if tracer is None:
+            new_tokens = run(state, cache, input_ids, key0)
+        else:
+            # analytic cache accounting for the request span: total
+            # allocated KV bytes at the cache dtype, and the avg bytes a
+            # decode step streams (cache fill averaged over the window)
+            leaves = jax.tree_util.tree_leaves(cache)
+            itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
+            kv_cache_bytes = int(sum(l.size * itemsize for l in leaves))
+            avg_len = min(prompt_len + max_new_tokens / 2.0, total)
+            pf, dc = traced_fns
+            pieces = obs.run_traced_decode(
+                tracer,
+                lambda: pf(state, cache, input_ids, key0),
+                lambda carry, aux, i0, c: dc(state, carry, aux, i0, c),
+                batch=b, max_new_tokens=max_new_tokens,
+                deadline_s=deadline_s,
+                attrs=dict(
+                    arch=(plan.get("arch", "llama") if plan is not None
+                          else type(model).__name__),
+                    fused=plan is not None, prompt_len=prompt_len,
+                    kv_cache_dtype=jnp.dtype(cache_dtype).name,
+                    kv_cache_bytes=kv_cache_bytes,
+                    kv_bytes_per_step=int(kv_cache_bytes * avg_len / total)))
+            new_tokens = jnp.concatenate(pieces, axis=1)
+    except Exception as e:  # noqa: BLE001 — ladder filters by class below
+        if not is_resource_exhausted(e):
+            raise
+        remaining = remaining_deadline(deadline_s, t_request)
+        retry_kw = dict(max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id, seed=seed, state=state,
+                        cache_dtype=cache_dtype, deadline_s=remaining)
+        if plan is not None and _kv_chunk == 0:
+            record_event("decode_degraded", stage="halved_chunk")
+            logger.warning(
+                "decode OOM (%s); retrying with a reduced KV chunk", e)
+            # 32 is strictly below every auto-picked chunk (64 in the 7B
+            # q-split regime, 128 plain, 256 MoE-int8), so the rung is
+            # never a no-op recompile of the configuration that just
+            # OOM'd; it always divides the 128-padded cache length
+            return generate(model, input_ids, _kv_chunk=32, **retry_kw)
+        if plan is not None and not kv_int8:
+            record_event("decode_degraded", stage="layered")
+            logger.warning(
+                "decode OOM persists (%s); falling back to the layered "
+                "(non-fused) decode path", e)
+            return generate(model, input_ids, _force_layered=True,
+                            **retry_kw)
+        raise
     if eos_token_id is not None:
         # trim columns where every row is already past its eos
         arr = np.asarray(new_tokens)

@@ -21,6 +21,7 @@ live at the stack boundary — fine at 1B, impossible for Llama-2-7B int8
   `from_state_dict` imports a per-layer checkpoint state layer by layer.
 """
 
+import logging
 import math
 from typing import Dict, Optional
 
@@ -33,6 +34,8 @@ from paddle_tpu.ops import fused_decode as fd
 from paddle_tpu.ops.rope import rope_cos_sin
 
 __all__ = ["StackedLlamaDecoder"]
+
+logger = logging.getLogger("paddle_tpu.inference")
 
 
 class StackedLlamaDecoder:
@@ -243,7 +246,8 @@ class StackedLlamaDecoder:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 cache_dtype=jnp.bfloat16):
+                 cache_dtype=jnp.bfloat16,
+                 deadline_s: Optional[float] = None, _kv_chunk: int = 0):
         """Prefill + fused-kernel decode, the whole loop one jitted scan.
         Returns (b, prompt+new) ids including the prompt.
 
@@ -252,7 +256,14 @@ class StackedLlamaDecoder:
         per-(layer, kv-head) scales (ops.fused_decode.quantize_kv_cache)
         and the fused kernel streams int8 KV chunks — halving the
         per-step cache DMA, the long-context (s >= 2048) decode regime
-        where cache bytes dominate the roofline."""
+        where cache bytes dominate the roofline.
+
+        Resilience (see inference.generate): ``deadline_s`` runs the
+        request as chunked decode programs and returns early at the
+        budget; accelerator OOM retries ONCE with a halved KV chunk
+        (``resilience.decode_degraded{stage=halved_chunk}``) — this
+        engine has no layered fallback (the stacked weights ARE the
+        fused layout), so a second OOM propagates."""
         from paddle_tpu import observability as obs
         from paddle_tpu.inference import _sample_logits
 
@@ -267,8 +278,12 @@ class StackedLlamaDecoder:
                 f"cache; got cache_dtype={jnp.dtype(cache_dtype).name}")
         key0 = jax.random.PRNGKey(seed)
         tracer = obs.active_tracer()
+        if tracer is None and deadline_s is not None:
+            # deadline checks happen at chunk boundaries — ride the split
+            # programs under a local, un-attached tracer
+            tracer = obs.Tracer()
         jk = (b, prompt_len, max_new_tokens, float(temperature), int(top_k),
-              float(top_p), jnp.dtype(cache_dtype).name)
+              float(top_p), jnp.dtype(cache_dtype).name, int(_kv_chunk))
         run = self._jit_cache.get(jk)
         traced_fns = self._jit_cache.get(jk + ("traced",))
         if (run is None if tracer is None else traced_fns is None):
@@ -314,7 +329,8 @@ class StackedLlamaDecoder:
                         x, params, kv, pos, cos, sin,
                         num_heads=cfg.num_heads, num_kv_heads=cfg.kv_heads,
                         eps=cfg.rms_norm_eps, rope_base=cfg.rope_base,
-                        blocks=blocks, kv_scales=kv_scales)
+                        blocks=blocks, kv_scales=kv_scales,
+                        kv_chunk=_kv_chunk)
                     with jax.named_scope("decode.sample"):
                         nxt = _sample_logits(
                             logits(x, embed_w, norm_w, head_arrays), ki,
@@ -348,31 +364,58 @@ class StackedLlamaDecoder:
                 self._jit_cache[jk + ("traced",)] = traced_fns
 
         head_arrays = tuple(self.head[1:])
-        if tracer is None:
-            new = run(self.params, self.embed_w, self.norm_w, head_arrays,
-                      input_ids, key0)
-        else:
-            dkv = cfg.kv_heads * cfg.head_dim
-            itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
-            kv_cache_bytes = cfg.num_layers * b * total * 2 * dkv * itemsize
-            avg_len = min(prompt_len + max_new_tokens / 2.0, total)
-            pf, dc = traced_fns
-            pieces = obs.run_traced_decode(
-                tracer,
-                lambda: pf(self.params, self.embed_w, self.norm_w,
-                           head_arrays, input_ids, key0),
-                lambda carry, aux, i0, c: dc(
-                    self.params, self.embed_w, self.norm_w, head_arrays,
-                    carry, aux, i0, c),
-                batch=b, max_new_tokens=max_new_tokens,
-                attrs=dict(
-                    arch="llama-stacked", fused=True,
-                    prompt_len=prompt_len,
-                    kv_cache_dtype=jnp.dtype(cache_dtype).name,
-                    kv_cache_bytes=int(kv_cache_bytes),
-                    kv_bytes_per_step=int(kv_cache_bytes * avg_len
-                                          / total)))
-            new = jnp.concatenate(pieces, axis=1)
+        from paddle_tpu.resilience import faults as _faults
+        from paddle_tpu.resilience import (is_resource_exhausted,
+                                           record_event,
+                                           remaining_deadline)
+
+        import time as _time
+        t_request = _time.perf_counter()
+        try:
+            _faults.maybe_fire("decode.dispatch")
+            if tracer is None:
+                new = run(self.params, self.embed_w, self.norm_w,
+                          head_arrays, input_ids, key0)
+            else:
+                dkv = cfg.kv_heads * cfg.head_dim
+                itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
+                kv_cache_bytes = (cfg.num_layers * b * total * 2 * dkv
+                                  * itemsize)
+                avg_len = min(prompt_len + max_new_tokens / 2.0, total)
+                pf, dc = traced_fns
+                pieces = obs.run_traced_decode(
+                    tracer,
+                    lambda: pf(self.params, self.embed_w, self.norm_w,
+                               head_arrays, input_ids, key0),
+                    lambda carry, aux, i0, c: dc(
+                        self.params, self.embed_w, self.norm_w, head_arrays,
+                        carry, aux, i0, c),
+                    batch=b, max_new_tokens=max_new_tokens,
+                    deadline_s=deadline_s,
+                    attrs=dict(
+                        arch="llama-stacked", fused=True,
+                        prompt_len=prompt_len,
+                        kv_cache_dtype=jnp.dtype(cache_dtype).name,
+                        kv_cache_bytes=int(kv_cache_bytes),
+                        kv_bytes_per_step=int(kv_cache_bytes * avg_len
+                                              / total)))
+                new = jnp.concatenate(pieces, axis=1)
+        except Exception as e:  # noqa: BLE001 — filtered by class below
+            if not (is_resource_exhausted(e) and _kv_chunk == 0):
+                raise
+            record_event("decode_degraded", stage="halved_chunk")
+            logger.warning(
+                "stacked decode OOM (%s); retrying with a reduced KV chunk",
+                e)
+            # retry rungs inherit the REMAINING request budget; 32 sits
+            # strictly below every auto-picked chunk (64/128), so the
+            # retry is never a recompile of the config that just OOM'd
+            remaining = remaining_deadline(deadline_s, t_request)
+            return self.generate(
+                input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, cache_dtype=cache_dtype, deadline_s=remaining,
+                _kv_chunk=32)
         return jnp.concatenate([input_ids, new], axis=1)
 
     def num_params(self):

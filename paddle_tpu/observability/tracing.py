@@ -139,7 +139,8 @@ def trace(**tracer_kwargs):
 
 def run_traced_decode(tracer: Tracer, prefill_call: Callable,
                       decode_call: Callable, *, batch: int,
-                      max_new_tokens: int, attrs: dict):
+                      max_new_tokens: int, attrs: dict,
+                      deadline_s: Optional[float] = None):
     """Drive a split decode under spans; returns the list of token pieces
     (each (b, n)) to concatenate along axis 1.
 
@@ -148,6 +149,14 @@ def run_traced_decode(tracer: Tracer, prefill_call: Callable,
     (nsteps, b). Records TTFT (request start → first token *on the
     host*), TPOT (decode span / (new-1)), tokens/s into the tracer's
     registry and onto the request span's attrs.
+
+    deadline_s: per-request wall-clock budget (graceful degradation,
+    paddle_tpu.resilience): measured from request start; once a chunk
+    boundary finds it spent, the request STOPS and returns the tokens
+    produced so far (never fewer than the prefill's first token —
+    already-dispatched work is not abandoned), bumping
+    ``resilience.deadline_exceeded`` and tagging the request span
+    ``deadline_exceeded=True``.
 
     Sync discipline: each phase is fenced by PULLING token values to the
     host (np.asarray of the tiny token arrays), not block_until_ready —
@@ -168,7 +177,12 @@ def run_traced_decode(tracer: Tracer, prefill_call: Callable,
         ttft = time.perf_counter() - t0
         pieces = [carry[0][:, None]]
         i, chunk = 1, max(tracer.decode_chunk, 1)
+        cut = False
         while i < max_new_tokens:
+            if deadline_s is not None \
+                    and time.perf_counter() - t0 >= deadline_s:
+                cut = True
+                break
             c = min(chunk, max_new_tokens - i)
             with tracer.span("decode.chunk", start=i, tokens=c) as cs:
                 carry, toks = decode_call(carry, aux, i, c)
@@ -177,17 +191,20 @@ def run_traced_decode(tracer: Tracer, prefill_call: Callable,
                 if cs.dur_s else None
             pieces.append(toks.T)
             i += c
+        produced = sum(int(p.shape[1]) for p in pieces)
         dur = time.perf_counter() - t0
-        tok_s = batch * max_new_tokens / dur if dur else 0.0
-        tpot = ((dur - ttft) / (max_new_tokens - 1)
-                if max_new_tokens > 1 else None)
+        tok_s = batch * produced / dur if dur else 0.0
+        tpot = (dur - ttft) / (produced - 1) if produced > 1 else None
         req.attrs.update(ttft_s=round(ttft, 6),
                          tpot_s=round(tpot, 6) if tpot is not None else None,
                          tokens_per_sec=round(tok_s, 1))
+        if cut:
+            req.attrs.update(deadline_exceeded=True, tokens_produced=produced)
+            reg.counter("resilience.deadline_exceeded").inc()
         reg.histogram("decode.ttft_seconds").observe(ttft)
         if tpot is not None:
             reg.histogram("decode.tpot_seconds").observe(tpot)
         reg.counter("decode.requests").inc()
-        reg.counter("decode.tokens").inc(batch * max_new_tokens)
+        reg.counter("decode.tokens").inc(batch * produced)
         reg.gauge("decode.tokens_per_sec").set(round(tok_s, 1))
     return pieces

@@ -234,8 +234,11 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         # A concrete mask can be inspected: route such masks to the XLA
         # path so the backends agree (−inf means "masked" and stays
         # kernel-eligible). The reduction runs ON DEVICE — only the bool
-        # verdict syncs to host, not the (b, h, sq, sk) mask itself.
-        if bool(jnp.any((kmask <= -1e9) & ~jnp.isneginf(kmask))):
+        # verdict syncs to host, not the (b, h, sq, sk) mask itself —
+        # and the verdict is CACHED per mask object, so only the first
+        # eager call with a given mask pays it (under jit the whole
+        # branch traces once; r5 item flagged by the PR 3 review).
+        if _float_mask_probe(attn_mask, kmask):
             pallas_ok = False
     if pallas_ok:
         padded = _pad_for_kernel(q, k, v, is_causal, scale, kv_lens, seg_k)
@@ -263,6 +266,43 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                           training=training, kv_lens=kv_lens,
                           seg_q=seg_q, seg_k=seg_k, window=window_size,
                           alibi_slopes=alibi_slopes)
+
+
+# verdict cache for the eager concrete-float-mask probe, keyed by the
+# id() of the USER-PASSED mask object with a weakref guard: the guard
+# proves the id still names the same live array (a dead entry is removed
+# by the weakref callback during dealloc, before the id can be reused,
+# and `ref() is mask` re-checks anyway). Only IMMUTABLE jax.Arrays are
+# cached — a numpy mask can be written in place between calls, which
+# would make a cached verdict silently stale. Bounded by mask lifetimes,
+# not call count — serving loops reuse one mask array across thousands
+# of eager calls and now pay the full-mask reduction + host sync once.
+_float_mask_verdicts = {}
+
+
+def _float_mask_probe(attn_mask, kmask) -> bool:
+    """True when the concrete float mask holds finite entries at/below
+    the −1e9 threshold (not −inf) — i.e. must route to the XLA path."""
+    import weakref
+
+    cacheable = isinstance(attn_mask, jax.Array) \
+        and not isinstance(attn_mask, jax.core.Tracer)
+    mid = id(attn_mask)
+    if cacheable:
+        entry = _float_mask_verdicts.get(mid)
+        if entry is not None and entry[0]() is attn_mask:
+            return entry[1]
+    verdict = bool(jnp.any((kmask <= -1e9) & ~jnp.isneginf(kmask)))
+    if not cacheable:
+        return verdict
+    try:
+        ref = weakref.ref(attn_mask,
+                          lambda _r, _i=mid: _float_mask_verdicts.pop(_i,
+                                                                      None))
+    except TypeError:        # array type without weakref support
+        return verdict
+    _float_mask_verdicts[mid] = (ref, verdict)
+    return verdict
 
 
 def _kernel_mask(attn_mask, q_shape, k_shape):

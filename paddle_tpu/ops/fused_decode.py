@@ -1534,7 +1534,7 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                       num_heads: int, num_kv_heads: int, eps: float = 1e-5,
                       rope_base: float = 10000.0, arch: str = "llama",
                       top_k: int = 2, blocks: Optional[Dict] = None,
-                      kv_scales=None):
+                      kv_scales=None, kv_chunk: int = 0):
     """Dispatch: Pallas whole-stack kernel on TPU, jnp reference elsewhere.
 
     Args follow fused_decode_reference (combined flat KV cache). `pos` may
@@ -1543,7 +1543,11 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
     dict (the plan that padded the params must also drive the kernel; for
     arch="moe" only its `cache_wbytes` is consumed — consistency-checked
     against the cache dtype). `kv_scales` enables the int8 KV-cache mode
-    (all three archs; see quantize_kv_cache).
+    (all three archs; see quantize_kv_cache). `kv_chunk` overrides the
+    kernel's KV-chunk sizing (0 = let the kernel pick) — the OOM
+    degradation ladder in `inference.generate` retries with a halved
+    chunk, shrinking the double-buffered VMEM chunk scratch; the jnp
+    reference path ignores it (no chunking to size).
 
     FLAGS_pallas_interpret=1 routes the Pallas kernel through interpret
     mode off-TPU — the CPU-CI path for kernel-logic parity tests.
@@ -1575,14 +1579,16 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
                         x, params, kv_cache, pos,
                         num_heads=num_heads, num_kv_heads=num_kv_heads,
                         head_dim=dkv // num_kv_heads, top_k=top_k,
-                        rope_base=rope_base, eps=eps, blocks=blocks,
-                        kv_scales=kv_scales, interpret=interp)
+                        rope_base=rope_base, eps=eps, chunk=kv_chunk,
+                        blocks=blocks, kv_scales=kv_scales,
+                        interpret=interp)
             with jax.named_scope("fused_decode.kernel"):
                 return _fused_decode_pallas(
                     x, params, kv_cache, pos,
                     num_heads=num_heads, num_kv_heads=num_kv_heads,
                     head_dim=dkv // num_kv_heads,
-                    rope_base=rope_base, eps=eps, arch=arch, blocks=blocks,
+                    rope_base=rope_base, eps=eps, chunk=kv_chunk,
+                    arch=arch, blocks=blocks,
                     kv_scales=kv_scales, interpret=interp)
         except Exception as e:  # pragma: no cover - hardware-dependent
             if flag("FLAGS_pallas_strict"):

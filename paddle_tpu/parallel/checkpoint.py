@@ -98,14 +98,29 @@ def load_state_dict(path: str, target=None, mesh: Optional[Mesh] = None,
 
 
 class CheckpointManager:
-    """Step-numbered checkpoints with retention, async save and auto-resume.
+    """Step-numbered checkpoints with retention, async save, auto-resume
+    and integrity manifests.
 
     Parity: the reference launcher's restart-from-checkpoint loop + 2.6's
     unified dist checkpoint; implemented over orbax.CheckpointManager.
+
+    Integrity (paddle_tpu.resilience.integrity, on by default): every
+    completed save commits a manifest — per-file size+crc32 and
+    (``tensor_checksums``; defaults to sync-saves-only since it
+    host-pulls the whole state) per-tensor checksums — under
+    ``<directory>/integrity/step_<N>.json``, written only AFTER the data
+    is durable (async saves flush manifests on ``wait_until_finished`` /
+    the next ``save``). The manifest is the step's commit marker:
+    ``verified_latest_step()`` walks back past steps with no manifest
+    (save never committed) or mismatched files (corruption), which is
+    what ``ElasticTrainLoop`` resumes from — one torn latest checkpoint
+    no longer means a permanent crash loop.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 save_interval_steps: int = 1, async_save: bool = True):
+                 save_interval_steps: int = 1, async_save: bool = True,
+                 integrity: bool = True,
+                 tensor_checksums: Optional[bool] = None):
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
@@ -114,11 +129,55 @@ class CheckpointManager:
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save)
         self._mngr = ocp.CheckpointManager(self._dir, options=self._options)
+        self._async = async_save
+        self._integrity = integrity
+        # per-tensor checksums host-pull + crc the WHOLE state on the
+        # caller thread at save() — defeating exactly the stall an async
+        # save exists to avoid, for a deep-verify mode nothing on the
+        # default resume path consumes. Default: on for sync saves
+        # (tests, small models — full end-to-end verification), off for
+        # async (file-level manifests still catch truncation/bit-rot).
+        self._tensor_checksums = (not async_save if tensor_checksums is None
+                                  else tensor_checksums)
+        self._pending: Dict[int, Optional[dict]] = {}
 
     def save(self, step: int, state: Dict[str, Any], force: bool = False):
         import orbax.checkpoint as ocp
-        return self._mngr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        from paddle_tpu.resilience import faults as _faults
+        from paddle_tpu.resilience import integrity as _integ
+
+        # cooperative fault site: kind='corrupt_checkpoint' damages the
+        # files AFTER the commit below — the torn/bit-rotted checkpoint
+        # verified_latest_step() exists to walk past
+        fault = _faults.maybe_fire("checkpoint.save", index=int(step))
+        if self._integrity and self._pending:
+            # a new save waits for the previous async commit anyway
+            # (orbax serializes); manifest those now-durable steps first
+            self._mngr.wait_until_finished()
+            self._flush_manifests()
+        saved = self._mngr.save(step, args=ocp.args.StandardSave(state),
+                                force=force)
+        if saved and self._integrity:
+            self._pending[int(step)] = (
+                _integ.tensor_checksums(state)
+                if self._tensor_checksums else None)
+            if not self._async:
+                self._flush_manifests()
+        if fault is not None and fault.kind == "corrupt_checkpoint":
+            if not saved:
+                # nothing was written (save_interval skip): give the fire
+                # back so the plan's fired()/pending() stay honest — a
+                # wider `count` window can then still hit a real save
+                # instead of the budget silently evaporating on a no-op
+                fault.refund()
+            else:
+                self.wait_until_finished()  # durable + manifest committed
+                step_dir = self._step_dir(step)
+                if step_dir is not None:
+                    _integ.corrupt_checkpoint(
+                        step_dir,
+                        mode=fault.payload.get("mode", "truncate"))
+        return saved
 
     def restore(self, step: Optional[int] = None, target=None,
                 mesh: Optional[Mesh] = None, specs=None):
@@ -141,9 +200,141 @@ class CheckpointManager:
 
     def wait_until_finished(self):
         self._mngr.wait_until_finished()
+        if self._integrity:
+            self._flush_manifests()
 
     def close(self):
+        self.wait_until_finished()
         self._mngr.close()
+
+    # -- integrity ---------------------------------------------------------
+
+    def _step_dir(self, step: int) -> Optional[str]:
+        """The orbax step directory (plain str(step) on current orbax;
+        scan tolerates prefixed/padded layouts)."""
+        cand = os.path.join(self._dir, str(int(step)))
+        if os.path.isdir(cand):
+            return cand
+        for fn in os.listdir(self._dir):
+            digits = "".join(c for c in fn if c.isdigit())
+            p = os.path.join(self._dir, fn)
+            if os.path.isdir(p) and digits and int(digits) == int(step):
+                return p
+        return None
+
+    def _flush_manifests(self):
+        """Commit manifests for saves whose data is durable, and prune
+        manifests orphaned by keep-K retention. Callers must ensure the
+        orbax save finished (wait_until_finished) first."""
+        from paddle_tpu.resilience import integrity as _integ
+
+        live = set(self._mngr.all_steps())
+        for step in sorted(self._pending):
+            tensors = self._pending.pop(step)
+            if step not in live:
+                continue            # already reaped by retention
+            step_dir = self._step_dir(step)
+            if step_dir is None:
+                continue
+            _integ.write_manifest(self._dir, step,
+                                  _integ.file_checksums(step_dir), tensors)
+        man_dir = os.path.join(self._dir, _integ.MANIFEST_SUBDIR)
+        if os.path.isdir(man_dir):
+            for fn in os.listdir(man_dir):
+                digits = "".join(c for c in fn if c.isdigit())
+                if digits and int(digits) not in live:
+                    try:
+                        os.unlink(os.path.join(man_dir, fn))
+                    except OSError:
+                        pass
+
+    def verify_step(self, step: int, deep: bool = False):
+        """(ok, reason). Fast mode checks the commit manifest + every
+        file's size/crc32; ``deep=True`` additionally RESTORES the step
+        and compares per-tensor checksums (end-to-end, needs
+        tensor_checksums=True at save time)."""
+        from paddle_tpu.resilience import integrity as _integ
+
+        manifest = _integ.read_manifest(self._dir, step)
+        if manifest is None:
+            return False, "no integrity manifest (save never committed?)"
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return False, "step directory missing"
+        ok, reason = _integ.verify_files(manifest, step_dir)
+        if not ok or not deep:
+            return ok, reason
+        try:
+            state = self._mngr.restore(step)
+        except Exception as e:  # noqa: BLE001 — any failure = unverified
+            return False, f"restore failed: {type(e).__name__}: {e}"
+        return _integ.verify_tensors(manifest, state)
+
+    def verified_latest_step(self, deep: bool = False,
+                             quarantine: bool = True) -> Optional[int]:
+        """Newest step that passes integrity verification, walking back
+        past incomplete/corrupt steps (each skip increments
+        ``resilience.checkpoint_corrupt_skipped``). With ``quarantine``
+        (default) a step failing with a DETERMINISTIC content mismatch
+        (size/crc/tensor) is DELETED as it is skipped, so a plain
+        ``latest_step()`` caller (or the re-save of that step number
+        after the resumed run catches back up) never lands on known-bad
+        data; transient-looking failures (unreadable file, missing
+        manifest) are walked past but left on disk — deleting a
+        checkpoint over an I/O blip would turn a recoverable error into
+        data loss. Checkpoints written without integrity (no manifest
+        anywhere) fall back to ``latest_step()`` so pre-existing runs
+        still resume."""
+        from paddle_tpu.resilience import integrity as _integ
+        from paddle_tpu.resilience import record_event
+        import logging
+
+        logger = logging.getLogger("paddle_tpu.resilience")
+        self.wait_until_finished()
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            return None
+        # steps saved BEFORE integrity was enabled have no manifest and
+        # can only be legacy-accepted; steps at/after the oldest
+        # manifested one were saved with integrity on, so "no manifest"
+        # there genuinely means the save never committed. Without the
+        # split, one corrupt post-upgrade step would strand every valid
+        # pre-upgrade checkpoint behind it and restart training from 0.
+        manifested = [s for s in steps
+                      if os.path.isfile(_integ.manifest_path(self._dir, s))]
+        if not manifested:
+            logger.info("no integrity manifests under %s (legacy "
+                        "checkpoints); resuming from latest_step()",
+                        self._dir)
+            return steps[0]
+        first_manifested = min(manifested)
+        for s in steps:
+            if s < first_manifested:
+                logger.info("checkpoint step %d predates integrity "
+                            "manifests; accepting as legacy", s)
+                return s
+            ok, reason = self.verify_step(s, deep=deep)
+            if ok:
+                return s
+            record_event("checkpoint_corrupt_skipped")
+            logger.warning("checkpoint step %d failed verification (%s); "
+                           "walking back", s, reason)
+            if quarantine and _integ.is_content_failure(reason):
+                try:
+                    self._mngr.delete(s)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    # keep the manifest when the delete failed: unlinking
+                    # it while the data survives would flip a later call
+                    # into the legacy no-manifest fallback, which resumes
+                    # from exactly this known-corrupt step
+                    logger.warning("could not quarantine corrupt step %d "
+                                   "(%s)", s, e)
+                    continue
+                try:
+                    os.unlink(_integ.manifest_path(self._dir, s))
+                except OSError:
+                    pass
+        return None
 
 
 def save_persistables(model, optimizer=None, path: str = "checkpoint",

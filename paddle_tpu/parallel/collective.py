@@ -179,6 +179,17 @@ def _member_only(g: Group, opname: str):
 
 _kv_seq: dict = {}
 
+# transient coordination-service hiccups (RPC reset, brief leader loss)
+# retry under resilience.kv_op's shared default-bounded policy (which
+# also carries the injectable kv.op fault site); DEADLINE_EXCEEDED on a
+# blocking get is NOT transient — it means the peer never posted (in-order
+# contract violation / dead peer) and extending it 3x only hides that
+
+
+def _kv_retry(describe, fn, retry_if=None):
+    from paddle_tpu.resilience import kv_op
+    return kv_op(describe, fn, retry_if=retry_if)
+
 
 def _kv_client():
     from jax._src import distributed
@@ -208,8 +219,14 @@ def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
     if payload is not None:
         buf = io.BytesIO()
         np.save(buf, np.asarray(payload), allow_pickle=False)
-        client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
-                             base64.b64encode(buf.getvalue()).decode("ascii"))
+        val = base64.b64encode(buf.getvalue()).decode("ascii")
+        # allow_overwrite: a retried set must be idempotent — the value
+        # may have committed server-side with only the RPC reply lost,
+        # and an already-exists rejection would burn the whole retry
+        # budget on a guaranteed failure
+        _kv_retry("collective.kv_set",
+                  lambda: client.key_value_set(f"ptkv/{tag}/{seq}/{me}",
+                                               val, allow_overwrite=True))
         # allgather-style tags (gc=True) prove consumption 2 generations
         # back and GC safely. One-way tags (broadcast/scatter/send) have
         # NO consumption evidence — a fire-and-forget sender may be
@@ -223,9 +240,13 @@ def _kv_put_get(tag: str, payload, me, peers, timeout_ms=60_000,
             except Exception:
                 pass
     out = {}
+    from paddle_tpu.resilience import is_timeout
     for r in peers:
         key = f"ptkv/{tag}/{seq}/{r}"
-        raw = client.blocking_key_value_get(key, timeout_ms)
+        raw = _kv_retry(
+            "collective.kv_get",
+            lambda key=key: client.blocking_key_value_get(key, timeout_ms),
+            retry_if=lambda e: not is_timeout(e))
         out[r] = np.load(io.BytesIO(base64.b64decode(raw)),
                          allow_pickle=False)
         if consume:

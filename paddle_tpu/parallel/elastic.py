@@ -20,6 +20,10 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Set
 
+from paddle_tpu.resilience import (RetryPolicy, is_not_found, kv_op,
+                                   record_event)
+from paddle_tpu.resilience import faults as _faults
+
 logger = logging.getLogger("paddle_tpu.elastic")
 
 
@@ -92,12 +96,24 @@ class CoordinationServiceStore(HeartbeatStore):
     * ``CoordinationServiceStore(client=...)`` / ``.from_jax()`` — reuse
       an existing client (inside a training process after
       `jax.distributed.initialize`, the job's own coordination service).
+
+    Every KV op runs under the shared bounded-retry policy
+    (paddle_tpu.resilience.retry) — a transient coordination-service
+    hiccup (RPC reset, leader re-election blip) must not read as a dead
+    peer or kill the heartbeat loop. Pass ``retry=None`` to disable.
     """
 
-    def __init__(self, client, prefix: str = "pt_elastic", service=None):
+    def __init__(self, client, prefix: str = "pt_elastic", service=None,
+                 retry: Optional[RetryPolicy] = RetryPolicy()):
         self._client = client
         self._prefix = prefix
         self._service = service        # kept alive on the hosting rank
+        self._retry = retry
+
+    def _kv_call(self, describe: str, fn, retry_if=None):
+        # shared resilience.kv_op wrapper: retry + the injectable kv.op
+        # fault site (policy=None → fault site only, no retry)
+        return kv_op(describe, fn, policy=self._retry, retry_if=retry_if)
 
     @classmethod
     def connect(cls, address: str, rank: int, world_size: int,
@@ -134,19 +150,25 @@ class CoordinationServiceStore(HeartbeatStore):
         return cls(client, prefix=prefix)
 
     def put(self, member, payload):
-        self._client.key_value_set(f"{self._prefix}/{member}",
-                                   json.dumps(payload), allow_overwrite=True)
+        self._kv_call("elastic.kv_set",
+                      lambda: self._client.key_value_set(
+                          f"{self._prefix}/{member}", json.dumps(payload),
+                          allow_overwrite=True))
 
     def members(self):
         out = {}
         try:
-            items = self._client.key_value_dir_get(self._prefix)
-        except Exception as e:
             # empty prefix reads as NOT_FOUND on some versions — that is
-            # genuinely "no members". Anything else (RPC hiccup, service
-            # error) must NOT read as an empty world: the watcher would
+            # genuinely "no members", never worth a retry. Anything else
+            # (RPC hiccup, service error) is retried, and past the retry
+            # budget must NOT read as an empty world: the watcher would
             # declare every peer dead and kill a healthy job.
-            if "NOT_FOUND" in str(e) or "not found" in str(e).lower():
+            items = self._kv_call(
+                "elastic.kv_dir_get",
+                lambda: self._client.key_value_dir_get(self._prefix),
+                retry_if=lambda e: not is_not_found(e))
+        except Exception as e:
+            if is_not_found(e):
                 return out
             raise
         for key, val in items:
@@ -158,7 +180,9 @@ class CoordinationServiceStore(HeartbeatStore):
 
     def remove(self, member):
         try:
-            self._client.key_value_delete(f"{self._prefix}/{member}")
+            self._kv_call("elastic.kv_delete",
+                          lambda: self._client.key_value_delete(
+                              f"{self._prefix}/{member}"))
         except Exception:
             pass
 
@@ -192,6 +216,13 @@ class ElasticManager:
     # -- registration / heartbeat --
 
     def register(self):
+        # cooperative fault site: kind='drop_heartbeat' swallows this
+        # put — from the peers' view this host just went silent, the
+        # exact signal a hung/partitioned host produces
+        fault = _faults.maybe_fire("elastic.heartbeat")
+        if fault is not None and fault.kind == "drop_heartbeat":
+            record_event("heartbeat_dropped")
+            return
         self.store.put(str(self.rank), {"rank": self.rank, "ts": time.time()})
 
     def _heartbeat_loop(self):
@@ -215,10 +246,15 @@ class ElasticManager:
 
     # -- membership --
 
-    def alive(self, now: Optional[float] = None) -> Set[int]:
+    def alive(self, now: Optional[float] = None,
+              members: Optional[Dict[str, dict]] = None) -> Set[int]:
+        """Ranks with a fresh heartbeat. `members` lets a caller reuse ONE
+        store snapshot for several derived views (see watch) instead of
+        re-polling per view."""
         now = now if now is not None else time.time()
+        members = members if members is not None else self.store.members()
         out = set()
-        for m, payload in self.store.members().items():
+        for m, payload in members.items():
             if now - payload.get("ts", 0) <= self.timeout:
                 out.add(int(m))
         return out
@@ -246,11 +282,15 @@ class ElasticManager:
         def loop():
             last = self.alive()
             while not self._stop.wait(poll):
-                cur = self.alive()
+                # ONE store snapshot per poll: alive and dead must be two
+                # views of the same instant — a second poll (the old
+                # self.dead() call) could disagree with `cur` mid-change
+                cur = self.alive(members=self.store.members())
                 if cur != last:
+                    dead = set(range(self.world_size)) - cur
                     logger.warning("membership change: alive=%s dead=%s",
-                                   sorted(cur), sorted(self.dead()))
-                    on_change(cur, set(range(self.world_size)) - cur)
+                                   sorted(cur), sorted(dead))
+                    on_change(cur, dead)
                     last = cur
 
         t = threading.Thread(target=loop, daemon=True)
@@ -259,27 +299,70 @@ class ElasticManager:
         return t
 
 
+def _nan_poison(tree):
+    """NaN-fill every floating leaf (the nan_grads fault injector)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            return jnp.full_like(leaf, jnp.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 class ElasticTrainLoop:
     """Supervised training with checkpoint/resume recovery.
 
     train_step(state, step) -> state : one (or k) optimizer steps; `state`
     is any orbax-serializable pytree (e.g. {"model":…, "opt":…}).
+
+    Recovery semantics (paddle_tpu.resilience):
+
+    * Resume restores from ``CheckpointManager.verified_latest_step()``
+      when the manager provides it — a corrupt/uncommitted latest step is
+      walked past instead of crash-looping forever.
+    * ``nonfinite_policy``: None (off — the step takes the exact code
+      path the seed took), ``"skip"`` (a step whose outputs hold NaN/Inf
+      is dropped: previous state kept, counter bumped, training moves
+      on) or ``"rewind"`` (skip, and after ``nonfinite_limit``
+      CONSECUTIVE bad steps rewind to the last verified checkpoint —
+      charged against the restart budget so a deterministic NaN can't
+      rewind forever). Built on utils.nan_inf's fused device reduction.
+    * The restart budget RESETS after ``restart_reset_steps`` consecutive
+      clean steps (default ``save_every``; 0 disables) — a flaky step at
+      hour 40 is no longer charged against failures from hour 1.
     """
 
     def __init__(self, checkpoint_manager, train_step: Callable,
                  init_state: Callable, max_restarts: int = 3,
                  save_every: int = 100,
-                 restore_target: Optional[Callable] = None):
+                 restore_target: Optional[Callable] = None,
+                 nonfinite_policy: Optional[str] = None,
+                 nonfinite_limit: int = 3,
+                 restart_reset_steps: Optional[int] = None):
+        if nonfinite_policy not in (None, "skip", "rewind"):
+            raise ValueError(
+                f"nonfinite_policy must be None, 'skip' or 'rewind'; got "
+                f"{nonfinite_policy!r}")
         self.mngr = checkpoint_manager
         self.train_step = train_step
         self.init_state = init_state
         self.max_restarts = max_restarts
         self.save_every = save_every
         self.restore_target = restore_target
+        self.nonfinite_policy = nonfinite_policy
+        self.nonfinite_limit = int(nonfinite_limit)
+        self.restart_reset_steps = (save_every if restart_reset_steps is None
+                                    else int(restart_reset_steps))
         self.restarts = 0
+        self.nonfinite_skipped = 0
 
     def _resume(self):
-        step = self.mngr.latest_step()
+        verified = getattr(self.mngr, "verified_latest_step", None)
+        step = verified() if callable(verified) else self.mngr.latest_step()
         if step is None:
             return self.init_state(), 0
         target = self.restore_target() if self.restore_target else None
@@ -288,23 +371,72 @@ class ElasticTrainLoop:
         return state, step + 1
 
     def run(self, total_steps: int):
+        from paddle_tpu.utils.nan_inf import tree_nonfinite_count
+
         state, start = self._resume()
         step = start
+        clean = 0      # consecutive completed steps since last recovery
+        streak = 0     # consecutive non-finite steps
         while step < total_steps:
             try:
-                state = self.train_step(state, step)
+                # raising fault kinds crash here exactly like a real step
+                # failure; kind='nan_grads' poisons the step's outputs so
+                # the non-finite policy (or a downstream guard) reacts
+                fault = _faults.maybe_fire("train.step", index=step)
+                new_state = self.train_step(state, step)
+                if fault is not None and fault.kind == "nan_grads":
+                    new_state = _nan_poison(new_state)
+                if self.nonfinite_policy is not None \
+                        and int(tree_nonfinite_count(new_state)):
+                    streak += 1
+                    self.nonfinite_skipped += 1
+                    record_event("nonfinite_step_skipped")
+                    logger.warning(
+                        "step %d produced non-finite values; skipping "
+                        "(%d consecutive, policy=%s)", step, streak,
+                        self.nonfinite_policy)
+                    if self.nonfinite_policy == "rewind" \
+                            and streak >= self.nonfinite_limit:
+                        record_event("nonfinite_rewind")
+                        # unify with the restart path below: rewind is a
+                        # restore-from-checkpoint charged to the budget
+                        raise FloatingPointError(
+                            f"{streak} consecutive non-finite steps "
+                            f"(limit {self.nonfinite_limit})")
+                    # a skipped step still honors the save cadence with
+                    # the RETAINED (valid) state — otherwise one NaN on a
+                    # boundary step stretches the progress-loss window to
+                    # 2x save_every
+                    if (step + 1) % self.save_every == 0 \
+                            or step + 1 == total_steps:
+                        self.mngr.save(step, state)
+                    clean = 0
+                    step += 1        # skip-step: old state, batch consumed
+                    continue
+                streak = 0
+                state = new_state
                 if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
                     self.mngr.save(step, state)
                 step += 1
+                clean += 1
+                if (self.restarts and self.restart_reset_steps
+                        and clean >= self.restart_reset_steps):
+                    logger.info("restart budget reset after %d clean steps",
+                                clean)
+                    record_event("restart_budget_reset")
+                    self.restarts = 0
             except KeyboardInterrupt:
                 raise
             except Exception as e:   # noqa: BLE001 — supervisor boundary
                 self.restarts += 1
+                record_event("train_restart")
                 logger.warning("train step %d failed (%s); restart %d/%d",
                                step, e, self.restarts, self.max_restarts)
                 if self.restarts > self.max_restarts:
                     raise
                 self.mngr.wait_until_finished()
                 state, step = self._resume()
+                clean = 0
+                streak = 0
         self.mngr.wait_until_finished()
         return state

@@ -118,9 +118,13 @@ def launch(script_args, nnodes=1, node_rank=0, master="127.0.0.1:49175",
                 # a restarted world needs every host present for rendezvous.
                 if not mgr.wait_for_world(timeout=elastic_world_timeout):
                     return code  # peer never came back; give up
+                from paddle_tpu.resilience import record_event
+                record_event("launcher_elastic_relaunch")
                 time.sleep(1.0)
                 continue
             restarts += 1
+            from paddle_tpu.resilience import record_event
+            record_event("launcher_restart")
             if restarts > max_restarts:
                 return code
             time.sleep(min(2 ** restarts, 30))

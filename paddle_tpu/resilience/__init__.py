@@ -1,0 +1,55 @@
+"""paddle_tpu.resilience — fault-tolerant training & serving.
+
+Three pillars (docs/RESILIENCE.md has the full story):
+
+* **Fault injection** (`faults.py`): a `FaultPlan` fires deterministic
+  faults (raise / NaN-poisoned grads / corrupted checkpoint files /
+  dropped heartbeats / simulated RESOURCE_EXHAUSTED) at named sites in
+  `ElasticTrainLoop`, `CheckpointManager`, `ElasticManager` and
+  `inference.generate`. Zero overhead disarmed — one global read.
+* **Checkpoint integrity** (`integrity.py`): per-tensor checksum
+  manifests + atomic commit markers with every `CheckpointManager.save`;
+  `verified_latest_step()` walks resume back past incomplete or corrupt
+  steps, so one torn save can't become a permanent crash loop.
+* **Graceful degradation & retry** (`retry.py`): the shared bounded
+  retry/backoff helper behind the coordination-service stores, plus the
+  error-class predicates the decode degradation ladder (halved KV chunk
+  → layered path) and per-request deadlines key off.
+
+Every recovery action — restart, skipped non-finite step, rewind,
+corrupt checkpoint skipped, retry, degraded decode, deadline cut, fault
+fired — lands on a ``resilience.*`` counter in the observability
+registry, so the existing JSONL/Prometheus exporters surface fleet
+health for free. `record_event` is the one helper behind those counters.
+"""
+
+import logging
+
+from paddle_tpu.resilience.faults import (   # noqa: F401
+    Fault, FaultPlan, SimulatedResourceExhausted,
+    arm, disarm, armed, maybe_fire, plan,
+)
+from paddle_tpu.resilience.retry import (    # noqa: F401
+    RetryPolicy, backoff_delays, call_with_retry, kv_op,
+    is_not_found, is_resource_exhausted, is_timeout, remaining_deadline,
+)
+from paddle_tpu.resilience import faults, integrity, retry  # noqa: F401
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+__all__ = [
+    "Fault", "FaultPlan", "SimulatedResourceExhausted",
+    "arm", "disarm", "armed", "maybe_fire", "plan",
+    "RetryPolicy", "backoff_delays", "call_with_retry", "kv_op",
+    "is_not_found", "is_resource_exhausted", "is_timeout",
+    "remaining_deadline", "faults", "integrity", "retry", "record_event",
+]
+
+
+def record_event(event: str, **labels):
+    """Increment ``resilience.<event>`` (+labels) in the default metrics
+    registry and log it — the one funnel for recovery-event telemetry."""
+    from paddle_tpu.observability import registry
+
+    registry().counter(f"resilience.{event}", **labels).inc()
+    logger.warning("resilience event: %s %s", event, labels or "")

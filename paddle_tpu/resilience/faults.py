@@ -1,0 +1,221 @@
+"""Deterministic fault injection — the testable half of the recovery story.
+
+Reference (SURVEY.md §5-failure): the reference's elastic tests kill
+worker processes and assert the manager relaunches; recovery paths that
+are never exercised rot. This module makes every failure mode the
+framework claims to survive *injectable on demand and deterministic*:
+a `FaultPlan` names WHERE (a site string), WHEN (the Nth call / step /
+request at that site) and WHAT (raise, NaN-poison, corrupt files, drop
+heartbeats, simulate RESOURCE_EXHAUSTED).
+
+Sites wired through the stack (each documents its index semantics):
+
+==========================  ================================================
+site                        fired from / index
+==========================  ================================================
+``train.step``              ``ElasticTrainLoop.run`` — index = step number
+``checkpoint.save``         ``CheckpointManager.save`` — index = step number
+``elastic.heartbeat``       ``ElasticManager.register`` — call counter
+``decode.dispatch``         ``inference.generate`` / ``StackedLlamaDecoder
+                            .generate`` — per-process dispatch-attempt
+                            counter (each degradation retry is a new call)
+``kv.op``                   ``collective._kv_put_get`` /
+                            ``CoordinationServiceStore`` — call counter
+==========================  ================================================
+
+Zero-overhead contract: with no plan armed, ``maybe_fire`` is ONE global
+read and an immediate return — nothing else in this module runs on the
+hot path. (Pinned by tests/test_resilience.py.)
+
+Kinds split in two families:
+
+* **raising** (``raise``, ``resource_exhausted``): ``maybe_fire`` raises
+  at the site — the caller's normal exception handling (restart loop,
+  degradation ladder) takes over, exactly as a real fault would.
+* **cooperative** (``nan_grads``, ``corrupt_checkpoint``,
+  ``drop_heartbeat``): ``maybe_fire`` RETURNS the fired `Fault`; the
+  hooked site applies the effect itself (poison the step outputs, damage
+  the files just committed, skip the store put).
+"""
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+__all__ = [
+    "Fault", "FaultPlan", "SimulatedResourceExhausted",
+    "arm", "disarm", "armed", "maybe_fire", "plan",
+]
+
+RAISING_KINDS = ("raise", "resource_exhausted")
+COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat")
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """Injected stand-in for XLA's RESOURCE_EXHAUSTED (device OOM).
+
+    The message carries the literal status-code string so the same
+    `retry.is_resource_exhausted` predicate matches both this and the
+    real `XlaRuntimeError` from a device allocator failure."""
+
+    def __init__(self, where: str = "decode.dispatch"):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected accelerator OOM at {where} "
+            "(paddle_tpu.resilience fault injection)")
+
+
+class Fault:
+    """One injectable fault.
+
+    site:  where it fires (see module table).
+    kind:  'raise' | 'resource_exhausted' | 'nan_grads' |
+           'corrupt_checkpoint' | 'drop_heartbeat'.
+    at:    first index (call number / step / request) it fires at.
+    count: how many consecutive indices it fires for (default 1) — AND
+           the total-fire budget: a fault fires at most `count` times
+           ever, so "kill at step 5" does not re-fire when the resumed
+           run replays step 5 (that would be a permanent crash loop,
+           the exact failure mode this subsystem tests its way out of).
+    exc:   for kind='raise', the exception instance to raise (default
+           RuntimeError("injected fault at <site>")).
+    payload: kind-specific knobs, e.g. mode='truncate'|'flip' for
+           corrupt_checkpoint.
+    """
+
+    __slots__ = ("site", "kind", "at", "count", "exc", "payload", "fired")
+
+    def __init__(self, site: str, kind: str = "raise", at: int = 0,
+                 count: int = 1, exc: Optional[BaseException] = None,
+                 **payload):
+        if kind not in RAISING_KINDS + COOPERATIVE_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; one of "
+                f"{RAISING_KINDS + COOPERATIVE_KINDS}")
+        self.site = site
+        self.kind = kind
+        self.at = int(at)
+        self.count = int(count)
+        self.exc = exc
+        self.payload = payload
+        self.fired = 0
+
+    def _matches(self, index: int) -> bool:
+        return self.at <= index < self.at + self.count
+
+    def refund(self):
+        """Return one fire to the budget — for a cooperative fault whose
+        site turned out to have nothing to apply it to (e.g. a
+        corrupt_checkpoint landing on a save_interval-skipped step)."""
+        if self.fired > 0:
+            self.fired -= 1
+
+    def __repr__(self):
+        return (f"Fault(site={self.site!r}, kind={self.kind!r}, "
+                f"at={self.at}, count={self.count}, fired={self.fired})")
+
+
+class FaultPlan:
+    """An armed set of `Fault`s with per-site call counters.
+
+    Call-counter indexing: sites that pass no explicit index (heartbeat,
+    decode dispatch, kv ops) are numbered by this plan's own per-site
+    counter, starting at 0 when the plan is armed — so "fire at call M"
+    is deterministic regardless of process history."""
+
+    def __init__(self, *faults: Fault):
+        self.faults: List[Fault] = list(faults)
+        self._calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, fault: Fault) -> "FaultPlan":
+        self.faults.append(fault)
+        return self
+
+    def fired(self) -> List[Fault]:
+        return [f for f in self.faults if f.fired]
+
+    def pending(self) -> List[Fault]:
+        return [f for f in self.faults if f.fired < f.count]
+
+    def _fire(self, site: str, index: Optional[int]) -> Optional[Fault]:
+        with self._lock:
+            if index is None:
+                index = self._calls.get(site, 0)
+                self._calls[site] = index + 1
+            hit = None
+            for f in self.faults:
+                if f.site == site and f.fired < f.count \
+                        and f._matches(index):
+                    f.fired += 1
+                    hit = f
+                    break
+        if hit is None:
+            return None
+        _count_fired(site, hit.kind)
+        logger.warning("fault injection: firing %r at index %d", hit, index)
+        if hit.kind == "raise":
+            raise hit.exc if hit.exc is not None else RuntimeError(
+                f"injected fault at {site} (index {index})")
+        if hit.kind == "resource_exhausted":
+            raise SimulatedResourceExhausted(site)
+        return hit
+
+
+def _count_fired(site: str, kind: str):
+    # lazy import: resilience must stay importable before observability
+    # (and this runs only when a fault actually fires — off the hot path)
+    from paddle_tpu.observability import registry
+    registry().counter("resilience.faults_fired", site=site, kind=kind).inc()
+
+
+_armed: Optional[FaultPlan] = None
+
+
+def arm(fault_plan: FaultPlan) -> FaultPlan:
+    """Make `fault_plan` the process-wide armed plan (replacing any)."""
+    global _armed
+    _armed = fault_plan
+    return fault_plan
+
+
+def disarm() -> Optional[FaultPlan]:
+    global _armed
+    p, _armed = _armed, None
+    return p
+
+
+def armed() -> Optional[FaultPlan]:
+    return _armed
+
+
+def maybe_fire(site: str, index: Optional[int] = None) -> Optional[Fault]:
+    """The per-site hook. With no plan armed this is one global read.
+
+    May RAISE (kinds 'raise' / 'resource_exhausted') or RETURN a fired
+    cooperative `Fault` for the caller to apply, else None."""
+    plan_ = _armed
+    if plan_ is None:
+        return None
+    return plan_._fire(site, index)
+
+
+class plan:
+    """``with faults.plan(Fault(...)) as p:`` — arm for the block,
+    restore the previously armed plan (if any) on exit."""
+
+    def __init__(self, *faults: Fault):
+        self.plan = FaultPlan(*faults)
+        self._prev: Tuple[Optional[FaultPlan]] = (None,)
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = (_armed,)
+        arm(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        global _armed
+        if _armed is self.plan:
+            _armed = self._prev[0]
+        return False

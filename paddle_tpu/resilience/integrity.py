@@ -1,0 +1,225 @@
+"""Checkpoint integrity: per-tensor checksum manifests + commit markers.
+
+Reference (SURVEY.md §5): the reference's recovery contract is
+restart-from-latest-checkpoint — which silently becomes a permanent
+crash loop the moment the *latest* checkpoint is truncated by the very
+crash that triggered the restart. The fix is the classic commit
+protocol: every completed save writes a MANIFEST (the commit marker)
+only after the data is durable, listing every file's size+crc32 and
+every tensor's checksum; resume walks BACK past any step whose
+manifest is missing (save never committed) or whose files no longer
+match (corruption after commit).
+
+Layout (docs/RESILIENCE.md): manifests live INSIDE the checkpoint root
+in a non-numeric subdir orbax's step scan ignores::
+
+    <ckpt_dir>/integrity/step_<N>.json        # atomic tmp+rename
+    {"schema": "paddle_tpu.ckpt_manifest/v1", "step": N, "ts": ...,
+     "files":   {"<relpath>": {"size": int, "crc32": int}, ...},
+     "tensors": {"<dotted.path>": {"shape": [...], "dtype": "float32",
+                                   "crc32": int}, ...}}
+
+Verification tiers: `verify_files` (fast — stat + crc every file under
+the step dir, no deserialization) is what `verified_latest_step` runs;
+`tensors` entries additionally let a deep check compare a RESTORED
+state against what was saved (CheckpointManager.verify_step(deep=True)).
+"""
+
+import json
+import logging
+import os
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+__all__ = [
+    "MANIFEST_SCHEMA", "MANIFEST_SUBDIR",
+    "tensor_checksums", "file_checksums", "manifest_path",
+    "write_manifest", "read_manifest", "verify_files", "verify_tensors",
+    "is_content_failure", "corrupt_checkpoint",
+]
+
+MANIFEST_SCHEMA = "paddle_tpu.ckpt_manifest/v1"
+MANIFEST_SUBDIR = "integrity"
+_CRC_CHUNK = 1 << 20
+
+# reason prefixes verify_files/verify_tensors use for DETERMINISTIC
+# content failures — data that provably differs from what the save
+# committed. Everything else (unreadable file, missing manifest, restore
+# error) may be transient, and destroying a checkpoint over a transient
+# error turns a recoverable blip into data loss. This tuple is the ONE
+# contract quarantine decisions key off; keep reason strings starting
+# with one of these when adding failure modes.
+_CONTENT_FAILURE_PREFIXES = (
+    "size mismatch", "crc mismatch", "missing file",
+    "tensor mismatch", "missing tensor", "unexpected tensors",
+)
+
+
+def is_content_failure(reason: str) -> bool:
+    """True when a verify_* reason denotes deterministic content damage
+    (safe to quarantine), not a possibly-transient read failure."""
+    return reason.startswith(_CONTENT_FAILURE_PREFIXES)
+
+
+def _crc_file(path: str) -> Tuple[int, int]:
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(_CRC_CHUNK)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            size += len(buf)
+    return crc & 0xFFFFFFFF, size
+
+
+def tensor_checksums(state, _prefix: str = "") -> Dict[str, dict]:
+    """{dotted.path: {shape, dtype, crc32}} over a pytree of arrays.
+
+    crc32 runs over the C-contiguous host bytes (np.asarray pulls device
+    arrays — on multi-GiB states prefer tensor_checksums=False on the
+    manager and rely on the file-level manifest)."""
+    import jax
+
+    out: Dict[str, dict] = {}
+    leaves = jax.tree_util.tree_leaves_with_path(state)
+    for path, leaf in leaves:
+        key = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        a = np.ascontiguousarray(np.asarray(leaf))
+        out[key] = {"shape": list(a.shape), "dtype": str(a.dtype),
+                    "crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF}
+    return out
+
+
+def file_checksums(step_dir: str) -> Dict[str, dict]:
+    """{relpath: {size, crc32}} for every regular file under `step_dir`."""
+    out: Dict[str, dict] = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in sorted(files):
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, step_dir)
+            crc, size = _crc_file(p)
+            out[rel] = {"size": size, "crc32": crc}
+    return out
+
+
+def manifest_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_SUBDIR, f"step_{int(step)}.json")
+
+
+def write_manifest(ckpt_dir: str, step: int, files: Dict[str, dict],
+                   tensors: Optional[Dict[str, dict]] = None,
+                   ts: Optional[float] = None) -> str:
+    """Atomically commit the manifest (tmp + rename): its existence IS
+    the step's commit marker, so it must never be observable half-written."""
+    import time
+
+    path = manifest_path(ckpt_dir, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {"schema": MANIFEST_SCHEMA, "step": int(step),
+           "ts": ts if ts is not None else time.time(),
+           "files": files, "tensors": tensors or {}}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(ckpt_dir: str, step: int) -> Optional[dict]:
+    try:
+        with open(manifest_path(ckpt_dir, step)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("schema") != MANIFEST_SCHEMA or doc.get("step") != int(step):
+        return None
+    return doc
+
+
+def verify_files(manifest: dict, step_dir: str) -> Tuple[bool, str]:
+    """Fast integrity check: every manifest file exists under `step_dir`
+    with matching size and crc32. Extra files (e.g. orbax-version debris)
+    are tolerated — the manifest pins what the save wrote, not what else
+    appeared."""
+    for rel, meta in manifest.get("files", {}).items():
+        p = os.path.join(step_dir, rel)
+        if not os.path.isfile(p):
+            return False, f"missing file {rel}"
+        try:
+            crc, size = _crc_file(p)
+        except OSError as e:
+            # NOT a content failure: may be a transient I/O error —
+            # is_content_failure stays False so quarantine never
+            # destroys the step over it
+            return False, f"unreadable file {rel}: {e}"
+        if size != meta["size"]:
+            return False, (f"size mismatch {rel}: {size} != "
+                           f"{meta['size']} (truncated?)")
+        if crc != meta["crc32"]:
+            return False, f"crc mismatch {rel}"
+    return True, "ok"
+
+
+def verify_tensors(manifest: dict, state) -> Tuple[bool, str]:
+    """Deep check: a RESTORED state's per-tensor checksums match what the
+    save recorded (end-to-end: serialize + disk + deserialize)."""
+    want = manifest.get("tensors") or {}
+    if not want:
+        return False, "manifest has no tensor checksums"
+    got = tensor_checksums(state)
+    for key, meta in want.items():
+        g = got.get(key)
+        if g is None:
+            return False, f"missing tensor {key}"
+        if g != meta:
+            return False, f"tensor mismatch {key}: {g} != {meta}"
+    extra = set(got) - set(want)
+    if extra:
+        return False, f"unexpected tensors {sorted(extra)[:4]}"
+    return True, "ok"
+
+
+def corrupt_checkpoint(step_dir: str, mode: str = "truncate",
+                       seed: int = 0) -> str:
+    """Deterministically damage a committed checkpoint (fault injection /
+    tests): pick the LARGEST regular file under `step_dir` (ties broken
+    by path — the tensor data file, not a tiny metadata json) and either
+    truncate it to half (`mode='truncate'` — the torn-write crash shape)
+    or flip 8 bytes mid-file (`mode='flip'` — silent bit rot). Returns
+    the damaged path."""
+    victims = []
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in files:
+            p = os.path.join(root, fn)
+            victims.append((-os.path.getsize(p), p))
+    if not victims:
+        raise FileNotFoundError(f"no files under {step_dir}")
+    victims.sort()
+    path = victims[0][1]
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 0))
+    elif mode == "flip":
+        rng = np.random.RandomState(seed)
+        off = max((size // 2) - 4, 0)
+        with open(path, "r+b") as f:
+            f.seek(off)
+            orig = f.read(min(8, size - off))
+            f.seek(off)
+            f.write(bytes(b ^ 0xFF for b in orig) if orig
+                    else rng.bytes(1))
+    else:
+        raise ValueError(f"unknown corrupt mode {mode!r}")
+    logger.warning("fault injection: corrupted checkpoint file %s (%s)",
+                   path, mode)
+    return path

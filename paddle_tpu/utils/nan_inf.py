@@ -2,14 +2,34 @@
 paddle/fluid/framework/details/nan_inf_utils_detail.cc).
 
 TPU-native: per-op scanning would break fusion; instead scan the step's
-OUTPUT pytrees (loss/grads/params) — one fused reduction per tensor — plus
-jax's debug_nans for eager pinpointing.
+OUTPUT pytrees (loss/grads/params) — ONE jitted fused reduction over the
+whole tree (a single device program, one scalar host sync) — plus jax's
+debug_nans for eager pinpointing. Detections log through `logging` and
+bump the ``numerics.nonfinite_detected`` registry counter, so fleet-wide
+NaN storms show up in the JSONL/Prometheus exporters; this is also the
+primitive behind `ElasticTrainLoop`'s non-finite skip/rewind policy
+(paddle_tpu.resilience).
 """
+
+import logging
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.flags import flag
+
+logger = logging.getLogger("paddle_tpu.nan_inf")
+
+
+@jax.jit
+def _fused_nonfinite_count(leaves):
+    # one compiled program for the WHOLE tree: per-leaf reductions fuse
+    # into a single device dispatch, vs the old eager per-leaf jnp.sum +
+    # Python sum that issued (and synced) one tiny program per leaf
+    total = jnp.zeros((), jnp.int32)
+    for leaf in leaves:
+        total = total + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+    return total
 
 
 def tree_nonfinite_count(tree):
@@ -17,7 +37,7 @@ def tree_nonfinite_count(tree):
               if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
     if not leaves:
         return jnp.zeros((), jnp.int32)
-    return sum(jnp.sum(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves)
+    return _fused_nonfinite_count(leaves)
 
 
 def check_numerics(tree, name="tensors", raise_error=True):
@@ -26,10 +46,12 @@ def check_numerics(tree, name="tensors", raise_error=True):
         return True
     n = int(tree_nonfinite_count(tree))
     if n:
+        from paddle_tpu.observability import registry
+        registry().counter("numerics.nonfinite_detected").inc()
         msg = f"[paddle_tpu] {n} non-finite values detected in {name}"
         if raise_error:
             raise FloatingPointError(msg)
-        print(msg)
+        logger.warning(msg)
         return False
     return True
 

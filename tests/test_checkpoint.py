@@ -75,8 +75,10 @@ def test_elastic_loop_resumes_after_crash(tmp_path):
                             save_every=2)
     final = loop.run(total_steps=8)
     # crash at step 5 → resume from ckpt of step 4 (saved at (4+1)%2? steps
-    # 1,3,5… save_every=2 saves after steps 1,3,5,7) → no lost progress
-    assert loop.restarts == 1
+    # 1,3,5… save_every=2 saves after steps 1,3,5,7) → no lost progress.
+    # The restart budget then RESETS after save_every clean post-restart
+    # steps (resilience satellite), so by run end it reads 0 again.
+    assert crashed["done"] and loop.restarts == 0
     assert float(final["step_sum"]) == sum(range(8))
     m.close()
 

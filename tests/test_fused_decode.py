@@ -284,8 +284,12 @@ class TestInterpretKernelParity:
         set_flags({"FLAGS_pallas_interpret": False,
                    "FLAGS_pallas_strict": False})
 
-    @pytest.mark.parametrize("nkv", [2, 4])  # GQA (batched per-group
-    def test_llama_generate_token_exact(self, nkv):  # o-proj) and MHA
+    # nkv=2 (dkv=64) is below the kernel's 128-lane gate and rides the
+    # jnp reference — sibling-covered by test_generate_fused_matches_
+    # unfused, so it runs tier-2; nkv=4 is the real interpret kernel
+    @pytest.mark.parametrize(
+        "nkv", [pytest.param(2, marks=pytest.mark.slow), 4])
+    def test_llama_generate_token_exact(self, nkv):  # GQA and MHA o-proj
         cfg, m = tiny_model(nkv)                     # (sum-trick o-proj)
         rng = np.random.RandomState(1)
         prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 9)))

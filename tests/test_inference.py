@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu
 from paddle_tpu.inference import Predictor, generate
@@ -49,7 +50,8 @@ def test_generate_greedy_deterministic():
                                   np.asarray(prompt))
 
 
-def test_generate_greedy_matches_no_cache_argmax():
+@pytest.mark.slow  # siblings: test_cached_decode_matches_full_forward +
+def test_generate_greedy_matches_no_cache_argmax():  # greedy_deterministic
     cfg, model = _model()
     prompt = jnp.asarray([[5, 6, 7]])
     out = generate(model, prompt, max_new_tokens=3, temperature=0.0,
@@ -157,7 +159,7 @@ def test_mixtral_fused_plan_matches_layered():
 
     prompt = jnp.asarray(np.random.RandomState(2).randint(0, 256, (2, 5)))
     out_fused = generate(m, prompt, max_new_tokens=8, temperature=0.0)
-    assert (2, 5, 8, 0.0, 0, 1.0, -1, "bfloat16", False, True) \
+    assert (2, 5, 8, 0.0, 0, 1.0, -1, "bfloat16", False, True, 0) \
         in m._generate_jit_cache   # plan really active
     paddle_tpu.set_flags({"FLAGS_fused_decode": False})
     try:

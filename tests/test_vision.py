@@ -12,6 +12,7 @@ from paddle_tpu.vision import transforms
 from paddle_tpu.vision.models import resnet18, resnet50
 
 
+@pytest.mark.slow  # sibling: test_resnet18_train_step_decreases_loss
 def test_resnet18_forward_and_bn_buffers():
     paddle_tpu.seed(0)
     model = resnet18(num_classes=10)

@@ -166,6 +166,7 @@ def test_small_vision_models_forward(ctor, shape):
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # construct-only smoke; vision forwards covered in tier-2
 def test_vgg_constructs():
     m = vision.vgg11(num_classes=5)
     n = sum(int(np.prod(p.shape)) for p in
