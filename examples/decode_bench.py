@@ -150,6 +150,13 @@ def main():
                     "per-phase %%-of-roofline table")
     ap.add_argument("--reps", type=int, default=3,
                     help="wall-timing repetitions (CI smoke uses 1)")
+    ap.add_argument("--eos", type=int, default=None,
+                    help="eos token id: adds a static-path pad-waste "
+                    "accounting pass — generate(return_lengths=True) "
+                    "reports per-row generated length, and every decode "
+                    "step past a row's eos is waste the continuous-"
+                    "batching engine (examples/serving_bench.py) "
+                    "reclaims")
     ap.add_argument("--device_time", action="store_true",
                     help="force the xplane device-clock pass off-TPU "
                     "(on TPU it always runs; the CPU backend yields no "
@@ -271,10 +278,19 @@ def main():
     if d_short is not None and d_long is not None:
         dt = d_long - d_short
         timing = "device(xplane)"
+        n_eff = ns.new_tokens - n_short
     else:
         dt = min(t_long) - min(t_short)
         timing = "wall(min-of-reps)"
-    n_eff = ns.new_tokens - n_short
+        n_eff = ns.new_tokens - n_short
+        if dt <= 0:
+            # a loaded host can make the short run's best wall exceed
+            # the long run's (seen at --reps 1 in the CI smoke): the
+            # marginal is pure noise — report the absolute long-run
+            # rate instead of a negative throughput
+            dt = min(t_long)
+            n_eff = ns.new_tokens
+            timing = "wall(absolute)"
 
     tok_s = ns.batch * n_eff / dt
     per_seq = n_eff / dt
@@ -364,6 +380,24 @@ def main():
         obs.validate_spans(spans, require_request=True)
         tracer.export_jsonl("/tmp/decode_bench_spans.jsonl")
 
+    pad_waste = None
+    if ns.eos is not None:
+        if stacked:
+            print("note: --eos pad-waste accounting needs "
+                  "generate(return_lengths=True); the stacked engine "
+                  "reports ids only — skipped", file=sys.stderr)
+        else:
+            # static-batch pad waste: every row decodes the full
+            # new_tokens budget; tokens after a row's eos are pure
+            # padding (the scheduling gap serving_bench's continuous
+            # engine closes — its A/B record quotes this number)
+            _, lens = generate(model, prompt, max_new_tokens=ns.new_tokens,
+                               temperature=0.0, state=state,
+                               cache_dtype=cache_dtype, eos_token_id=ns.eos,
+                               return_lengths=True)
+            useful = int(np.minimum(lens + 1, ns.new_tokens).sum())
+            pad_waste = round(1 - useful / (ns.batch * ns.new_tokens), 3)
+
     tag = (" int8" if ns.int8 else "") + (" kv8" if ns.cache_int8 else "")
     rec = obs.bench_record(
         f"{name}{tag} decode tokens/s (batch={ns.batch})",
@@ -377,6 +411,7 @@ def main():
         new_tokens=ns.new_tokens,
         step_time_ms=round(1000 * dt / n_eff, 3),
         timing=timing,
+        **({"pad_waste_frac": pad_waste} if pad_waste is not None else {}),
         roofline_plan=roofline_plan,
         memory=obs.memory.memory_snapshot(),
         **({"request_span": next(

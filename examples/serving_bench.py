@@ -1,0 +1,321 @@
+"""Serving throughput A/B: continuous batching vs static `generate`.
+
+After PR 1/2 drove the per-step fused decode kernel toward roofline, the
+remaining serving throughput loss is SCHEDULING waste: a static batch
+pads every slot to the longest member's budget (a finished request burns
+decode steps emitting padding) and a late arrival waits for the whole
+batch to drain. This bench runs the SAME synthetic workload — Poisson
+arrivals, mixed prompt lengths, mixed token budgets, an optional shared
+system prefix — through both paths:
+
+* **static** — requests grouped into fixed batches of ``--slots`` in
+  arrival order; each batch is one ``inference.generate`` call padded to
+  the batch max prompt/budget (the pre-serving deployment model). Useful
+  tokens are each request's own budget; everything past it is pad waste
+  (``generate(return_lengths=True)`` is the per-row accounting).
+* **continuous** — one ``serving.ServingEngine`` with ``--slots`` decode
+  slots over the paged KV pool: requests join mid-flight as arrivals
+  land (virtual clock: arrival times are measured in decode steps),
+  retire at budget at slot granularity, and block-aligned shared
+  prefixes ride the content-hashed prefix cache.
+
+Both sides emit one ``paddle_tpu.bench/v1`` JSON line (static first);
+the continuous record carries the headline ``speedup_vs_static`` plus
+the occupancy / pad-waste / prefix-hit / queue-depth gauges the engine
+exports through the observability registry. Run:
+
+    python examples/serving_bench.py [--requests 24] [--slots 8]
+        [--sys_prompt_len 32] [--seed 0]
+
+CPU-sized by default (llama-medium, the jnp reference decode path — the
+same program the interpret-mode parity twins in tests/test_serving.py
+pin against the Pallas kernel; --model llama-tiny for smoke runs); on
+TPU the default is llama-345m.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_model(name):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if name == "llama-tiny":
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=4,
+                          intermediate_size=256,
+                          max_position_embeddings=512)
+    elif name == "llama-small":
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=512, num_layers=4,
+                          num_heads=8, num_kv_heads=8,
+                          intermediate_size=1024,
+                          max_position_embeddings=512)
+    elif name == "llama-medium":
+        # the CPU A/B size: big enough that per-step model compute (not
+        # per-dispatch overhead, which a static `generate`'s lax.scan
+        # amortizes but a per-token serving dispatch pays in full) sets
+        # the step time — the regime where the scheduling win
+        # (occupancy) decides the headline, as it does on TPU
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=640, num_layers=6,
+                          num_heads=10, num_kv_heads=10,
+                          intermediate_size=1664,
+                          max_position_embeddings=512)
+    elif name == "llama-345m":
+        cfg = LlamaConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                          num_heads=16, num_kv_heads=16,
+                          intermediate_size=2816,
+                          max_position_embeddings=2048)
+    else:
+        raise SystemExit(f"unknown model {name}")
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return cfg, m
+
+
+def make_workload(ns, rng):
+    """N requests: Poisson arrivals (exp gaps, in decode-step units),
+    mixed prompt lengths and LONG-TAILED token budgets, optional shared
+    system prefix.
+
+    Budgets are bimodal — a ``1 - long_frac`` majority of short
+    chat-style replies (uniform ``[min_new, max_new/4]``) and a
+    ``long_frac`` tail of long generations (uniform
+    ``[max_new/2, max_new]``). That tail is the serving regime the Orca
+    lineage targets: one long request in a static batch pads EVERY
+    sibling to its budget, while the continuous engine retires the short
+    ones at slot granularity and back-fills from the queue."""
+    sys_prefix = rng.randint(3, ns.vocab, (ns.sys_prompt_len,))
+    reqs = []
+    t = 0.0
+    short_hi = max(ns.min_new + 1, ns.max_new // 4)
+    long_lo = max(ns.min_new, ns.max_new // 2)
+    mean_budget = ((1 - ns.long_frac) * (ns.min_new + short_hi) / 2
+                   + ns.long_frac * (long_lo + ns.max_new) / 2)
+    # offered load a multiple of slot capacity: the queue stays busy
+    # (saturation), which is the regime where occupancy is the honest
+    # headline
+    rate = ns.load * ns.slots / mean_budget      # requests per step
+    for i in range(ns.requests):
+        t += rng.exponential(1.0 / rate)
+        plen = rng.randint(ns.min_prompt, ns.max_prompt + 1)
+        prompt = np.concatenate(
+            [sys_prefix, rng.randint(3, ns.vocab, (plen,))])
+        if rng.random_sample() < ns.long_frac:
+            budget = int(rng.randint(long_lo, ns.max_new + 1))
+        else:
+            budget = int(rng.randint(ns.min_new, short_hi + 1))
+        reqs.append(dict(arrival_step=t, prompt=prompt, budget=budget))
+    return reqs
+
+
+# ---------------------------------------------------------------- static A/B
+
+def run_static(model, state, reqs, slots, cache_dtype=jnp.bfloat16):
+    """Arrival-order batches of ``slots`` through one padded `generate`
+    each (same KV-cache dtype as the engine side — a fair A/B). Returns
+    (wall_s, useful_tokens, emitted_slot_tokens)."""
+    from paddle_tpu.inference import generate
+
+    wall = 0.0
+    useful = emitted = 0
+    for k in range(0, len(reqs), slots):
+        batch = reqs[k:k + slots]
+        pmax = max(len(r["prompt"]) for r in batch)
+        nmax = max(r["budget"] for r in batch)
+        ids = np.ones((len(batch), pmax), np.int32)   # right-pad token 1
+        for i, r in enumerate(batch):
+            ids[i, :len(r["prompt"])] = r["prompt"]
+        ids = jnp.asarray(ids)
+        t0 = time.perf_counter()
+        out, lens = generate(model, ids, max_new_tokens=nmax,
+                             temperature=0.0, state=state,
+                             cache_dtype=cache_dtype,
+                             return_lengths=True)
+        int(out[:, -1].sum())                         # sync
+        wall += time.perf_counter() - t0
+        # every row decodes nmax steps; a request is only USEFUL up to
+        # its own budget — the rest is the pad waste static batching
+        # cannot avoid (lens reports eos cuts when an eos id is set)
+        useful += sum(min(r["budget"], int(n)) for r, n in zip(batch, lens))
+        emitted += len(batch) * nmax
+    return wall, useful, emitted
+
+
+# ------------------------------------------------------------ continuous A/B
+
+def run_continuous(model, reqs, ns):
+    """Drive a ServingEngine: virtual clock in decode steps — request i
+    joins the queue once ``arrival_step`` steps have run. Returns
+    (wall_s, engine)."""
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(
+        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+        max_seq_len=ns.max_seq_len,
+        cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16)
+    return drive(eng, reqs), eng
+
+
+def drive(eng, reqs):
+    from paddle_tpu import serving
+
+    pending = sorted(reqs, key=lambda r: r["arrival_step"])
+    i = 0
+    vstep = 0
+    t0 = time.perf_counter()
+    while i < len(pending) or not eng.idle:
+        while i < len(pending) and pending[i]["arrival_step"] <= vstep:
+            r = pending[i]
+            eng.submit(serving.Request(r["prompt"],
+                                       max_new_tokens=r["budget"]))
+            i += 1
+        eng.step()
+        vstep += 1
+    return time.perf_counter() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block_tokens", type=int, default=32,
+                    help="pool block size; 32 keeps the default shared "
+                    "32-token system prefix exactly one full "
+                    "(shareable) block and halves the block-table "
+                    "dirty-upload rate vs 16")
+    ap.add_argument("--max_seq_len", type=int, default=None)
+    ap.add_argument("--min_prompt", type=int, default=8)
+    ap.add_argument("--max_prompt", type=int, default=48)
+    ap.add_argument("--min_new", type=int, default=4)
+    ap.add_argument("--max_new", type=int, default=128,
+                    help="budget ceiling; the default 128 vs min_new=4 "
+                    "gives the wide generation-length spread of real "
+                    "chat traffic (short replies + a long tail) — the "
+                    "regime static batching pads worst")
+    ap.add_argument("--sys_prompt_len", type=int, default=32,
+                    help="shared system prefix (0 disables): block-"
+                    "aligned full blocks are content-hash shared, so "
+                    "every request after the first skips that prefill")
+    ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--load", type=float, default=3.0,
+                    help="offered load as a multiple of slot capacity")
+    ap.add_argument("--long_frac", type=float, default=0.25,
+                    help="fraction of long-generation requests (budget "
+                    "uniform in [max_new/2, max_new]; the rest draw "
+                    "short chat budgets in [min_new, max_new/4])")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved static/continuous pass pairs "
+                    "(best wall per side kept)")
+    ap.add_argument("--seed", type=int, default=0)
+    ns = ap.parse_args()
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # CPU default is llama-small: big enough that per-step compute (not
+    # host dispatch) dominates — the regime where the A/B measures
+    # scheduling, which is what the engine changes. llama-tiny stays
+    # available for fast smoke runs (the CI schema test uses it).
+    name = ns.model or ("llama-345m" if on_tpu else "llama-medium")
+    if ns.requests is None:
+        # enough requests that the ramp/drain edge effects (slots
+        # filling at t=0, the batch thinning as the last arrivals
+        # finish) stop dominating occupancy — real traffic has no drain
+        ns.requests = 96
+
+    cfg, model = build_model(name)
+    ns.vocab = cfg.vocab_size
+    if ns.max_seq_len is None:
+        need = ns.sys_prompt_len + ns.max_prompt + ns.max_new
+        ns.max_seq_len = -(-need // ns.block_tokens) * ns.block_tokens
+    state = model.trainable_state()
+
+    rng = np.random.RandomState(ns.seed)
+    reqs = make_workload(ns, rng)
+    n_useful = sum(r["budget"] for r in reqs)
+
+    # ---- warmups: static compiles the per-batch-shape programs; the
+    # engine gets two passes (pass 1 compiles the cold-prefix prefill
+    # variants, pass 2 the warm-prefix ones)
+    cdt = jnp.int8 if ns.cache_int8 else jnp.bfloat16
+    run_static(model, state, reqs, ns.slots, cdt)
+    _, eng = run_continuous(model, reqs, ns)
+    drive(eng, reqs)
+
+    # ---- measurement: INTERLEAVED static/continuous pairs, best-of-reps
+    # wall per side. The container's CPU budget swings by 2x over tens of
+    # seconds; running all static passes then all continuous passes would
+    # hand whichever side lands in the fast window a phantom speedup,
+    # while adjacent interleaved passes see (and best-of filters) the
+    # same contention.
+    wall_s = wall_c = float("inf")
+    for _ in range(ns.reps):
+        w, useful_s, emitted_s = run_static(model, state, reqs,
+                                            ns.slots, cdt)
+        wall_s = min(wall_s, w)
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+        eng.reset_stats()
+        # drop warmup/prior-rep results: ttft_p50 must cover ONE
+        # measured pass, not compile-stall warmup TTFTs
+        eng.results.clear()
+        wall_c = min(wall_c, drive(eng, reqs))
+    static_tok_s = useful_s / wall_s
+    static_occ = useful_s / emitted_s
+    st = eng.stats
+    # each request's FIRST token is sampled by its prefill program, not
+    # a decode step; drive() runs to idle so requests_finished counts
+    # exactly one prefill sample per request — omitting them would bias
+    # the A/B low (the static side's useful counts full budgets)
+    cont_tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall_c
+    cont_occ = st["decode_tokens"] / max(
+        st["decode_tokens"] + st["idle_slot_steps"], 1)
+    prefix_hit = (eng.prefix_cache.hit_rate
+                  if eng.prefix_cache is not None else 0.0)
+    ttfts = sorted(r.ttft_s for r in eng.results.values())
+    ttft_p50 = ttfts[len(ttfts) // 2]
+
+    from paddle_tpu import observability as obs
+    common = dict(device=dev.device_kind, batch=ns.slots,
+                  n_requests=ns.requests,
+                  prompt_len=ns.sys_prompt_len + ns.max_prompt,
+                  new_tokens=ns.max_new, useful_tokens=n_useful,
+                  workload=dict(min_prompt=ns.min_prompt,
+                                max_prompt=ns.max_prompt,
+                                min_new=ns.min_new, max_new=ns.max_new,
+                                sys_prompt_len=ns.sys_prompt_len,
+                                arrivals=f"poisson({ns.load:g}x-capacity)",
+                                seed=ns.seed))
+    tag = " kv8" if ns.cache_int8 else ""
+    print(json.dumps(obs.bench_record(
+        f"{name}{tag} static batch tokens/s (b={ns.slots})",
+        round(static_tok_s, 1), "tokens/s", mode="static",
+        occupancy=round(static_occ, 3),
+        pad_waste_frac=round(1 - static_occ, 3),
+        emitted_slot_tokens=emitted_s, **common)))
+    print(json.dumps(obs.bench_record(
+        f"{name}{tag} continuous serving tokens/s (slots={ns.slots})",
+        round(cont_tok_s, 1), "tokens/s", mode="continuous",
+        speedup_vs_static=round(cont_tok_s / static_tok_s, 3),
+        occupancy=round(cont_occ, 3),
+        prefix_hit_rate=round(prefix_hit, 3),
+        prefill_tokens=st["prefill_tokens"],
+        prefill_tokens_reused=st["prefill_tokens_reused"],
+        ttft_p50_s=round(ttft_p50, 4),
+        pool_blocks=eng.pool.num_blocks - 1,
+        block_tokens=ns.block_tokens, **common)))
+
+
+if __name__ == "__main__":
+    main()

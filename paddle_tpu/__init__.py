@@ -177,5 +177,6 @@ from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import resilience  # noqa: F401
+from paddle_tpu import serving  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
 from paddle_tpu.parallel.data_parallel import DataParallel  # noqa: F401

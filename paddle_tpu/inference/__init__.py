@@ -48,30 +48,80 @@ def _greedy_argmax(logits):
     return blk * 128 + lane
 
 
-def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
-    """logits (b, vocab) → token ids (b,). Greedy when temperature == 0."""
-    if temperature == 0.0:
-        return _greedy_argmax(logits)
-    logits = logits.astype(jnp.float32) / temperature
+def _filter_logits(logits, top_k=0, top_p=1.0):
+    """Apply top-k / nucleus (top-p) filtering to (b, vocab) fp32 logits.
+
+    The top-p cutoff is RANK-based: the kept set is exactly the smallest
+    prefix of the (stable) descending sort whose cumulative probability
+    reaches top_p. A value-based cutoff (`logits < cutoff`) would retain
+    every logit EQUAL to the boundary value, overshooting the nucleus
+    whenever duplicates straddle it (pinned by tests/test_serving.py)."""
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        order = jnp.argsort(-logits, axis=-1)        # stable: ties keep
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest set with cumulative prob >= top_p
-        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        # keep rank i iff the mass BEFORE it is < top_p — the smallest
+        # prefix with cum >= top_p; rank 0 is kept unconditionally (its
+        # prior mass is 0, but `0.0 < 0.0` is False at top_p == 0.0 and
+        # an all-masked row would sample token id 0); scatter the rank
+        # mask back to vocab order
+        keep_sorted = ((cum - probs) < top_p).at[..., 0].set(True)
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
+def _sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """logits (b, vocab) → token ids (b,). Greedy when temperature == 0.
+
+    ``key`` is either one PRNG key — a shared gumbel stream over the
+    batch — or a (b, 2) batch of per-ROW keys (the per-request streams
+    `generate` builds from ``request_seeds``), sampled row-by-row so a
+    request's tokens don't depend on its batch neighbours."""
+    if temperature == 0.0:
+        return _greedy_argmax(logits)
+    logits = _filter_logits(logits.astype(jnp.float32) / temperature,
+                            top_k, top_p)
+    if key.ndim > 1:                 # per-request streams
+        return jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(key, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def _row_keys(seeds):
+    """(b,) request seeds → (b, 2) per-row base PRNG keys."""
+    return jax.vmap(jax.random.PRNGKey)(seeds)
+
+
+def _fold_rows(keys, t):
+    """Fold token index t into each row's base key: the key that samples
+    token t of every request, whatever batch it currently rides in."""
+    return jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, t)
+
+
+def _request_seeds(request_seeds, seed, b):
+    """(b,) uint32 per-request seeds — explicit streams, or the default
+    ``seed + row`` convention. ONE definition: `generate`, the stacked
+    decoder and the serving engine must agree on the default or the
+    engine-vs-isolated sampling parity contract silently breaks."""
+    s = (jnp.asarray(request_seeds, jnp.uint32)
+         if request_seeds is not None
+         else jnp.uint32(seed) + jnp.arange(b, dtype=jnp.uint32))
+    assert s.shape == (b,), f"request_seeds must be ({b},), got {s.shape}"
+    return s
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
              top_p=1.0, eos_token_id: Optional[int] = None, seed: int = 0,
              state: Optional[Dict] = None, cache_dtype=jnp.bfloat16,
-             deadline_s: Optional[float] = None, _kv_chunk: int = 0,
-             _force_layered: bool = False):
+             deadline_s: Optional[float] = None,
+             request_seeds=None, return_lengths: bool = False,
+             _kv_chunk: int = 0, _force_layered: bool = False):
     """Autoregressive generation with a preallocated KV cache.
 
     model must expose forward(ids, cache=..., start_pos=...) and
@@ -109,6 +159,16 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     With no fault plan armed and no deadline, the request takes the
     exact code path it always did — bit-identical tokens, no added
     dispatches (pinned by tests/test_resilience.py).
+
+    Sampling uses PER-REQUEST RNG streams: row r draws token t from
+    ``fold_in(PRNGKey(request_seeds[r]), t)`` (default seeds
+    ``seed + r``), so a request's sampled tokens are invariant to its
+    batch composition — the property the continuous-batching engine
+    (paddle_tpu.serving) needs for join/leave parity with isolated
+    calls. ``return_lengths=True`` additionally returns the per-row
+    generated length (tokens before the first eos) as an int32 numpy
+    array — slot-free accounting for serving, pad-waste accounting for
+    decode_bench — as ``(ids, lengths)``.
     """
     from paddle_tpu.core.flags import flag
 
@@ -180,7 +240,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             cos_tab, sin_tab = rope_ops.rope_cos_sin(
                 total, plan["head_dim"], base=plan["rope_base"])
 
-            def _prefill_impl(state, cache, ids, key):
+            def _prefill_impl(state, cache, ids, seeds):
                 # rebuild the plan from the traced state so the stacked
                 # weights flow from the `state` argument (not constants)
                 plan_t = model.fused_decode_plan(state)
@@ -199,12 +259,12 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                             kv, plan_t["num_kv_heads"])
                 else:
                     kv_scales = None
-                key, k0 = jax.random.split(key)
+                keys = _row_keys(seeds)
                 with jax.named_scope("decode.sample"):
-                    tok = _sample_logits(out[:, -1, :], k0, temperature,
-                                         top_k, top_p)
+                    tok = _sample_logits(out[:, -1, :], _fold_rows(keys, 0),
+                                         temperature, top_k, top_p)
                 finished = jnp.zeros((b,), bool)
-                return (tok, kv, key, finished), kv_scales
+                return (tok, kv, keys, finished), kv_scales
 
             def _decode_impl(state, carry, kv_scales, i0, nsteps):
                 plan_t = model.fused_decode_plan(state)
@@ -213,9 +273,9 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                     blocks = dict(blocks, cache_wbytes=1)
 
                 def step(carry, i):
-                    tok, kv, key, finished = carry
+                    tok, kv, keys, finished = carry
                     finished = finished | (tok == eos)
-                    key, ki = jax.random.split(key)
+                    ki = _fold_rows(keys, i)
                     pos = prompt_len + i - 1
                     x = plan_t["embed"](tok, pos)
                     cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
@@ -233,26 +293,26 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                         nxt = _sample_logits(plan_t["head"](x), ki,
                                              temperature, top_k, top_p)
                     nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
-                    return (nxt, kv, key, finished), nxt
+                    return (nxt, kv, keys, finished), nxt
 
                 return lax.scan(step, carry, i0 + jnp.arange(nsteps))
         else:
-            def _prefill_impl(state, cache, ids, key):
+            def _prefill_impl(state, cache, ids, seeds):
                 with jax.named_scope("decode.prefill"):
                     out, cache = functional_call(model, state, ids,
                                                  cache=cache, start_pos=0)
-                key, k0 = jax.random.split(key)
+                keys = _row_keys(seeds)
                 with jax.named_scope("decode.sample"):
-                    tok = _sample_logits(out[:, -1, :], k0, temperature,
-                                         top_k, top_p)
+                    tok = _sample_logits(out[:, -1, :], _fold_rows(keys, 0),
+                                         temperature, top_k, top_p)
                 finished = jnp.zeros((b,), bool)
-                return (tok, cache, key, finished), None
+                return (tok, cache, keys, finished), None
 
             def _decode_impl(state, carry, _aux, i0, nsteps):
                 def step(carry, i):
-                    tok, cache, key, finished = carry
+                    tok, cache, keys, finished = carry
                     finished = finished | (tok == eos)
-                    key, ki = jax.random.split(key)
+                    ki = _fold_rows(keys, i)
                     out, cache = functional_call(
                         model, state, tok[:, None], cache=cache,
                         start_pos=prompt_len + i - 1)
@@ -260,13 +320,13 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                         nxt = _sample_logits(out[:, -1, :], ki, temperature,
                                              top_k, top_p)
                     nxt = jnp.where(finished, jnp.full_like(nxt, eos), nxt)
-                    return (nxt, cache, key, finished), nxt
+                    return (nxt, cache, keys, finished), nxt
 
                 return lax.scan(step, carry, i0 + jnp.arange(nsteps))
 
         if tracer is None:
-            def run_impl(state, cache, ids, key):
-                carry, aux = _prefill_impl(state, cache, ids, key)
+            def run_impl(state, cache, ids, seeds):
+                carry, aux = _prefill_impl(state, cache, ids, seeds)
                 tok = carry[0]
                 carry, toks = _decode_impl(state, carry, aux, 1,
                                            max_new_tokens - 1)
@@ -288,7 +348,9 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                         donate_argnums=(1,) if don else ()))
             jit_cache[jit_key + ("traced",)] = traced_fns
 
-    key0 = jax.random.PRNGKey(seed)
+    # per-request RNG streams: row r samples token t from
+    # fold_in(PRNGKey(seeds0[r]), t) — batch-composition-invariant
+    seeds0 = _request_seeds(request_seeds, seed, b)
     from paddle_tpu.resilience import faults as _faults
     from paddle_tpu.resilience import (is_resource_exhausted, record_event,
                                        remaining_deadline)
@@ -299,7 +361,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
         # injectable accelerator-OOM site (one global read when disarmed)
         _faults.maybe_fire("decode.dispatch")
         if tracer is None:
-            new_tokens = run(state, cache, input_ids, key0)
+            new_tokens = run(state, cache, input_ids, seeds0)
         else:
             # analytic cache accounting for the request span: total
             # allocated KV bytes at the cache dtype, and the avg bytes a
@@ -311,7 +373,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             pf, dc = traced_fns
             pieces = obs.run_traced_decode(
                 tracer,
-                lambda: pf(state, cache, input_ids, key0),
+                lambda: pf(state, cache, input_ids, seeds0),
                 lambda carry, aux, i0, c: dc(state, carry, aux, i0, c),
                 batch=b, max_new_tokens=max_new_tokens,
                 deadline_s=deadline_s,
@@ -330,7 +392,9 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
         retry_kw = dict(max_new_tokens=max_new_tokens,
                         temperature=temperature, top_k=top_k, top_p=top_p,
                         eos_token_id=eos_token_id, seed=seed, state=state,
-                        cache_dtype=cache_dtype, deadline_s=remaining)
+                        cache_dtype=cache_dtype, deadline_s=remaining,
+                        request_seeds=request_seeds,
+                        return_lengths=return_lengths)
         if plan is not None and _kv_chunk == 0:
             record_event("decode_degraded", stage="halved_chunk")
             logger.warning(
@@ -349,12 +413,22 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                             **retry_kw)
         raise
     if eos_token_id is not None:
-        # trim columns where every row is already past its eos
         arr = np.asarray(new_tokens)
-        done = np.cumsum(arr == eos_token_id, axis=1) > 1
+        # per-row generated length: tokens before the first eos
+        hit = arr == eos_token_id
+        gen_len = np.where(hit.any(axis=1), hit.argmax(axis=1),
+                           arr.shape[1]).astype(np.int32)
+        # trim columns where every row is already past its eos
+        done = np.cumsum(hit, axis=1) > 1
         keep = int((~done.all(axis=0)).sum())
         new_tokens = new_tokens[:, :max(keep, 1)]
-    return jnp.concatenate([input_ids, new_tokens], axis=1)
+    else:
+        # no host pull: keep the default path's async dispatch (shapes
+        # are static, so gen_len needs no device sync)
+        gen_len = np.full(new_tokens.shape[0], new_tokens.shape[1],
+                          np.int32)
+    out = jnp.concatenate([input_ids, new_tokens], axis=1)
+    return (out, gen_len) if return_lengths else out
 
 
 class Predictor:

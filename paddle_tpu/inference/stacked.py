@@ -247,7 +247,8 @@ class StackedLlamaDecoder:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
                  cache_dtype=jnp.bfloat16,
-                 deadline_s: Optional[float] = None, _kv_chunk: int = 0):
+                 deadline_s: Optional[float] = None, request_seeds=None,
+                 _kv_chunk: int = 0):
         """Prefill + fused-kernel decode, the whole loop one jitted scan.
         Returns (b, prompt+new) ids including the prompt.
 
@@ -263,9 +264,14 @@ class StackedLlamaDecoder:
         budget; accelerator OOM retries ONCE with a halved KV chunk
         (``resilience.decode_degraded{stage=halved_chunk}``) — this
         engine has no layered fallback (the stacked weights ARE the
-        fused layout), so a second OOM propagates."""
+        fused layout), so a second OOM propagates.
+
+        Sampling rides per-request RNG streams (see inference.generate):
+        row r draws token t from fold_in(PRNGKey(request_seeds[r]), t),
+        default seeds ``seed + r`` — batch-composition-invariant."""
         from paddle_tpu import observability as obs
-        from paddle_tpu.inference import _sample_logits
+        from paddle_tpu.inference import (_fold_rows, _request_seeds,
+                                          _row_keys, _sample_logits)
 
         input_ids = jnp.asarray(input_ids)
         b, prompt_len = input_ids.shape
@@ -276,7 +282,7 @@ class StackedLlamaDecoder:
             raise ValueError(
                 "StackedLlamaDecoder decodes against a bf16 or int8 KV "
                 f"cache; got cache_dtype={jnp.dtype(cache_dtype).name}")
-        key0 = jax.random.PRNGKey(seed)
+        seeds0 = _request_seeds(request_seeds, seed, b)
         tracer = obs.active_tracer()
         if tracer is None and deadline_s is not None:
             # deadline checks happen at chunk boundaries — ride the split
@@ -297,7 +303,7 @@ class StackedLlamaDecoder:
                     self._final_norm(x, norm_w), embed_w, head_arrays)
 
             def _prefill_impl(params, embed_w, norm_w, head_arrays, ids,
-                              key):
+                              seeds):
                 with jax.named_scope("decode.prefill"):
                     x, kv = self.prefill(
                         params, ids, total,
@@ -309,18 +315,18 @@ class StackedLlamaDecoder:
                                                              cfg.kv_heads)
                 else:
                     kv_scales = None
-                key, k0 = jax.random.split(key)
+                keys = _row_keys(seeds)
                 with jax.named_scope("decode.sample"):
                     tok = _sample_logits(
-                        logits(x, embed_w, norm_w, head_arrays), k0,
-                        temperature, top_k, top_p)
-                return (tok, kv, key), kv_scales
+                        logits(x, embed_w, norm_w, head_arrays),
+                        _fold_rows(keys, 0), temperature, top_k, top_p)
+                return (tok, kv, keys), kv_scales
 
             def _decode_impl(params, embed_w, norm_w, head_arrays, carry,
                              kv_scales, i0, nsteps):
                 def step(carry, i):
-                    tok, kv, key = carry
-                    key, ki = jax.random.split(key)
+                    tok, kv, keys = carry
+                    ki = _fold_rows(keys, i)
                     pos = prompt_len + i - 1
                     x = jnp.take(embed_w, tok, axis=0)
                     cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1, axis=0)
@@ -335,7 +341,7 @@ class StackedLlamaDecoder:
                         nxt = _sample_logits(
                             logits(x, embed_w, norm_w, head_arrays), ki,
                             temperature, top_k, top_p)
-                    return (nxt, kv, key), nxt
+                    return (nxt, kv, keys), nxt
 
                 return lax.scan(step, carry, i0 + jnp.arange(nsteps))
 
@@ -375,7 +381,7 @@ class StackedLlamaDecoder:
             _faults.maybe_fire("decode.dispatch")
             if tracer is None:
                 new = run(self.params, self.embed_w, self.norm_w,
-                          head_arrays, input_ids, key0)
+                          head_arrays, input_ids, seeds0)
             else:
                 dkv = cfg.kv_heads * cfg.head_dim
                 itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
@@ -386,7 +392,7 @@ class StackedLlamaDecoder:
                 pieces = obs.run_traced_decode(
                     tracer,
                     lambda: pf(self.params, self.embed_w, self.norm_w,
-                               head_arrays, input_ids, key0),
+                               head_arrays, input_ids, seeds0),
                     lambda carry, aux, i0, c: dc(
                         self.params, self.embed_w, self.norm_w, head_arrays,
                         carry, aux, i0, c),
@@ -415,7 +421,7 @@ class StackedLlamaDecoder:
                 input_ids, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=seed, cache_dtype=cache_dtype, deadline_s=remaining,
-                _kv_chunk=32)
+                request_seeds=request_seeds, _kv_chunk=32)
         return jnp.concatenate([input_ids, new], axis=1)
 
     def num_params(self):

@@ -90,6 +90,20 @@ class Tracer:
                 if len(self.spans) < self.max_spans:
                     self.spans.append(s)
 
+    def record(self, name: str, ts: float, dur_s: float,
+               parent: Optional[str] = None, **attrs) -> Span:
+        """Append an already-measured span (no open/close nesting) — for
+        producers whose spans interleave across many dispatches, like
+        the serving engine's per-request TTFT/TPOT spans: a request's
+        lifetime brackets other requests' steps, so a stack-scoped
+        context manager can't represent it."""
+        s = Span(name, ts, parent=parent, attrs=attrs)
+        s.dur_s = float(dur_s)
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(s)
+        return s
+
     def span_dicts(self) -> List[dict]:
         return [s.to_dict() for s in self.spans]
 

@@ -1606,3 +1606,633 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
             x, params, kv_cache, pos, cos, sin,
             num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
             arch=arch, top_k=top_k, kv_scales=kv_scales)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode (continuous-batching serving): block-table KV pool
+# ---------------------------------------------------------------------------
+#
+# The contiguous (L, b, S, 2*nkv*hd) cache above sizes every slot for
+# prompt+max_new — a request that finishes early strands its tail, and a
+# batch pads every slot to the longest member. The serving engine
+# (paddle_tpu.serving) instead carves the cache into fixed-size KV BLOCKS
+# shared by all slots (the vLLM paged-KV layout on the fused kernel):
+#
+#   kv_pool       (L, num_blocks, block_tokens, 2*nkv*hd)   HBM, aliased
+#   block_tables  (b, max_blocks) int32   slot-local chunk c -> physical
+#                                         block (layer-invariant: block n
+#                                         holds the same token span in
+#                                         every layer's pool plane)
+#   positions     (b,) int32              per-slot append position
+#
+# One block == one KV chunk of the kernel's online-softmax walk, so the
+# chunk copy indexes through the block table (the same SMEM-addressed DMA
+# technique the MoE kernel uses for routed expert weights) and slots of
+# wildly different lengths share one dispatch: per-row chunk counts only
+# mask (an all-masked online-softmax merge is an exact no-op).
+
+
+def paged_pool_shape(num_layers: int, num_blocks: int, block_tokens: int,
+                     num_kv_heads: int, head_dim: int):
+    """Shape of the paged KV pool (the serving engine's one cache tensor)."""
+    return (num_layers, num_blocks, block_tokens,
+            2 * num_kv_heads * head_dim)
+
+
+def fused_paged_decode_reference(x, params, kv_pool, block_tables, positions,
+                                 cos, sin, *, num_heads: int,
+                                 num_kv_heads: int, eps: float = 1e-5,
+                                 arch: str = "llama", kv_scales=None):
+    """One decode step against a paged KV pool; pure jnp twin.
+
+    x (b, h); kv_pool (L, NB, BT, 2*nkv*hd); block_tables (b, MB) int32;
+    positions (b,) int32 (each slot's append position — the number of
+    tokens already cached for that slot); cos/sin (b, hd) fp32 rope rows
+    gathered at each slot's position. Returns (x_out (b, h), kv_pool).
+
+    int8 pool mode: kv_scales (L, b, 2*nkv*hd) fp32 — per-SLOT scales
+    (serving calibrates each request from its own prefill, unlike the
+    batch-shared scales of `fused_decode_reference`).
+
+    The arithmetic is kept line-for-line with `fused_decode_reference`
+    (same einsums, same masking, same cast points) so a slot's step is
+    bit-identical to the same tokens decoding through a contiguous cache
+    — the continuous-batching parity contract (tests/test_serving.py).
+    Slots whose block-table tail is unallocated must point spare entries
+    at a valid (scratch) block: the copies are masked, not skipped.
+    """
+    L, NB, BT, dkv2 = kv_pool.shape
+    b, MB = block_tables.shape
+    S = MB * BT
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = dkv // nkv
+    rep = nh // nkv
+    dq = nh * hd
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+    int8 = "wqkv_s" in params
+    gpt = arch == "gpt"
+    if arch not in ("llama", "gpt"):
+        raise NotImplementedError(
+            f"paged decode supports arch llama/gpt, got {arch!r}")
+    cos_b = cos.reshape(b, 1, hd).astype(jnp.float32)
+    sin_b = sin.reshape(b, 1, hd).astype(jnp.float32)
+    rows = jnp.arange(b)
+    app_bid = jnp.take_along_axis(
+        block_tables, (positions // BT)[:, None], axis=1)[:, 0]   # (b,)
+    app_off = positions % BT
+    kv_news = []    # per-layer appended rows, written back in ONE scatter
+
+    def wdot(act, key, l):
+        w = params[key][l]
+        if int8:
+            y = jnp.dot(act, w.astype(act.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * params[f"{key}_s"][l]
+        return jnp.dot(act, w, preferred_element_type=jnp.float32)
+
+    xf = x.astype(jnp.float32)
+    for l in range(L):
+        if gpt:
+            xn = _layernorm(xf, params["ln1"][l], params["ln1_b"][l], eps)
+        else:
+            xn = _rms(xf, params["ln1"][l], eps)
+        qkv = wdot(xn, "wqkv", l)
+        if gpt:
+            qkv = qkv + params["bqkv"][l]
+        q = qkv[:, :dq].reshape(b, nh, hd)
+        k = qkv[:, dq:dq + nkv * hd].reshape(b, nkv, hd)
+        v = qkv[:, dq + nkv * hd:].reshape(b, nkv, hd)
+        if not gpt:
+            q = _rope1(q, cos_b, sin_b)
+            k = _rope1(k, cos_b, sin_b)
+        kv_new = jnp.concatenate(
+            [k.reshape(b, dkv), v.reshape(b, dkv)], axis=-1)
+        if kv_scales is not None:     # int8 pool: per-slot static scales
+            kv_new = jnp.clip(
+                jnp.round(kv_new.astype(jnp.float32) / kv_scales[l]),
+                -127, 127)
+        kv_new = kv_new.astype(kv_pool.dtype)
+        kv_news.append(kv_new)
+        # gather the slot's logical cache view [0, S) for attention
+        # (spare table entries gather a scratch block — masked below)
+        # and inject this step's append into the GATHERED view; the pool
+        # itself is written once after the layer walk. A per-layer
+        # `kv_pool.at[l, ...].set` costs a full pool copy per LAYER on
+        # backends without in-place scatter (jax-0.4 CPU ignores
+        # donation: measured 211 -> ~55 ms per b=8 step); the values the
+        # attention sees are identical either way, because each row's
+        # append block is private (copy-on-write invariant) and the
+        # injected entry is exactly what the scatter would have stored.
+        kvl = kv_pool[l][block_tables].reshape(b, S, dkv2)
+        kvl = kvl.at[rows, positions].set(kv_new)
+        kl = kvl[:, :, :dkv].astype(jnp.float32)
+        vl = kvl[:, :, dkv:].astype(jnp.float32)
+        if kv_scales is not None:     # dequantize with per-slot scales
+            kl = kl * kv_scales[l][:, None, :dkv]
+            vl = vl * kv_scales[l][:, None, dkv:]
+        kl = kl.reshape(b, S, nkv, hd)
+        vl = vl.reshape(b, S, nkv, hd)
+        qg = q.reshape(b, nkv, rep, hd) * scale
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg, kl)
+        valid = (jnp.arange(S)[None, None, None]
+                 <= positions[:, None, None, None])
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
+        attn = attn.reshape(b, dq).astype(dtype)
+        o = wdot(attn, "wo", l)
+        if gpt:
+            o = o + params["bo"][l]
+        xf = xf + o
+        if gpt:
+            xn2 = _layernorm(xf, params["ln2"][l], params["ln2_b"][l], eps)
+            g = wdot(xn2, "wg", l) + params["bg"][l]
+            act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            xf = xf + wdot(act, "wd", l) + params["bd"][l]
+        else:
+            xn2 = _rms(xf, params["ln2"][l], eps)
+            g = wdot(xn2, "wg", l)
+            u = wdot(xn2, "wu", l)
+            act = (jax.nn.silu(g) * u).astype(dtype)
+            xf = xf + wdot(act, "wd", l)
+    # ONE combined append for all layers (indices collide for no two
+    # rows: append blocks are never shared)
+    kv_pool = kv_pool.at[:, app_bid, app_off].set(jnp.stack(kv_news))
+    return xf.astype(dtype), kv_pool
+
+
+def _fused_paged_decode_pallas(x, params, kv_pool, block_tables, positions,
+                               *, num_heads: int, num_kv_heads: int,
+                               head_dim: int, rope_base: float = 10000.0,
+                               eps: float = 1e-5, arch: str = "llama",
+                               blocks: Optional[Dict] = None,
+                               kv_scales=None, interpret: bool = False):
+    """Paged-pool variant of `_fused_decode_pallas` (llama/gpt, no q-split).
+
+    Differences from the contiguous kernel:
+
+    * the KV cache is the (L, NB, BT, 2*nkv*hd) pool; every chunk copy /
+      RMW append resolves its physical block through the SMEM block table
+      (`bt_ref[r, c]` — the data-dependent DMA addressing the MoE kernel
+      pioneered for routed expert weights), so the copies are per-ROW
+      (b DMAs per chunk instead of 1) — serving batches are small and
+      decode is bandwidth-bound, so the extra descriptors are noise;
+    * `positions` is per-row: rope angles, the append RMW offset and the
+      online-softmax limits all broadcast (b, 1, 1) instead of scalar.
+      Rows past their own prefix mask every lane of a merge — an exact
+      no-op — so one dispatch serves slots of different lengths;
+    * int8 pool scales are per-SLOT ((L, b, 2*nkv*hd)).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, NB, BT, dkv2 = kv_pool.shape
+    b, MB = block_tables.shape
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = head_dim
+    assert hd == dkv // nkv
+    rep = nh // nkv
+    h = x.shape[1]
+    dq = nh * hd
+    dqkv = dq + 2 * dkv
+    ffn = params["wg"].shape[2]
+    int8 = "wqkv_s" in params
+    kvq = kv_scales is not None
+    assert kvq == (jnp.dtype(kv_pool.dtype) == jnp.int8), \
+        "int8 KV pool needs kv_scales (and vice versa)"
+    gpt = arch == "gpt"
+    wbytes = 1 if int8 else 2
+    cb = jnp.dtype(kv_pool.dtype).itemsize
+    ck = BT                 # one block == one KV chunk of the walk
+    assert BT % 8 == 0, f"block_tokens {BT} must be a multiple of 8"
+    assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
+    if blocks is not None:
+        assert blocks.get("cache_wbytes", cb) == cb, \
+            (f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+             f"cache but the pool dtype is {kv_pool.dtype} ({cb} B)")
+        if blocks.get("q_split", 1) != 1:
+            raise ValueError(
+                "paged decode does not support the q-split (big-model) "
+                "regime yet; build the plan with q_split=1")
+        J, fblk = blocks["ffn_blocks"], blocks["fblk"]
+        assert ffn == J * fblk, (ffn, blocks)
+    else:
+        J, fblk = _pick_ffn_blocks(
+            ffn, h, fixed_bytes=(dqkv + dq) * h * wbytes, wbytes=wbytes)
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    def kernel(*refs):
+        if gpt:
+            (pos_ref, bt_ref, posv_ref, x_in_ref, ln1_ref, wqkv_ref,
+             wo_ref, ln2_ref, wg_ref, wd_ref) = refs[:10]
+            wu_ref = None
+            i = 10
+            (ln1b_ref, ln2b_ref, bqkv_ref, bo_ref, bg_ref,
+             bd_ref) = refs[i:i + 6]
+            i += 6
+        else:
+            (pos_ref, bt_ref, posv_ref, x_in_ref, ln1_ref, wqkv_ref,
+             wo_ref, ln2_ref, wg_ref, wu_ref, wd_ref) = refs[:11]
+            i = 11
+        if int8:
+            sqkv_ref, so_ref, sg_ref, su_ref, sd_ref = refs[i:i + 5]
+            i += 5
+        if kvq:
+            kvs_ref = refs[i]          # (b, 2*dkv) per-SLOT cache scales
+            i += 1
+        kv_in = refs[i]                # aliased with kv_ref
+        x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
+        (x_s, xn_s, acc_s, q_s, kv32_s, kvblk_s, kvch_s,
+         wsem, rsem) = refs[i + 3:]
+        del kv_in
+
+        def wdot(act, wref, sref):
+            w = wref[...]
+            if int8:
+                y = jnp.dot(act, w.astype(act.dtype),
+                            preferred_element_type=jnp.float32)
+                return y if sref is None else y * sref[...]
+            return jnp.dot(act, w, preferred_element_type=jnp.float32)
+
+        li = pl.program_id(0)
+        j = pl.program_id(1)
+
+        # ---- per-row paged DMA descriptors (block table in SMEM) ----
+        def rmw_read(l, r):
+            p = pos_ref[r]
+            bid = bt_ref[r, p // BT]
+            return pltpu.make_async_copy(
+                kv_ref.at[l, bid, pl.ds((p % BT) // 8 * 8, 8)],
+                kvblk_s.at[r], wsem.at[r])
+
+        def rmw_write(l, r):
+            p = pos_ref[r]
+            bid = bt_ref[r, p // BT]
+            return pltpu.make_async_copy(
+                kvblk_s.at[r],
+                kv_ref.at[l, bid, pl.ds((p % BT) // 8 * 8, 8)],
+                wsem.at[r])
+
+        def chunk_copy(l, c, slot, r):
+            return pltpu.make_async_copy(
+                kv_ref.at[l, bt_ref[r, c]], kvch_s.at[slot, r],
+                rsem.at[slot, r])
+
+        # chunk walk bound: the LONGEST row's full-8-block prefix (rows
+        # past their own prefix contribute all-masked merges — exact
+        # no-ops, the price of one shared dispatch)
+        nc = (pos_ref[0] // 8 * 8 + ck - 1) // ck
+        for r in range(1, b):
+            nc = jnp.maximum(nc, (pos_ref[r] // 8 * 8 + ck - 1) // ck)
+
+        @pl.when(j == 0)
+        def attention_phase():
+            posv = posv_ref[...]                       # (b, 1) int32
+            blk_v = posv // 8 * 8
+            blk3 = blk_v.reshape(b, 1, 1)
+
+            @pl.when(li == 0)
+            def _():
+                x_s[...] = x_in_ref[...].astype(jnp.float32)
+                # one-time zero of the block-diagonal q staging (layers
+                # rewrite the same in-block lanes; off-block lanes stay 0)
+                q_s[...] = jnp.zeros_like(q_s)
+                for r in range(b):
+                    rmw_read(li, r).start()
+
+                @pl.when(nc > 0)
+                def _():
+                    for r in range(b):
+                        chunk_copy(li, 0, 0, r).start()
+
+            if gpt:
+                xn = _layernorm(x_s[...], ln1_ref[...].reshape(h),
+                                ln1b_ref[...].reshape(h), eps)
+            else:
+                xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
+            qkv = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
+            if gpt:
+                qkv = qkv + bqkv_ref[...]
+                rope2 = lambda t: t
+            else:
+                # per-row rope angles from the per-row positions
+                half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+                        % (hd // 2)).astype(jnp.float32)
+                inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
+                ang = posv.astype(jnp.float32) * inv_freq      # (b, hd)
+                cos_b = jnp.cos(ang)
+                sin_b = jnp.sin(ang)
+                rope2 = lambda t: (t * cos_b + jnp.concatenate(
+                    [-t[:, hd // 2:], t[:, :hd // 2]], axis=-1) * sin_b)
+            # q staged block-diagonally over kv-group lane blocks (see
+            # _fused_decode_pallas); new k/v staged flat for the RMW merge
+            for n in range(nh):
+                g = n // rep
+                q_s[:, n, g * hd:(g + 1) * hd] = rope2(
+                    qkv[:, n * hd:(n + 1) * hd]) * scale
+            for g in range(nkv):
+                kv32_s[:, g * hd:(g + 1) * hd] = rope2(
+                    qkv[:, dq + g * hd:dq + (g + 1) * hd])
+                kv32_s[:, dkv + g * hd:dkv + (g + 1) * hd] = \
+                    qkv[:, dq + dkv + g * hd:dq + dkv + (g + 1) * hd]
+
+            if kvq:     # per-slot k-half dequant scales fold into q rows
+                qbd = q_s[...] * kvs_ref[...][:, None, :dkv]
+            else:
+                qbd = q_s[...]
+
+            def merge(carry, kvblk, idx, limit):
+                """Online-softmax block update over ALL heads; `limit` is
+                per-row (b, 1, 1) — an all-masked row is an exact no-op
+                (alpha = 1, pp = 0), which is what lets one dispatch
+                serve slots of different lengths."""
+                m, l, acc = carry
+                kf = kvblk[:, :, :dkv].astype(jnp.float32)
+                vf = kvblk[:, :, dkv:].astype(jnp.float32)
+                sc = lax.dot_general(
+                    qbd, kf, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, w)
+                sc = jnp.where(idx < limit, sc, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+                alpha = jnp.exp(m - m_new)
+                pp = jnp.exp(sc - m_new[..., None])
+                acc = acc * alpha[..., None] + lax.dot_general(
+                    pp, vf, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)      # (b, nh, dkv)
+                return m_new, l * alpha + jnp.sum(pp, axis=-1), acc
+
+            def body(c, carry):
+                slot = lax.rem(c, 2)
+
+                @pl.when(c + 1 < nc)
+                def _():
+                    for r in range(b):
+                        chunk_copy(li, c + 1, lax.rem(c + 1, 2), r).start()
+
+                for r in range(b):
+                    chunk_copy(li, c, slot, r).wait()
+                idx = c * ck + lax.broadcasted_iota(
+                    jnp.int32, (1, 1, ck), 2)
+                return merge(carry, kvch_s[slot], idx, blk3)
+
+            carry = lax.fori_loop(0, nc, body, (
+                jnp.full((b, nh), NEG_INF, jnp.float32),
+                jnp.zeros((b, nh), jnp.float32),
+                jnp.zeros((b, nh, dkv), jnp.float32)))
+
+            # merge each row's new token into its RMW block, attend to it
+            # from VMEM, write the block back (waited in FFN j==1)
+            for r in range(b):
+                rmw_read(li, r).wait()
+            off3 = (posv - blk_v).reshape(b, 1, 1)
+            sel = lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1) == off3
+            newtok = kv32_s[...]
+            if kvq:     # quantize the append with the per-slot scales
+                newtok = jnp.clip(
+                    jnp.round(newtok / kvs_ref[...]), -127.0, 127.0)
+            kvblk_s[...] = jnp.where(
+                sel, newtok[:, None, :],
+                kvblk_s[...].astype(jnp.float32)).astype(kv_pool.dtype)
+            for r in range(b):
+                rmw_write(li, r).start()
+            bidx = blk3 + lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
+            ms, ls, accs = merge(carry, kvblk_s[...], bidx,
+                                 posv.reshape(b, 1, 1) + 1)
+
+            norm = accs / ls[..., None]                     # (b, nh, dkv)
+            if kvq:     # per-slot v-half dequant scales, applied once
+                norm = norm * kvs_ref[...][:, None, dkv:]
+            if rep == 1:
+                bd = (lax.broadcasted_iota(jnp.int32, (1, nh, dkv), 2)
+                      // hd == lax.broadcasted_iota(
+                          jnp.int32, (1, nh, dkv), 1))
+                attn = jnp.sum(jnp.where(bd, norm, 0.0), axis=1)  # (b, dq)
+                oacc = wdot(attn.astype(dtype), wo_ref,
+                            so_ref if int8 else None)
+            else:
+                oacc = jnp.zeros((b, h), jnp.float32)
+                for g in range(nkv):
+                    ng = norm[:, g * rep:(g + 1) * rep,
+                              g * hd:(g + 1) * hd]          # (b, rep, hd)
+                    w3 = wo_ref[g * rep * hd:(g + 1) * rep * hd,
+                                :].reshape(rep, hd, h)
+                    part = lax.dot_general(
+                        ng.astype(dtype),
+                        w3.astype(dtype) if int8 else w3,
+                        (((2,), (1,)), ((1,), (0,))),
+                        preferred_element_type=jnp.float32)  # (rep, b, h)
+                    oacc = oacc + jnp.sum(part, axis=0)
+                if int8:
+                    oacc = oacc * so_ref[...]
+            if gpt:
+                oacc = oacc + bo_ref[...]
+            xr = x_s[...] + oacc
+            x_s[...] = xr
+            if gpt:
+                xn_s[...] = _layernorm(xr, ln2_ref[...].reshape(h),
+                                       ln2b_ref[...].reshape(h),
+                                       eps).astype(dtype)
+            else:
+                xn_s[...] = _rms(xr, ln2_ref[...].reshape(h),
+                                 eps).astype(dtype)
+            acc_s[...] = jnp.zeros_like(acc_s)
+
+        @pl.when(j >= 1)
+        def ffn_phase():
+            @pl.when(j == 1)
+            def prefetch_next_layer():
+                # drain this layer's per-row write-backs, then issue the
+                # next layer's RMW + chunk-0 reads
+                for r in range(b):
+                    rmw_write(li, r).wait()
+
+                @pl.when(li + 1 < L)
+                def _():
+                    for r in range(b):
+                        rmw_read(li + 1, r).start()
+
+                    @pl.when(nc > 0)
+                    def _():
+                        for r in range(b):
+                            chunk_copy(li + 1, 0, 0, r).start()
+
+            xn = xn_s[...]
+            g = wdot(xn, wg_ref, sg_ref if int8 else None)
+            if gpt:
+                g = g + bg_ref[...]
+                act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            else:
+                u = wdot(xn, wu_ref, su_ref if int8 else None)
+                act = (jax.nn.silu(g) * u).astype(dtype)
+            acc_s[...] += wdot(act, wd_ref, sd_ref if int8 else None)
+
+            if gpt:
+                @pl.when(j == J)
+                def _():
+                    acc_s[...] += jnp.broadcast_to(bd_ref[...], acc_s.shape)
+
+            @pl.when(j == J)
+            def _():
+                xr = x_s[...] + acc_s[...]
+                x_s[...] = xr
+                x_out_ref[...] = xr.astype(dtype)
+
+    def jm(ll, jj):
+        # FFN column block: phase j >= 1 streams block j-1; the attention
+        # phase keeps the previous layer's last block (no refetch)
+        return jnp.where(jj < 1, J - 1, jj - 1)
+
+    def fl(ll, jj):
+        return lax.max(ll - (jj < 1), 0)
+
+    grid = (L, 1 + J)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                 # positions
+        pl.BlockSpec(memory_space=pltpu.SMEM),                 # block table
+        pl.BlockSpec((b, 1), lambda l, j: (0, 0)),             # posv
+        pl.BlockSpec((b, h), lambda l, j: (0, 0)),             # x
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln1
+        pl.BlockSpec((None, h, dqkv), lambda l, j: (l, 0, 0)),  # wqkv
+        pl.BlockSpec((None, dq, h), lambda l, j: (l, 0, 0)),   # wo
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln2
+        pl.BlockSpec((None, h, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # wg
+    ] + ([] if gpt else [
+        pl.BlockSpec((None, h, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # wu
+    ]) + [
+        pl.BlockSpec((None, fblk, h),
+                     lambda l, j: (fl(l, j), jm(l, j), 0)),     # wd
+    ] + ([
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln1_b
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln2_b
+        pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # bqkv
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bo
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # bg
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bd
+    ] if gpt else []) + ([
+        pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # sqkv
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # so
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # sg
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # su
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # sd
+    ] if int8 else []) + ([
+        pl.BlockSpec((None, b, 2 * dkv), lambda l, j: (l, 0, 0)),  # kvs
+    ] if kvq else []) + [
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv pool
+    ]
+    operands = [
+        jnp.asarray(positions, jnp.int32).reshape(b),
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(positions, jnp.int32).reshape(b, 1),
+        x,
+        params["ln1"][:, None], params["wqkv"], params["wo"],
+        params["ln2"][:, None], params["wg"],
+        *(() if gpt else (params["wu"],)),
+        params["wd"],
+        *((params["ln1_b"][:, None], params["ln2_b"][:, None],
+           params["bqkv"][:, None], params["bo"][:, None],
+           params["bg"][:, None], params["bd"][:, None]) if gpt else ()),
+        *((params["wqkv_s"], params["wo_s"], params["wg_s"],
+           params["wu_s"], params["wd_s"]) if int8 else ()),
+        *((jnp.asarray(kv_scales, jnp.float32),) if kvq else ()),
+        kv_pool,
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((b, h), lambda l, j: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h), dtype),
+            jax.ShapeDtypeStruct(kv_pool.shape, kv_pool.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),          # x_s
+            pltpu.VMEM((b, h), dtype),                # xn_s
+            pltpu.VMEM((b, h), jnp.float32),          # acc_s
+            pltpu.VMEM((b, nh, dkv), jnp.float32),    # q_s (block-diag)
+            pltpu.VMEM((b, 2 * dkv), jnp.float32),    # kv32_s staging
+            pltpu.VMEM((b, 8, 2 * dkv), kv_pool.dtype),    # kvblk_s RMW
+            pltpu.VMEM((2, b, ck, 2 * dkv), kv_pool.dtype),  # kvch_s dbuf
+            pltpu.SemaphoreType.DMA((b,)),            # wsem (per row)
+            pltpu.SemaphoreType.DMA((2, b)),          # rsem (slot, row)
+        ],
+        input_output_aliases={len(in_specs) - 1: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=_vmem_limit_bytes()),
+        name="fused_paged_decode_step",
+        interpret=interpret,
+    )(*operands)
+    return out[0], out[1]
+
+
+def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
+                            cos, sin, *, num_heads: int, num_kv_heads: int,
+                            eps: float = 1e-5, rope_base: float = 10000.0,
+                            arch: str = "llama",
+                            blocks: Optional[Dict] = None, kv_scales=None):
+    """Dispatch one PAGED decode step: Pallas kernel on TPU (or under
+    FLAGS_pallas_interpret), jnp paged reference elsewhere.
+
+    Args follow `fused_paged_decode_reference` (block-table pool, per-row
+    positions). cos/sin are the (b, hd) rope rows gathered at each slot's
+    position — consumed by the reference path only (the kernel computes
+    rope in-kernel from `positions`, like the contiguous kernel).
+    `blocks` is a `decode_block_plan` dict; the paged kernel rejects
+    q-split plans and consistency-checks `cache_wbytes` against the pool
+    dtype. `kv_scales` (L, b, 2*nkv*hd) enables the per-slot int8 pool.
+    """
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.ops import use_pallas
+    if arch not in ("llama", "gpt"):
+        raise NotImplementedError(
+            f"paged decode supports arch llama/gpt, got {arch!r}")
+    dkv = kv_pool.shape[-1] // 2
+    BT = kv_pool.shape[2]
+    interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
+    if (use_pallas() or interp) and dkv % 128 == 0 and BT % 8 == 0:
+        cb = jnp.dtype(kv_pool.dtype).itemsize
+        if blocks is not None and blocks.get("cache_wbytes", cb) != cb:
+            raise ValueError(
+                f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+                f"cache but the pool dtype is {kv_pool.dtype} ({cb} B); "
+                f"rebuild the plan with decode_block_plan(cache_wbytes="
+                f"{cb})")
+        try:
+            with jax.named_scope("fused_decode.kernel_paged"):
+                return _fused_paged_decode_pallas(
+                    x, params, kv_pool, block_tables, positions,
+                    num_heads=num_heads, num_kv_heads=num_kv_heads,
+                    head_dim=dkv // num_kv_heads, rope_base=rope_base,
+                    eps=eps, arch=arch, blocks=blocks,
+                    kv_scales=kv_scales, interpret=interp)
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            if flag("FLAGS_pallas_strict"):
+                raise
+            global _fallback_logged
+            if not _fallback_logged:
+                _fallback_logged = True
+                import logging
+                logging.getLogger("paddle_tpu.ops.fused_decode").warning(
+                    "Pallas paged decode failed (%s: %s); using the jnp "
+                    "reference path. FLAGS_pallas_strict=1 to raise.",
+                    type(e).__name__, e)
+    with jax.named_scope("fused_decode.reference_paged"):
+        return fused_paged_decode_reference(
+            x, params, kv_pool, block_tables, positions, cos, sin,
+            num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
+            arch=arch, kv_scales=kv_scales)

@@ -1,0 +1,27 @@
+"""Continuous-batching serving engine (docs/SERVING.md).
+
+The production analog of the reference's fused_multi_transformer serving
+stack: a paged KV-cache pool with per-slot block tables over the fused
+decode kernel, in-flight request join/leave at slot granularity, and
+content-hashed prefix reuse. ``inference.generate`` remains the
+single-batch entry point; this package is the multi-request scheduler on
+top of the same kernel (Orca continuous batching + vLLM paged KV, both
+in the PAPERS lineage).
+
+    from paddle_tpu import serving
+    eng = serving.ServingEngine(model, max_slots=8, eos_token_id=2)
+    rid = eng.submit(serving.Request(prompt_ids, max_new_tokens=64))
+    eng.drain()
+    out = eng.results[rid].ids        # == generate()'s output row
+"""
+
+from paddle_tpu.serving.engine import (  # noqa: F401
+    Request, RequestResult, ServingEngine)
+from paddle_tpu.serving.pool import (  # noqa: F401
+    SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
+
+__all__ = [
+    "Request", "RequestResult", "ServingEngine",
+    "BlockPool", "PoolExhausted", "PrefixCache", "PrefixEntry",
+    "SCRATCH_BLOCK",
+]

@@ -1,0 +1,812 @@
+"""Continuous-batching serving engine over the fused paged-decode kernel.
+
+``inference.generate`` runs one fixed batch to ``max_new_tokens`` in a
+single dispatch: a request that finishes early burns full decode steps
+emitting eos padding, and a request that arrives late waits for the
+whole batch to drain. This engine (the Orca continuous-batching /
+vLLM paged-KV design, PAPERS lineage) instead schedules at *slot*
+granularity over a shared paged KV pool:
+
+* **join** — a queued request is admitted when a batch slot and enough
+  pool blocks are free; prefill runs apart from the decode dispatch
+  (reusing any content-hashed cached prefix blocks, and admissions that
+  land on the same tick share one batched prefill program per prompt
+  shape), then the slot joins the running decode batch mid-flight;
+* **leave** — a slot that hits eos, its token budget, or its deadline
+  retires immediately: its blocks return to the pool the same step, no
+  eos-padding decode steps are spent on it;
+* every decode step is ONE dispatch of the fused paged kernel for all
+  active slots, whatever their lengths — per-row positions mask the
+  online-softmax walk, so mixed-length slots share the program.
+
+Parity contract (tests/test_serving.py): with greedy sampling a
+request's tokens from a merged continuously-batched run are identical to
+an isolated ``generate`` call — per-request RNG streams
+(``fold_in(PRNGKey(request_seed), t)``) make that hold for sampled
+tokens too, because a row's stream never depends on its batch
+neighbours.
+"""
+
+import itertools
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool, PoolExhausted,
+                                     PrefixCache)
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["Request", "RequestResult", "ServingEngine"]
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One generation request.
+
+    Sampling *shape* knobs (temperature/top_k/top_p/eos) live on the
+    engine — they are baked into the one shared decode program. Per
+    request: the prompt, the token budget, the RNG ``seed`` (defaults to
+    a fresh engine-assigned seed; pass the seed an isolated
+    ``generate(..., request_seeds=[seed])`` call would use to reproduce
+    it exactly), and an optional wall-clock ``deadline_s`` measured from
+    submit (queue wait included) — on expiry the request retires with
+    the tokens it has, mirroring ``generate(deadline_s=...)``.
+    """
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "seed",
+                 "deadline_s", "_t_submit")
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 seed: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[int] = None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.seed = seed
+        self.deadline_s = deadline_s
+        self.request_id = (next(_req_ids) if request_id is None
+                           else int(request_id))
+        self._t_submit: Optional[float] = None
+
+
+class RequestResult:
+    """Terminal state of a request. ``tokens`` are the generated ids
+    (eos included when hit); ``gen_len`` counts tokens before the first
+    eos — the same accounting ``generate(return_lengths=True)`` reports.
+    ``finish`` is one of ``eos`` / ``length`` / ``deadline``."""
+
+    __slots__ = ("request_id", "prompt", "tokens", "gen_len", "finish",
+                 "ttft_s", "tpot_s", "prefix_hit_blocks")
+
+    def __init__(self, request_id, prompt, tokens, gen_len, finish,
+                 ttft_s, tpot_s, prefix_hit_blocks):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.tokens = np.asarray(tokens, np.int32)
+        self.gen_len = int(gen_len)
+        self.finish = finish
+        self.ttft_s = ttft_s
+        self.tpot_s = tpot_s
+        self.prefix_hit_blocks = prefix_hit_blocks
+
+    @property
+    def ids(self) -> np.ndarray:
+        """prompt + generated tokens, the ``generate`` output row."""
+        return np.concatenate([self.prompt, self.tokens])
+
+
+class _Slot:
+    __slots__ = ("req", "tok", "pos", "count", "tokens", "blocks", "ntab",
+                 "worst_blocks", "t_first", "deadline_at",
+                 "prefix_hit_blocks")
+
+    def __init__(self, req: Request, worst_blocks: int,
+                 prefix_hit_blocks: int):
+        self.req = req
+        self.tok = 0            # last sampled, kv not yet appended
+        self.pos = 0            # append position of the next decode step
+        self.count = 0          # tokens generated so far
+        self.tokens: List[int] = []
+        self.blocks: List[int] = []     # owned pool refs (shared + private)
+        self.ntab = 0                   # table entries populated
+        self.worst_blocks = worst_blocks
+        self.t_first: Optional[float] = None
+        self.deadline_at: Optional[float] = None
+        self.prefix_hit_blocks = prefix_hit_blocks
+
+
+class ServingEngine:
+    """Continuous-batching decode over a paged KV pool.
+
+    ``max_slots`` is the decode batch width (one fused dispatch serves
+    all active slots). The pool holds ``num_blocks`` blocks of
+    ``block_tokens`` tokens each — sized directly (``num_blocks``), by
+    byte budget (``pool_bytes`` / the per-block byte cost at the cache
+    element size: 1 for int8, 2 for bf16), or defaulted to worst case
+    (every slot filled to ``max_seq_len``). Admission reserves each
+    request's worst-case blocks (prompt + max_new) so lazy per-step
+    block allocation can never fail mid-flight; physical blocks are
+    still allocated lazily, so pool-usage gauges track real occupancy.
+
+    ``cache_dtype=jnp.int8`` enables the int8 KV pool: each request's
+    prefill is its own calibration pass (per-SLOT scales — an isolated
+    b=1 ``generate`` computes the same scales, which is what keeps int8
+    parity token-exact).
+    """
+
+    def __init__(self, model, *, max_slots: int = 4,
+                 block_tokens: int = 128, num_blocks: Optional[int] = None,
+                 pool_bytes: Optional[int] = None, max_seq_len: int = 1024,
+                 cache_dtype=jnp.bfloat16, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 prefix_caching: bool = True,
+                 prefix_cache_blocks: int = 256,
+                 state: Optional[Dict] = None):
+        from paddle_tpu.inference import _inference_state
+
+        self.model = model
+        self._state = state if state is not None else _inference_state(model)
+        meta = (model.fused_decode_plan(self._state, probe=True)
+                if hasattr(model, "fused_decode_plan") else None)
+        if meta is None:
+            raise ValueError(
+                "ServingEngine needs a fused_decode_plan-eligible model "
+                "(llama/gpt); this model/config cannot ride the paged "
+                "kernel")
+        self.arch = meta.get("arch", "llama")
+        if self.arch not in ("llama", "gpt"):
+            raise ValueError(
+                f"paged serving supports arch llama/gpt, got {self.arch!r}")
+        blocks_plan = meta.get("blocks")
+        if blocks_plan is not None and blocks_plan.get("q_split", 1) != 1:
+            raise ValueError(
+                "paged serving does not support the q-split (big-model) "
+                "weight-streaming regime yet")
+        self.meta = meta
+        self.kv_int8 = jnp.dtype(cache_dtype) == jnp.int8
+        if not self.kv_int8 and jnp.dtype(cache_dtype).itemsize != 2:
+            raise ValueError(
+                f"cache_dtype must be bf16-width or int8, got "
+                f"{jnp.dtype(cache_dtype).name}")
+        self.cache_dtype = jnp.int8 if self.kv_int8 else cache_dtype
+        if max_seq_len % block_tokens:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} must be a multiple of "
+                f"block_tokens {block_tokens}")
+        self.block_tokens = int(block_tokens)
+        self.max_seq_len = int(max_seq_len)
+        self.max_slots = int(max_slots)
+        self.max_blocks_per_slot = max_seq_len // block_tokens
+
+        L = self._num_layers = self._count_layers()
+        nkv, hd = meta["num_kv_heads"], meta["head_dim"]
+        self._dkv = nkv * hd
+        bpb = self.block_bytes = (
+            L * block_tokens * 2 * self._dkv
+            * (1 if self.kv_int8 else 2))
+        if num_blocks is None:
+            if pool_bytes is not None:
+                num_blocks = max(2, int(pool_bytes) // bpb)
+            else:   # worst case: every slot filled to max_seq_len
+                num_blocks = max_slots * self.max_blocks_per_slot + 1
+        self.pool = BlockPool(num_blocks, block_tokens)
+        self.kv_pool = jnp.zeros(
+            (L, num_blocks, block_tokens, 2 * self._dkv), self.cache_dtype)
+        self.prefix_cache = (PrefixCache(self.pool, prefix_cache_blocks)
+                             if prefix_caching else None)
+
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = eos_token_id
+        self.seed = int(seed)
+        self._seed_counter = itertools.count()
+
+        from paddle_tpu.ops import rope as rope_ops
+        self._cos_tab, self._sin_tab = rope_ops.rope_cos_sin(
+            max_seq_len, hd, base=meta["rope_base"])
+
+        # host mirrors of the per-slot device state
+        ms = self.max_slots
+        self._tables = np.full((ms, self.max_blocks_per_slot),
+                               SCRATCH_BLOCK, np.int32)
+        self._positions = np.zeros(ms, np.int32)
+        self._toks = np.zeros(ms, np.int32)
+        self._seeds = np.zeros(ms, np.uint32)
+        self._counts = np.zeros(ms, np.int32)
+        self._kv_scales = np.ones((L, ms, 2 * self._dkv), np.float32)
+
+        self._slots: List[Optional[_Slot]] = [None] * ms
+        self._queue: deque = deque()
+        self.results: Dict[int, RequestResult] = {}
+        self._reserved = 0      # blocks promised to in-flight slots
+        self._step_fn = None
+        # the stacked per-layer weight copy is built ONCE here and fed to
+        # the step program as a traced argument: a per-token dispatch has
+        # no scan to amortize the in-trace rebuild over (generate()'s
+        # decode program runs build_fused_params once per max_new_tokens
+        # steps; a serving step would run it once per token)
+        self._stacked = jax.jit(
+            lambda st: model.fused_decode_plan(st)["params"])(self._state)
+        # device twins of the host mirrors above: positions/toks/counts
+        # advance ON DEVICE inside the step program (no per-step H2D
+        # uploads); a join/leave/table event marks them dirty and the
+        # next step re-uploads from the host mirrors
+        self._dev = None
+        self._dirty = True
+        self._jit_cache: Dict = {}
+        self.stats = dict(steps=0, decode_tokens=0, idle_slot_steps=0,
+                          prefill_tokens=0, prefill_tokens_reused=0,
+                          requests_finished=0)
+        self._finished_tick: List[int] = []
+        self._gauges_init()
+
+    # ------------------------------------------------------------- helpers
+    def _count_layers(self) -> int:
+        cfg = self.model.cfg
+        return int(getattr(cfg, "num_layers"))
+
+    def _gauges_init(self):
+        from paddle_tpu.observability import registry
+        r = registry()
+        r.gauge("serving.pool_blocks_total").set(self.pool.num_blocks - 1)
+        self._update_gauges()
+
+    def _update_gauges(self):
+        from paddle_tpu.observability import registry
+        r = registry()
+        active = sum(s is not None for s in self._slots)
+        r.gauge("serving.batch_occupancy").set(active / self.max_slots)
+        r.gauge("serving.queue_depth").set(len(self._queue))
+        r.gauge("serving.pool_blocks_used").set(self.pool.used_blocks)
+        if self.prefix_cache is not None:
+            r.gauge("serving.prefix_hit_rate").set(
+                self.prefix_cache.hit_rate)
+
+    def reset_stats(self):
+        """Zero the cumulative throughput counters (and the prefix
+        cache's hit accounting) — bench warmup -> measured pass."""
+        self.stats = dict(steps=0, decode_tokens=0, idle_slot_steps=0,
+                          prefill_tokens=0, prefill_tokens_reused=0,
+                          requests_finished=0)
+        if self.prefix_cache is not None:
+            self.prefix_cache.hit_blocks = 0
+            self.prefix_cache.lookup_blocks = 0
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def idle(self) -> bool:
+        return self.active_slots == 0 and not self._queue
+
+    # ---------------------------------------------------------- submission
+    def submit(self, request) -> int:
+        """Queue a request (accepts a :class:`Request` or a 1-D prompt).
+        Returns the request id; the result lands in ``self.results``."""
+        if not isinstance(request, Request):
+            request = Request(request)
+        P = len(request.prompt)
+        worst = -(-(P + request.max_new_tokens - 1) // self.block_tokens)
+        if worst > self.max_blocks_per_slot:
+            raise ValueError(
+                f"request needs {worst} blocks "
+                f"({P}+{request.max_new_tokens} tokens) but max_seq_len "
+                f"{self.max_seq_len} caps a slot at "
+                f"{self.max_blocks_per_slot}")
+        # never-fits check: optimistic bound only — with prefix caching
+        # up to (P-1)//BT prompt blocks may be shared, so don't reject a
+        # request the cache could make admissible. The dtype-accurate
+        # reservation (int8 hits share NO physical blocks) lives in
+        # _admit, where an over-sized request queues instead of raising.
+        lookup = ((P - 1) // self.block_tokens
+                  if self.prefix_cache is not None else 0)
+        if worst - lookup > self.pool.num_blocks - 1:
+            raise PoolExhausted(
+                f"request needs at least {worst - lookup} blocks; the "
+                f"whole pool has {self.pool.num_blocks - 1}")
+        if request.seed is None:
+            request.seed = self.seed + next(self._seed_counter)
+        request._t_submit = time.perf_counter()
+        self._queue.append(request)
+        self._update_gauges()
+        return request.request_id
+
+    # ------------------------------------------------------------- prefill
+    def _prefill_wave_fn(self, R, s_pad, n):
+        """Batched prefill program for a WAVE of ``n`` same-shape
+        admissions (shared prefix depth ``R``, padded prompt tail
+        ``s_pad``): the prefix gather (bf16: straight from the pool),
+        the forward pass, the pool adopt scatter, the int8 calibration,
+        and the first-token sample are ONE dispatch. A b=1 prefill of a
+        short prompt streams every weight once — the same traffic as a
+        whole decode step — so admissions that land on the same tick
+        share one weight pass and one pool write instead of paying both
+        per request."""
+        from paddle_tpu.inference import (_fold_rows, _row_keys,
+                                          _sample_logits)
+        from paddle_tpu.nn.layer import functional_call
+
+        key = ("prefill", self.kv_int8, R, s_pad, n)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        nkv, hd = self.meta["num_kv_heads"], self.meta["head_dim"]
+        dkv = self._dkv
+        BT = self.block_tokens
+        cache_len = R + s_pad
+        hb = R // BT                 # shared prefix blocks per row
+        nb_new = s_pad // BT         # freshly prefilled blocks per row
+        n0 = hb + nb_new             # blocks covering the whole prompt
+        model = self.model
+        int8 = self.kv_int8
+
+        def impl(state, pool, prefix, ids, last_idx, seeds, new_bids,
+                 valid_len):
+            # prefix: bf16 pools pass the (n, hb) shared block ids and
+            # gather the prefix KV HERE (no separate dispatch); int8
+            # pools pass the host-kept bf16 copies (L, n, R, 2dkv) —
+            # quantized blocks are per-slot-scaled, never shareable
+            cache = model.init_cache(n, cache_len, dtype=jnp.bfloat16)
+            if R:
+                pk = (prefix if int8
+                      else pool[:, prefix].reshape(
+                          len(cache), n, R, 2 * dkv))
+                for l in range(len(cache)):
+                    kl = pk[l, :, :, :dkv].reshape(n, R, nkv, hd)
+                    vl = pk[l, :, :, dkv:].reshape(n, R, nkv, hd)
+                    cache[l] = {
+                        "k": cache[l]["k"].at[:, :R].set(
+                            kl.astype(cache[l]["k"].dtype)),
+                        "v": cache[l]["v"].at[:, :R].set(
+                            vl.astype(cache[l]["v"].dtype))}
+            with jax.named_scope("decode.prefill"):
+                out, cache = functional_call(model, state, ids,
+                                             cache=cache, start_pos=R)
+            kv_flat = jnp.stack([jnp.concatenate(
+                [c["k"].reshape(n, cache_len, dkv),
+                 c["v"].reshape(n, cache_len, dkv)], axis=-1)
+                for c in cache])                 # (L, n, cache_len, 2dkv)
+            logits = jnp.take_along_axis(
+                out, last_idx[:, None, None], axis=1)[:, 0]   # (n, vocab)
+            keys = _row_keys(seeds)
+            with jax.named_scope("decode.sample"):
+                tok = _sample_logits(logits, _fold_rows(keys, 0),
+                                     self.temperature, self.top_k,
+                                     self.top_p)
+            if int8:
+                # per-request calibration: amax over each row's VALID
+                # prompt positions only — the padded tail holds
+                # pad-token kv, which must not leak into the scales
+                # (matches quantize_kv_cache over a contiguous cache)
+                mask = (jnp.arange(cache_len)[None]
+                        < valid_len[:, None])[None, :, :, None]
+                a = jnp.where(mask, jnp.abs(kv_flat.astype(jnp.float32)),
+                              0.0).max(axis=2)              # (L, n, 2dkv)
+                a = a.reshape(-1, n, 2 * nkv, hd).max(axis=-1)
+                lanes = jnp.repeat(jnp.maximum(a / 127.0, 1e-8), hd,
+                                   axis=-1)                 # (L, n, 2dkv)
+                q = jnp.clip(jnp.round(
+                    kv_flat.astype(jnp.float32) / lanes[:, :, None, :]),
+                    -127, 127).astype(jnp.int8)
+                pool = pool.at[:, new_bids].set(
+                    q.reshape(-1, n, n0, BT, 2 * dkv))
+                return tok, pool, lanes, kv_flat
+            blk = kv_flat[:, :, R:cache_len].reshape(
+                -1, n, nb_new, BT, 2 * dkv)
+            pool = pool.at[:, new_bids].set(blk.astype(pool.dtype))
+            return tok, pool
+
+        # `state` flows as a traced argument (matching generate) so the
+        # weights are not baked into the program as constants
+        jitted = jax.jit(impl, donate_argnums=(1,))
+        fn = lambda *a: jitted(self._state, *a)   # noqa: E731
+        self._jit_cache[key] = fn
+        return fn
+
+    def _admit(self):
+        """FIFO admission: while a slot and the head request's
+        worst-case block reservation both fit, pop it into the current
+        wave; the wave is grouped by prefill shape ``(R, s_pad)`` and
+        each group runs as ONE batched prefill program."""
+        from paddle_tpu.resilience import faults as _faults
+
+        BT = self.block_tokens
+        while self._queue:
+            wave = []           # (slot_idx, slot, hits, R, s_pad)
+            while self._queue:
+                try:
+                    slot_idx = self._slots.index(None)
+                except ValueError:
+                    break
+                req = self._queue[0]
+                P = len(req.prompt)
+                n_lookup = (P - 1) // BT
+                hits = (self.prefix_cache.lookup(req.prompt, n_lookup,
+                                                 record=False)
+                        if self.prefix_cache is not None else [])
+                worst = -(-(P + req.max_new_tokens - 1) // BT)
+                # bf16 hits ride the cached PHYSICAL blocks (refcount++,
+                # no fresh allocation); int8 hits only skip prefill
+                # FLOPs — the slot still allocates every prompt block,
+                # so they don't reduce the worst-case reservation
+                spare = 0 if self.kv_int8 else len(hits)
+                short = (worst - spare
+                         - (self.pool.free_blocks - self._reserved))
+                if short > 0 and self.prefix_cache is not None:
+                    # cached-but-idle prefix blocks are reclaimable pool
+                    # capacity — evict LRU entries (never this request's
+                    # own hits) before declaring the pool full
+                    self.prefix_cache.evict_free(short, keep=hits)
+                    short = (worst - spare
+                             - (self.pool.free_blocks - self._reserved))
+                if short > 0:
+                    break       # head-of-line: keep arrival order
+                # fault site BEFORE the pop: a raising fault (the PR 4
+                # injection contract for decode.dispatch) leaves the
+                # request queued — a retried step() re-admits it; firing
+                # after the pop would lose it (no queue, slot or result)
+                _faults.maybe_fire("decode.dispatch")
+                self._queue.popleft()
+                if self.prefix_cache is not None:
+                    self.prefix_cache.commit(hits, n_lookup)
+
+                R = len(hits) * BT
+                n0 = -(-P // BT)        # blocks covering the prompt
+                s_pad = -(-(P - R) // BT) * BT
+                slot = _Slot(req, worst, len(hits))
+                row = self._tables[slot_idx]
+                row[:] = SCRATCH_BLOCK
+                if self.kv_int8:
+                    slot.blocks = self.pool.alloc(n0)
+                else:
+                    for e in hits:  # slot's own ref on shared blocks
+                        self.pool.ref(e.block_id)
+                    slot.blocks = ([e.block_id for e in hits]
+                                   + self.pool.alloc(n0 - len(hits)))
+                row[:n0] = slot.blocks
+                slot.ntab = n0
+                self._reserved += worst - n0
+                self._slots[slot_idx] = slot
+                wave.append((slot_idx, slot, hits, R, s_pad))
+            if not wave:
+                return
+            self._dirty = True
+            groups: Dict = {}
+            for item in wave:
+                groups.setdefault((item[3], item[4]), []).append(item)
+            for (R, s_pad), grp in groups.items():
+                self._run_prefill_group(R, s_pad, grp)
+            # an instantly-finished admission (eos/1-token budget on the
+            # prefill sample) frees its slot — loop for the next wave
+
+    def _run_prefill_group(self, R, s_pad, grp):
+        """Run one batched prefill program and adopt each row's slot
+        into the running decode batch."""
+        from paddle_tpu.observability import registry
+
+        n = len(grp)
+        BT = self.block_tokens
+        L = self._num_layers
+        hb = R // BT
+        ids = np.zeros((n, s_pad), np.int32)
+        last_idx = np.zeros(n, np.int32)
+        seeds = np.zeros(n, np.uint32)
+        valid = np.zeros(n, np.int32)
+        for r, (slot_idx, slot, hits, _, _) in enumerate(grp):
+            P = len(slot.req.prompt)
+            ids[r, :P - R] = slot.req.prompt[R:]
+            last_idx[r] = P - 1 - R
+            seeds[r] = np.uint32(slot.req.seed)
+            valid[r] = P
+        fn = self._prefill_wave_fn(R, s_pad, n)
+        if self.kv_int8:
+            new_bids = np.asarray([s.blocks for _, s, _, _, _ in grp],
+                                  np.int32)                    # (n, n0)
+            prefix = (jnp.asarray(np.stack(
+                [np.concatenate([e.kv_host for e in hits], axis=1)
+                 for _, _, hits, _, _ in grp], axis=1)) if hb
+                else jnp.zeros((L, n, 0, 2 * self._dkv), jnp.bfloat16))
+            tok, self.kv_pool, lanes, kv_flat = fn(
+                self.kv_pool, prefix, jnp.asarray(ids),
+                jnp.asarray(last_idx), jnp.asarray(seeds),
+                jnp.asarray(new_bids), jnp.asarray(valid))
+            lanes_np = np.asarray(lanes)
+            kv_np = (np.asarray(kv_flat)
+                     if self.prefix_cache is not None else None)
+        else:
+            new_bids = np.asarray(
+                [s.blocks[hb:] for _, s, _, _, _ in grp], np.int32)
+            prefix = (np.asarray([[e.block_id for e in hits]
+                                  for _, _, hits, _, _ in grp], np.int32)
+                      if hb else np.zeros((n, 0), np.int32))
+            tok, self.kv_pool = fn(
+                self.kv_pool, jnp.asarray(prefix), jnp.asarray(ids),
+                jnp.asarray(last_idx), jnp.asarray(seeds),
+                jnp.asarray(new_bids), jnp.asarray(valid))
+            lanes_np = kv_np = None
+        tok_np = np.asarray(tok)
+        # the prefill sample is each request's first GENERATED token
+        # (stats["decode_tokens"] counts only decode-step tokens)
+        registry().counter("serving.tokens_generated").inc(n)
+        eos = self.eos_token_id
+        for r, (slot_idx, slot, hits, _, _) in enumerate(grp):
+            req = slot.req
+            P = len(req.prompt)
+            if lanes_np is not None:
+                self._kv_scales[:, slot_idx, :] = lanes_np[:, r]
+            slot.pos = P
+            slot.count = 1
+            slot.tok = int(tok_np[r])
+            slot.tokens = [slot.tok]
+            slot.t_first = time.perf_counter()
+            if req.deadline_s is not None:
+                slot.deadline_at = req._t_submit + req.deadline_s
+            self._positions[slot_idx] = P
+            self._toks[slot_idx] = slot.tok
+            self._seeds[slot_idx] = np.uint32(req.seed)
+            self._counts[slot_idx] = 1
+            self.stats["prefill_tokens"] += P - R
+            self.stats["prefill_tokens_reused"] += R
+            if self.prefix_cache is not None:
+                # full prompt blocks are append-proof (appends land at
+                # pos >= P) — bf16 shares them as-is, copy-on-write by
+                # construction; int8 keeps exact bf16 copies host-side.
+                # Inserts land AFTER the wave program so a same-wave
+                # sibling can never hit blocks not yet written (it just
+                # misses; the next wave sees the entries).
+                nh = len(hits)
+                if self.kv_int8:
+                    # copy the slices: a view would pin the whole wave's
+                    # (L, n, cache_len, 2dkv) buffer per cached block
+                    self.prefix_cache.insert(
+                        req.prompt, nh,
+                        kv_host=[np.ascontiguousarray(
+                            kv_np[:, r, c * BT:(c + 1) * BT])
+                                 for c in range(nh, P // BT)])
+                else:
+                    self.prefix_cache.insert(
+                        req.prompt, nh,
+                        block_ids=slot.blocks[nh:P // BT])
+            if (eos is not None and slot.tok == int(eos)) \
+                    or slot.count >= req.max_new_tokens:
+                self._retire(slot_idx,
+                             "eos" if eos is not None
+                             and slot.tok == int(eos) else "length")
+
+    # -------------------------------------------------------------- decode
+    def _build_step_fn(self):
+        from paddle_tpu.inference import _row_keys, _sample_logits
+        from paddle_tpu.ops.fused_decode import fused_paged_decode_step
+
+        meta, arch, int8 = self.meta, self.arch, self.kv_int8
+        model, cos_tab, sin_tab = self.model, self._cos_tab, self._sin_tab
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        pos_cap = self.max_seq_len - 1
+
+        def impl(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales):
+            # embed/head come from the traced state (cheap gathers); the
+            # stacked layer weights arrive prebuilt via `stacked`, so the
+            # plan's own build_fused_params output is unused and XLA
+            # dead-codes the per-step restacking away
+            plan_t = model.fused_decode_plan(state)
+            blocks = plan_t.get("blocks")
+            if int8 and blocks is not None:
+                blocks = dict(blocks, cache_wbytes=1)
+            x = plan_t["embed"](toks, positions)
+            cos = jnp.take(cos_tab, positions, axis=0)
+            sin = jnp.take(sin_tab, positions, axis=0)
+            x, pool = fused_paged_decode_step(
+                x, stacked, pool, tables, positions, cos, sin,
+                num_heads=meta["num_heads"],
+                num_kv_heads=meta["num_kv_heads"], eps=meta["eps"],
+                rope_base=meta["rope_base"], arch=arch, blocks=blocks,
+                kv_scales=kv_scales if int8 else None)
+            with jax.named_scope("decode.sample"):
+                keys = _row_keys(seeds)
+                ki = jax.vmap(jax.random.fold_in)(keys, counts)
+                nxt = _sample_logits(plan_t["head"](x), ki, temperature,
+                                     top_k, top_p)
+            # advance the per-slot state in-program so event-free steps
+            # re-dispatch with NO host->device uploads; the clamp only
+            # ever binds on retired rows (an active row's position is
+            # bounded by its admission-checked worst case), keeping their
+            # table lookups in range while they idle against scratch
+            pos2 = jnp.minimum(positions + 1, pos_cap)
+            return nxt, pool, pos2, counts + 1
+
+        # donate the pool: the reference path batches every layer's
+        # append into ONE scatter (jax-0.4 CPU ignores donation, so each
+        # scatter costs one full pool copy — per step, not per layer);
+        # on TPU the Pallas kernel aliases the pool and donation skips
+        # the defensive copy
+        jitted = jax.jit(impl, donate_argnums=(2,))
+        return lambda *a: jitted(self._state, self._stacked, *a)
+
+    def _ensure_blocks(self, slot_idx: int):
+        """The next append position must resolve to an allocated block;
+        allocate lazily as a slot's sequence crosses block boundaries
+        (admission already reserved the worst case, so this cannot
+        exhaust the pool)."""
+        s = self._slots[slot_idx]
+        c = s.pos // self.block_tokens
+        while s.ntab <= c:
+            bid = self.pool.alloc(1)[0]
+            s.blocks.append(bid)
+            self._tables[slot_idx][s.ntab] = bid
+            s.ntab += 1
+            self._reserved -= 1
+            self._dirty = True
+
+    def _retire(self, slot_idx: int, finish: str):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import registry
+
+        s = self._slots[slot_idx]
+        now = time.perf_counter()
+        for bid in s.blocks:
+            self.pool.free(bid)
+        self._reserved -= s.worst_blocks - s.ntab
+        self._slots[slot_idx] = None
+        self._tables[slot_idx][:] = SCRATCH_BLOCK
+        self._positions[slot_idx] = 0
+        self._toks[slot_idx] = 0
+        self._counts[slot_idx] = 0
+        self._dirty = True
+
+        toks = np.asarray(s.tokens, np.int32)
+        eos = self.eos_token_id
+        if eos is not None and (toks == int(eos)).any():
+            gen_len = int((toks == int(eos)).argmax())
+        else:
+            gen_len = len(toks)
+        ttft = s.t_first - s.req._t_submit
+        tpot = ((now - s.t_first) / (s.count - 1) if s.count > 1 else None)
+        res = RequestResult(s.req.request_id, s.req.prompt, toks, gen_len,
+                            finish, ttft, tpot, s.prefix_hit_blocks)
+        self.results[s.req.request_id] = res
+        self._finished_tick.append(s.req.request_id)
+        self.stats["requests_finished"] += 1
+        registry().counter("serving.requests", finish=finish).inc()
+        tr = obs.active_tracer()
+        if tr is not None:
+            # _t_submit is monotonic (perf_counter); span ts must share
+            # the wall-clock base every other span uses, so map the
+            # monotonic age onto time.time() at retirement
+            tr.record("serving.request",
+                      ts=time.time() - (now - s.req._t_submit),
+                      dur_s=now - s.req._t_submit,
+                      request_id=s.req.request_id, finish=finish,
+                      prompt_len=int(len(s.req.prompt)),
+                      tokens=int(s.count), ttft_s=ttft, tpot_s=tpot,
+                      prefix_hit_blocks=s.prefix_hit_blocks)
+        return res
+
+    def step(self) -> Dict:
+        """One scheduler tick: admit what fits, retire expired deadlines,
+        run ONE fused paged decode step for every active slot, retire
+        slots that finished. Returns a small status dict."""
+        from paddle_tpu.observability import registry
+        from paddle_tpu.resilience import faults as _faults
+        from paddle_tpu.resilience import record_event
+
+        # every _retire this tick (deadline sweep, instant finish on the
+        # prefill sample inside _admit, decode finish) lands here, so the
+        # returned `finished` list is complete for result collection
+        self._finished_tick = []
+        self._admit()
+        now = time.perf_counter()
+        for i, s in enumerate(self._slots):
+            if s is not None and s.deadline_at is not None \
+                    and now > s.deadline_at:
+                record_event("deadline_exceeded")
+                self._retire(i, "deadline")
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if active:
+            if self._step_fn is None:
+                self._step_fn = self._build_step_fn()
+            for i in active:
+                self._ensure_blocks(i)
+            _faults.maybe_fire("decode.dispatch")
+            if self._dirty:
+                self._dev = (jnp.asarray(self._tables),
+                             jnp.asarray(self._positions),
+                             jnp.asarray(self._toks),
+                             jnp.asarray(self._seeds),
+                             jnp.asarray(self._counts),
+                             jnp.asarray(self._kv_scales))
+                self._dirty = False
+            d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
+                self.kv_pool, *self._dev)
+            # toks <- sampled ids; tables/seeds/scales are event-driven
+            self._dev = (self._dev[0], d_pos, d_nxt, self._dev[3], d_cnt,
+                         self._dev[5])
+            nxt = np.asarray(d_nxt)
+            self.stats["steps"] += 1
+            self.stats["decode_tokens"] += len(active)
+            self.stats["idle_slot_steps"] += self.max_slots - len(active)
+            r = registry()
+            r.counter("serving.steps").inc()
+            r.counter("serving.tokens_generated").inc(len(active))
+            r.counter("serving.idle_slot_steps").inc(
+                self.max_slots - len(active))
+            for i in active:
+                s = self._slots[i]
+                tok = int(nxt[i])
+                s.tokens.append(tok)
+                s.tok = tok
+                s.pos += 1
+                s.count += 1
+                self._positions[i] = s.pos
+                self._toks[i] = tok
+                self._counts[i] = s.count
+                eos = self.eos_token_id
+                if eos is not None and tok == int(eos):
+                    self._retire(i, "eos")
+                elif s.count >= s.req.max_new_tokens:
+                    self._retire(i, "length")
+        self._update_gauges()
+        return dict(active=self.active_slots, queued=len(self._queue),
+                    finished=self._finished_tick)
+
+    def pop_result(self, request_id: int) -> RequestResult:
+        """Remove and return a finished request's result. ``results``
+        retains every finished request until collected — a long-running
+        server must pop (or periodically clear) results or host memory
+        grows with every request ever served."""
+        return self.results.pop(request_id)
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[int,
+                                                             RequestResult]:
+        """Step until every submitted request has finished (or
+        ``max_steps`` elapsed). Returns ``self.results``."""
+        steps = 0
+        while not self.idle:
+            # stall probe: a step that BEGINS with every slot free runs
+            # _admit with the whole pool reclaimable (prefix cache
+            # already squeezed via evict_free) and nothing in flight to
+            # retire — if it still admits nothing, no future step can,
+            # and looping would spin forever (e.g. an int8-pool request
+            # whose worst case exceeds the whole pool — submit's
+            # never-fits check is deliberately optimistic about prefix
+            # sharing). A step that merely ENDS idle is not a stall: its
+            # retirements feed the next step's admission.
+            q0 = len(self._queue) if self.active_slots == 0 else -1
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+            if q0 > 0 and self.active_slots == 0 and len(self._queue) == q0:
+                head = self._queue[0]
+                raise PoolExhausted(
+                    f"drain stalled: request {head.request_id} "
+                    f"({len(head.prompt)}+{head.max_new_tokens} tokens) "
+                    f"cannot be admitted even with an idle engine")
+        return self.results
+
+    def generate(self, prompts: Sequence, **req_kwargs) -> List[np.ndarray]:
+        """Batch convenience: submit every prompt, drain, return the
+        ``prompt+tokens`` id rows in submission order."""
+        ids = [self.submit(Request(np.asarray(p).reshape(-1), **req_kwargs))
+               for p in prompts]
+        self.drain()
+        return [self.results[i].ids for i in ids]
