@@ -1,0 +1,248 @@
+"""Open-loop serving load harness: the latency-vs-throughput curve.
+
+``serving_bench.py`` answers "how much faster is continuous batching
+than static batching" with a *closed* virtual clock — useful for the
+A/B, useless for SLOs: closed-loop arrival generators slow down when
+the server slows down, which hides exactly the queueing tails
+production traffic produces. This harness drives the
+``ServingEngine`` **open-loop**: arrivals land at wall-clock times
+drawn independently of engine progress (Poisson, or bursty on-off),
+so when the engine falls behind, the queue — and TTFT — grow the way
+they do under real overload.
+
+The sweep: offered load is expressed as multiples of the engine's
+*calibrated* capacity (a closed-loop saturated drain measures
+tokens/s, converted to requests/s via the mean budget), so
+``--loads 0.5,0.9,1.5`` means the same thing on a laptop CPU and a
+v5e. Each point emits one ``paddle_tpu.bench/v1`` record carrying the
+percentile fields (``observability.SLOReport``): p50/p95/p99
+TTFT/TPOT, token-weighted **goodput-under-SLO** against the
+``(--slo_ttft_s, --slo_tpot_s)`` target, offered vs achieved request
+rate, and the per-segment step-time breakdown from the engine stats.
+A final record names the **goodput knee** — the highest offered load
+whose goodput still clears ``--knee_goodput`` — which is the serving
+headline ROADMAP's SLO item asks for (and the regression baseline the
+chunked-prefill / speculative PRs will move). Run:
+
+    python examples/load_bench.py [--model llama-medium]
+        [--arrivals poisson|bursty] [--loads 0.5,0.9,1.5]
+        [--slo_ttft_s 2.0] [--slo_tpot_s 0.25]
+        [--flight_dump /tmp/flight.jsonl]
+
+Prefix caching is off here (random prompts never share blocks) and
+prompt lengths quantize to few pad shapes, keeping prefill compile
+churn out of the measured tails; the first sweep point still pays any
+residual compiles, so compare points within a run, not across runs.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from serving_bench import build_model
+
+
+def make_requests(ns, rng):
+    """N requests with uniform prompt lengths / budgets (the queueing
+    dynamics, not the length mix, are under test here)."""
+    reqs = []
+    for _ in range(ns.requests):
+        plen = int(rng.randint(ns.min_prompt, ns.max_prompt + 1))
+        budget = int(rng.randint(ns.min_new, ns.max_new + 1))
+        reqs.append(dict(prompt=rng.randint(3, ns.vocab, (plen,)),
+                         budget=budget))
+    return reqs
+
+
+def gen_arrivals(n, rps, mode, rng, on_s=0.5, off_s=0.5):
+    """Wall-clock arrival offsets (seconds from t0) for ``n`` requests
+    at mean rate ``rps``.
+
+    ``poisson``: i.i.d. exponential gaps. ``bursty``: on-off modulated
+    Poisson — exponential ON windows (mean ``on_s``) arriving at
+    ``rps / duty`` so the long-run mean is still ``rps``, separated by
+    exponential OFF gaps (mean ``off_s``); the bursts are what stress
+    admission and the queue."""
+    if mode == "poisson":
+        return np.cumsum(rng.exponential(1.0 / rps, n))
+    duty = on_s / (on_s + off_s)
+    rate_on = rps / duty
+    out = []
+    t = 0.0
+    while len(out) < n:
+        on_end = t + rng.exponential(on_s)
+        while len(out) < n:
+            t += rng.exponential(1.0 / rate_on)
+            if t > on_end:
+                break
+            out.append(t)
+        t = max(t, on_end) + rng.exponential(off_s)
+    return np.asarray(out[:n])
+
+
+def drive_open_loop(eng, reqs, arrivals):
+    """Submit request i once the wall clock passes ``arrivals[i]``,
+    stepping the engine regardless of queue state (open loop). Returns
+    wall seconds from first arrival epoch to full drain."""
+    from paddle_tpu import serving
+
+    n = len(reqs)
+    i = 0
+    t0 = time.perf_counter()
+    while i < n or not eng.idle:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            r = reqs[i]
+            eng.submit(serving.Request(r["prompt"],
+                                       max_new_tokens=r["budget"]))
+            i += 1
+        if eng.idle and i < n:
+            # nothing in flight: sleep toward the next arrival instead
+            # of spinning the scheduler against an empty batch
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+            continue
+        eng.step()
+    return time.perf_counter() - t0
+
+
+def calibrate(eng, reqs):
+    """Closed-loop saturated pass: submit everything at t=0, drain.
+    Doubles as compile warmup (prefill shapes + the step program) and
+    yields the capacity estimate the load multiples are scaled by."""
+    from paddle_tpu import serving
+
+    t0 = time.perf_counter()
+    for r in reqs:
+        eng.submit(serving.Request(r["prompt"],
+                                   max_new_tokens=r["budget"]))
+        eng.step()          # staggered submits compile small-wave shapes
+    eng.drain()
+    wall = time.perf_counter() - t0
+    st = eng.stats
+    tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall
+    mean_budget = sum(r["budget"] for r in reqs) / len(reqs)
+    return tok_s, tok_s / mean_budget       # tokens/s, requests/s
+
+
+def step_breakdown(stats):
+    steps = max(stats["steps"], 1)
+    return {k: round(stats[f"step_{k}_s"] / steps, 6)
+            for k in ("admit", "prefill", "dispatch", "sync")}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--requests", type=int, default=48,
+                    help="requests per offered-load point")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--block_tokens", type=int, default=32)
+    ap.add_argument("--max_seq_len", type=int, default=None)
+    ap.add_argument("--min_prompt", type=int, default=8)
+    ap.add_argument("--max_prompt", type=int, default=24)
+    ap.add_argument("--min_new", type=int, default=8)
+    ap.add_argument("--max_new", type=int, default=32)
+    ap.add_argument("--arrivals", choices=("poisson", "bursty"),
+                    default="poisson")
+    ap.add_argument("--burst_on_s", type=float, default=0.5)
+    ap.add_argument("--burst_off_s", type=float, default=0.5)
+    ap.add_argument("--loads", default="0.5,0.9,1.5",
+                    help="offered load as multiples of calibrated "
+                    "capacity (comma list; >1 is deliberate overload — "
+                    "that is where the knee lives)")
+    ap.add_argument("--slo_ttft_s", type=float, default=2.0)
+    ap.add_argument("--slo_tpot_s", type=float, default=0.25)
+    ap.add_argument("--knee_goodput", type=float, default=0.9,
+                    help="goodput threshold defining the knee")
+    ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--flight_dump", default=None,
+                    help="flight-recorder auto-dump path (postmortems "
+                    "on fault/pool/deadline events)")
+    ap.add_argument("--seed", type=int, default=0)
+    ns = ap.parse_args()
+
+    dev = jax.devices()[0]
+    name = ns.model or ("llama-345m" if dev.platform == "tpu"
+                        else "llama-medium")
+    cfg, model = build_model(name)
+    ns.vocab = cfg.vocab_size
+    if ns.max_seq_len is None:
+        need = ns.max_prompt + ns.max_new
+        ns.max_seq_len = -(-need // ns.block_tokens) * ns.block_tokens
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(
+        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+        max_seq_len=ns.max_seq_len,
+        cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
+        prefix_caching=False, flight_dump_path=ns.flight_dump)
+
+    rng = np.random.RandomState(ns.seed)
+    reqs = make_requests(ns, rng)
+    calibrate(eng, reqs)                # cold pass: compiles dominate
+    eng.reset_stats()
+    eng.results.clear()
+    cap_tok_s, cap_rps = calibrate(eng, reqs)   # warm pass: the estimate
+    print(f"# calibrated capacity: {cap_tok_s:.1f} tokens/s "
+          f"~ {cap_rps:.2f} req/s", file=sys.stderr)
+
+    curve = []
+    loads = [float(x) for x in ns.loads.split(",") if x]
+    for mult in loads:
+        rps = mult * cap_rps
+        arrivals = gen_arrivals(ns.requests, rps, ns.arrivals, rng,
+                                ns.burst_on_s, ns.burst_off_s)
+        eng.reset_stats()
+        eng.results.clear()
+        wall = drive_open_loop(eng, reqs, arrivals)
+        rep = obs.SLOReport(ns.slo_ttft_s, ns.slo_tpot_s)
+        for res in eng.results.values():
+            rep.add(res.ttft_s, res.tpot_s, tokens=max(1, res.gen_len))
+        st = eng.stats
+        tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall
+        rec = obs.bench_record(
+            f"{name} open-loop {ns.arrivals} {mult:g}x tokens/s",
+            round(tok_s, 1), "tokens/s", device=dev.device_kind,
+            timing="wall", batch=ns.slots, mode=ns.arrivals,
+            load_mult=mult, n_requests=ns.requests,
+            offered_rps=round(rps, 4),
+            achieved_rps=round(st["requests_finished"] / wall, 4),
+            occupancy=round(st["decode_tokens"] / max(
+                st["decode_tokens"] + st["idle_slot_steps"], 1), 3),
+            step_breakdown_s=step_breakdown(st),
+            **rep.bench_fields())
+        print(json.dumps(rec))
+        curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),
+                          tokens_per_s=round(tok_s, 1),
+                          goodput=rec["goodput"],
+                          ttft_p99_s=rec["ttft_p99_s"],
+                          tpot_p99_s=rec["tpot_p99_s"]))
+
+    # the knee: highest offered load still clearing the goodput bar —
+    # the number a capacity planner actually provisions against
+    good = [c for c in curve if c["goodput"] >= ns.knee_goodput]
+    knee = max(good, key=lambda c: c["offered_rps"]) if good else None
+    rec = obs.bench_record(
+        f"{name} goodput-under-SLO knee ({ns.arrivals})",
+        knee["offered_rps"] if knee else 0.0, "req/s",
+        device=dev.device_kind, timing="wall",
+        slo_ttft_s=ns.slo_ttft_s, slo_tpot_s=ns.slo_tpot_s,
+        knee_goodput=ns.knee_goodput,
+        knee_load_mult=knee["load_mult"] if knee else None,
+        calibrated_capacity_rps=round(cap_rps, 4), curve=curve)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

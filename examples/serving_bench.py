@@ -218,6 +218,12 @@ def main():
     ap.add_argument("--reps", type=int, default=3,
                     help="interleaved static/continuous pass pairs "
                     "(best wall per side kept)")
+    ap.add_argument("--slo_ttft_s", type=float, default=None,
+                    help="TTFT target: with either SLO set the "
+                    "continuous record reports token-weighted "
+                    "goodput-under-SLO (examples/load_bench.py is the "
+                    "open-loop harness built around that number)")
+    ap.add_argument("--slo_tpot_s", type=float, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -283,10 +289,13 @@ def main():
         st["decode_tokens"] + st["idle_slot_steps"], 1)
     prefix_hit = (eng.prefix_cache.hit_rate
                   if eng.prefix_cache is not None else 0.0)
-    ttfts = sorted(r.ttft_s for r in eng.results.values())
-    ttft_p50 = ttfts[len(ttfts) // 2]
 
     from paddle_tpu import observability as obs
+    # per-request tail latency over the measured pass (the sketch's 1%
+    # relative error is far under run-to-run CPU noise)
+    slo = obs.SLOReport(ns.slo_ttft_s, ns.slo_tpot_s)
+    for r in eng.results.values():
+        slo.add(r.ttft_s, r.tpot_s, tokens=max(1, r.gen_len))
     common = dict(device=dev.device_kind, batch=ns.slots,
                   n_requests=ns.requests,
                   prompt_len=ns.sys_prompt_len + ns.max_prompt,
@@ -312,9 +321,8 @@ def main():
         prefix_hit_rate=round(prefix_hit, 3),
         prefill_tokens=st["prefill_tokens"],
         prefill_tokens_reused=st["prefill_tokens_reused"],
-        ttft_p50_s=round(ttft_p50, 4),
         pool_blocks=eng.pool.num_blocks - 1,
-        block_tokens=ns.block_tokens, **common)))
+        block_tokens=ns.block_tokens, **slo.bench_fields(), **common)))
 
 
 if __name__ == "__main__":

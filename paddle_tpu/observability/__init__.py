@@ -16,6 +16,13 @@ One subsystem, four pieces (docs/OBSERVABILITY.md has the full story):
   record all benches emit + span validation.
 * **Memory telemetry** (`memory.py`): live-HBM / allocator stats /
   compiled-executable accounting as registry gauges.
+* **SLO quantiles** (`slo.py`): DDSketch-style streaming quantile
+  sketch (`registry().sketch(...)`) + `SLOReport` folding per-request
+  TTFT/TPOT into p50/p95/p99 and goodput-under-SLO bench fields.
+* **Flight recorder** (`flight.py`): fixed-size ring of per-step
+  serving-engine events, auto-dumped to JSONL at the resilience seams
+  (fired fault / `PoolExhausted` / deadline retirement) for
+  postmortems.
 
 Roofline attribution lives with the xplane parser:
 `paddle_tpu.profiler.roofline_report(log_dir, plan)`.
@@ -32,8 +39,16 @@ from paddle_tpu.observability.schema import (     # noqa: F401
     BENCH_SCHEMA, bench_record, validate_bench, validate_spans,
     validate_roofline_plan,
 )
+from paddle_tpu.observability.slo import (        # noqa: F401
+    QuantileSketch, SLOReport,
+)
+from paddle_tpu.observability.flight import (     # noqa: F401
+    FLIGHT_SCHEMA, FlightRecorder,
+)
+from paddle_tpu.observability import flight       # noqa: F401
 from paddle_tpu.observability import memory       # noqa: F401
 from paddle_tpu.observability import schema       # noqa: F401
+from paddle_tpu.observability import slo          # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -41,5 +56,7 @@ __all__ = [
     "Span", "Tracer", "attach", "detach", "active_tracer", "trace",
     "run_traced_decode",
     "BENCH_SCHEMA", "bench_record", "validate_bench", "validate_spans",
-    "validate_roofline_plan", "memory", "schema",
+    "validate_roofline_plan",
+    "QuantileSketch", "SLOReport", "FLIGHT_SCHEMA", "FlightRecorder",
+    "flight", "memory", "schema", "slo",
 ]

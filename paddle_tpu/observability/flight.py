@@ -1,0 +1,141 @@
+"""Serving flight recorder: a fixed-size ring of per-step events.
+
+When a TPOT spike or a pool stall hits a production engine, the gauges
+say *that* something went wrong; this module records *what the last N
+scheduler steps actually did* so the failure is reconstructable after
+the fact. The ``ServingEngine`` writes one compact event per ``step()``
+(admissions, retirements with finish reason, occupancy, queue depth,
+pool blocks used, prefill wave shapes, per-segment wall times) into a
+preallocated ring — steady-state cost is one small dict and a ring
+write, no I/O.
+
+``dump_jsonl()`` snapshots the ring to JSONL on demand: one
+``paddle_tpu.flight/v1`` header line (reason, timestamp, event count)
+followed by the events oldest-first. **Auto-dump** wires the snapshot
+to the resilience seams (docs/RESILIENCE.md): a fired ``FaultPlan``
+site (``faults._count_fired`` calls :func:`auto_dump_all`), a
+``PoolExhausted``, and a deadline retirement each dump the last N
+steps — but only when the recorder was given an ``auto_dump_path``
+(``ServingEngine(flight_dump_path=...)``); with no path configured
+auto-dump is a no-op, so tests and embedded uses never write files as
+a side effect.
+"""
+
+import json
+import logging
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+from paddle_tpu.observability.registry import append_jsonl_lines
+
+logger = logging.getLogger("paddle_tpu.observability")
+
+__all__ = ["FLIGHT_SCHEMA", "FlightRecorder", "auto_dump_all"]
+
+FLIGHT_SCHEMA = "paddle_tpu.flight/v1"
+
+# every live recorder, for auto_dump_all (fault seam); weak so an
+# engine's recorder dies with the engine
+_recorders: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+
+
+class FlightRecorder:
+    """Fixed-capacity ring buffer of per-step event dicts.
+
+    ``record`` overwrites the oldest event once ``capacity`` is
+    exceeded — the ring always holds exactly the last
+    ``min(total_events, capacity)`` events (wraparound pinned by
+    tests/test_slo.py). Events must be JSON-serializable.
+    """
+
+    def __init__(self, capacity: int = 256,
+                 auto_dump_path: Optional[str] = None,
+                 name: str = "flight"):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.auto_dump_path = auto_dump_path
+        self.name = name
+        self._buf: List[Optional[Dict]] = [None] * self.capacity
+        self._n = 0                      # total events ever recorded
+        self._lock = threading.Lock()
+        _recorders.add(self)
+
+    def record(self, event: Dict):
+        with self._lock:
+            self._buf[self._n % self.capacity] = event
+            self._n += 1
+
+    @property
+    def total_events(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def _snapshot(self):
+        """(events oldest-first, total recorded) under ONE lock hold —
+        dump headers must agree with the events they describe even with
+        a concurrent recorder thread."""
+        with self._lock:
+            n, cap = self._n, self.capacity
+            if n <= cap:
+                return list(self._buf[:n]), n
+            start = n % cap
+            return self._buf[start:] + self._buf[:start], n
+
+    def events(self) -> List[Dict]:
+        """The retained events, oldest first."""
+        return self._snapshot()[0]
+
+    def dump(self) -> List[Dict]:
+        return self.events()
+
+    def dump_jsonl(self, path: Optional[str] = None,
+                   reason: str = "manual") -> Optional[str]:
+        """Append a header line + the retained events to ``path``
+        (default: ``auto_dump_path``). Returns the path written, or
+        None when neither is set. Appending means repeated dumps stack
+        in one file; a postmortem reads from the LAST header line."""
+        path = path if path is not None else self.auto_dump_path
+        if path is None:
+            return None
+        events, total = self._snapshot()
+        header = {"schema": FLIGHT_SCHEMA, "kind": "flight_dump",
+                  "name": self.name, "reason": reason,
+                  "ts": time.time(), "events": len(events),
+                  "total_recorded": total}
+        append_jsonl_lines(path, [json.dumps(header)]
+                           + [json.dumps(e) for e in events])
+        return path
+
+    def auto_dump(self, reason: str) -> Optional[str]:
+        """Dump iff an ``auto_dump_path`` is configured (else no-op) —
+        the form every resilience-seam trigger calls. NEVER raises: a
+        broken dump sink (missing directory, read-only disk) must not
+        mask the failure being recorded — the engine calls this while
+        re-raising ``PoolExhausted``/injected faults, and an I/O error
+        here would replace the real exception. Use ``dump_jsonl``
+        directly when a write failure should surface."""
+        if self.auto_dump_path is None:
+            return None
+        try:
+            return self.dump_jsonl(self.auto_dump_path, reason=reason)
+        except Exception:
+            logger.warning("flight recorder %r: auto-dump to %s failed",
+                           self.name, self.auto_dump_path, exc_info=True)
+            return None
+
+
+def auto_dump_all(reason: str) -> List[str]:
+    """Auto-dump every live recorder (those with a path configured).
+    Called from ``resilience.faults`` when a fault fires; like
+    ``auto_dump`` it never raises."""
+    out = []
+    for rec in list(_recorders):
+        p = rec.auto_dump(reason)
+        if p is not None:
+            out.append(p)
+    return out

@@ -106,6 +106,32 @@ class Histogram:
     def mean(self) -> Optional[float]:
         return self.sum / self.count if self.count else None
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Prometheus ``histogram_quantile`` over the cumulative ``le``
+        buckets: find the first bucket whose cumulative count reaches
+        ``q * count`` and interpolate linearly inside it (uniform-
+        within-bucket assumption; the lowest bucket's lower edge is 0).
+        A rank landing in the +Inf overflow returns the highest finite
+        bound — exactly Prometheus's behavior. None while empty.
+
+        Accuracy is bounded by the bucket layout — for tight tail
+        quantiles use ``MetricsRegistry.sketch`` (bounded *relative*
+        error at any quantile)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self.count == 0:
+                return None
+            target = q * self.count
+            cum = 0
+            for i, b in enumerate(self.bounds):
+                prev = cum
+                cum += self.counts[i]
+                if cum >= target and self.counts[i]:
+                    lo = self.bounds[i - 1] if i else 0.0
+                    return lo + (b - lo) * (target - prev) / self.counts[i]
+            return self.bounds[-1]
+
     def snapshot(self) -> dict:
         return {"name": self.name, "type": self.kind,
                 "labels": dict(self.labels),
@@ -175,6 +201,14 @@ class MetricsRegistry:
             raise ValueError(
                 f"histogram {name!r}{dict(merged)} already exists with "
                 f"buckets {m.bounds}; requested {tuple(sorted(kw['buckets']))}")
+        if kw.get("relative_accuracy") is not None \
+                and m.relative_accuracy != float(kw["relative_accuracy"]):
+            # same contract for sketches: a silently different accuracy
+            # would change the error bound callers rely on
+            raise ValueError(
+                f"sketch {name!r}{dict(merged)} already exists with "
+                f"relative_accuracy {m.relative_accuracy}; requested "
+                f"{float(kw['relative_accuracy'])}")
         return m
 
     # positional-only metric names: labels may legitimately be called
@@ -189,14 +223,27 @@ class MetricsRegistry:
                   **labels) -> Histogram:
         return self._get(Histogram, name, labels, buckets=buckets)
 
+    def sketch(self, name: str, /,
+               relative_accuracy: Optional[float] = None, **labels):
+        """DDSketch-style streaming quantile sketch
+        (:class:`observability.slo.QuantileSketch`): bounded relative
+        error at ANY quantile — the tool for latency tails, where a
+        fixed bucket layout can't promise accuracy. Exported by
+        ``prometheus_text`` as a summary with quantile labels."""
+        from paddle_tpu.observability.slo import QuantileSketch
+        return self._get(QuantileSketch, name, labels,
+                         relative_accuracy=relative_accuracy)
+
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> List[dict]:
         return [m.snapshot() for m in list(self._metrics.values())]
 
     def export_jsonl(self, path: str, extra: Optional[Dict] = None) -> int:
-        """Append one JSON line per metric (single O_APPEND write per line
-        — safe under concurrent per-rank writers). Returns lines written."""
+        """Append one JSON line per metric. The whole snapshot goes out
+        as ONE O_APPEND write (``append_jsonl_lines``), so concurrent
+        per-rank writers sharing a path interleave only between whole
+        snapshots, never inside a line. Returns lines written."""
         ts = time.time()
         lines = []
         for snap in self.snapshot():
@@ -213,10 +260,22 @@ class MetricsRegistry:
         for snap in self.snapshot():
             name = _prom_name(snap["name"])
             if name not in seen_types:
-                out.append(f"# TYPE {name} {snap['type']}")
+                # a sketch is exposed in the summary exposition shape
+                # (quantile-labeled gauges + _sum/_count)
+                ptype = ("summary" if snap["type"] == "sketch"
+                         else snap["type"])
+                out.append(f"# TYPE {name} {ptype}")
                 seen_types.add(name)
             labels = snap["labels"]
-            if snap["type"] == "histogram":
+            if snap["type"] == "sketch":
+                for q, v in snap["quantiles"].items():
+                    if v is not None:
+                        out.append(
+                            f"{name}{_prom_labels(labels, quantile=q)} {v}")
+                out.append(f"{name}_sum{_prom_labels(labels)} {snap['sum']}")
+                out.append(f"{name}_count{_prom_labels(labels)} "
+                           f"{snap['count']}")
+            elif snap["type"] == "histogram":
                 cum = 0
                 for bound, cnt in snap["buckets"].items():
                     cum += cnt
@@ -233,7 +292,13 @@ class MetricsRegistry:
         return "\n".join(out) + ("\n" if out else "")
 
     def reset(self):
-        """Drop all metrics and default labels (test isolation)."""
+        """Drop all metrics AND the default labels (test isolation).
+
+        The label drop is deliberate but easy to trip over: after
+        ``fleet.init`` has set ``rank=...``, a ``reset()`` leaves the
+        registry untagged — metrics created afterwards carry no rank
+        until ``set_default_labels`` runs again (re-init, or re-set
+        explicitly in tests that reset between phases)."""
         with self._lock:
             self._metrics.clear()
             self._default_labels.clear()

@@ -43,6 +43,21 @@ _BENCH_OPTIONAL = {
     "final_loss": numbers.Real,
     "roofline_plan": dict,
     "memory": dict,
+    # SLO / tail-latency fields (observability.slo.SLOReport.bench_fields
+    # emits them): percentile TTFT/TPOT in seconds, offered vs achieved
+    # open-loop request rate, and token-weighted goodput under a
+    # (slo_ttft_s, slo_tpot_s) target
+    "ttft_p50_s": numbers.Real,
+    "ttft_p95_s": numbers.Real,
+    "ttft_p99_s": numbers.Real,
+    "tpot_p50_s": numbers.Real,
+    "tpot_p95_s": numbers.Real,
+    "tpot_p99_s": numbers.Real,
+    "offered_rps": numbers.Real,
+    "achieved_rps": numbers.Real,
+    "goodput": numbers.Real,
+    "slo_ttft_s": numbers.Real,
+    "slo_tpot_s": numbers.Real,
 }
 
 
@@ -68,6 +83,10 @@ def validate_bench(rec: Dict) -> Dict:
             problems.append(
                 f"field {field!r} must be {getattr(typ, '__name__', typ)} "
                 f"or null, got {type(v).__name__}")
+    g = rec.get("goodput")
+    if isinstance(g, numbers.Real) and not isinstance(g, bool) \
+            and not 0.0 <= g <= 1.0:
+        problems.append(f"goodput must be in [0, 1], got {g}")
     if "roofline_plan" in rec and isinstance(rec["roofline_plan"], dict):
         try:
             validate_roofline_plan(rec["roofline_plan"])

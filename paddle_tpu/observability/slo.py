@@ -1,0 +1,220 @@
+"""Streaming latency quantiles + SLO accounting (the percentile layer).
+
+Production serving is judged by p50/p99 TTFT/TPOT and goodput-under-SLO,
+not raw tokens/s (ROADMAP "SLO-aware scheduling"). The registry's
+fixed-bucket `Histogram` can answer coarse quantile questions
+(`Histogram.quantile`, Prometheus-style interpolation), but its
+relative error blows up wherever the bucket layout is sparse. This
+module adds the precise tool:
+
+* **QuantileSketch** — a DDSketch-style log-spaced-bucket sketch:
+  every observation lands in bucket ``ceil(log_gamma(v))`` with
+  ``gamma = (1+a)/(1-a)``, so any quantile is answered within relative
+  error ``a`` (default 1%) from a few KB of preallocated counts.
+  ``observe`` is allocation-free like ``Histogram.observe`` (one log +
+  one int add under the lock); registry integration via
+  ``registry().sketch(name)`` exports through ``export_jsonl`` and
+  ``prometheus_text`` (as a summary with quantile labels).
+* **SLOReport** — folds per-request ``(ttft_s, tpot_s, tokens)``
+  samples into p50/p95/p99 TTFT/TPOT plus **goodput-under-SLO**: the
+  token-weighted fraction of requests meeting a ``(ttft_s, tpot_s)``
+  target. ``bench_fields()`` returns the optional percentile fields of
+  the ``paddle_tpu.bench/v1`` schema, which is how
+  ``examples/load_bench.py`` and ``examples/serving_bench.py`` put
+  tail latency on the bench record.
+
+Accuracy contract (pinned by tests/test_slo.py): ``quantile(q)``
+returns a value within ``relative_accuracy`` of the sample at rank
+``max(1, ceil(q * count))`` — the ``numpy.percentile(...,
+method="inverted_cdf")`` convention — for any distribution whose
+values lie in ``[min_value, max_value]``.
+"""
+
+import math
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = ["QuantileSketch", "SLOReport", "DEFAULT_QUANTILES"]
+
+# the quantiles snapshot()/prometheus export answer by default
+DEFAULT_QUANTILES = (0.5, 0.9, 0.95, 0.99)
+
+
+class QuantileSketch:
+    """DDSketch-style streaming quantile sketch with bounded relative
+    error.
+
+    Bucket ``i`` covers ``(gamma^(i-1), gamma^i]``; the estimate for a
+    rank landing in bucket ``i`` is the bucket's harmonic midpoint
+    ``2 * gamma^i / (gamma + 1)``, whose relative error against any
+    value in the bucket is at most ``relative_accuracy``. Values in
+    ``(0, min_value]`` (and the occasional non-positive outlier — e.g.
+    a clock-skewed 0-duration) collapse into a zero bucket answered as
+    ``0.0``; values above ``max_value`` clamp into the last bucket.
+    Estimates are additionally clamped to the observed ``[min, max]``,
+    so single-valued streams are answered exactly.
+    """
+
+    __slots__ = ("name", "labels", "relative_accuracy", "counts", "count",
+                 "sum", "min", "max", "zero_count", "_gamma", "_log_gamma",
+                 "_min_value", "_max_value", "_offset", "_lock")
+    kind = "sketch"
+
+    def __init__(self, name: str = "", labels: Tuple = (),
+                 relative_accuracy: Optional[float] = None,
+                 min_value: float = 1e-6, max_value: float = 1e5):
+        a = 0.01 if relative_accuracy is None else float(relative_accuracy)
+        if not 0.0 < a < 1.0:
+            raise ValueError(
+                f"relative_accuracy must be in (0, 1), got {a}")
+        if not 0.0 < min_value < max_value:
+            raise ValueError(
+                f"need 0 < min_value < max_value, got "
+                f"({min_value}, {max_value})")
+        self.name = name
+        self.labels = labels
+        self.relative_accuracy = a
+        self._gamma = (1.0 + a) / (1.0 - a)
+        self._log_gamma = math.log(self._gamma)
+        self._min_value = float(min_value)
+        self._max_value = float(max_value)
+        self._offset = int(math.ceil(
+            math.log(min_value) / self._log_gamma))
+        nb = int(math.ceil(math.log(max_value) / self._log_gamma)) \
+            - self._offset + 1
+        # preallocated once (1% accuracy over [1e-6, 1e5] s is ~1300
+        # ints): observe never allocates, mirroring Histogram
+        self.counts = [0] * nb
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def _index(self, v: float) -> int:
+        i = int(math.ceil(math.log(v) / self._log_gamma)) - self._offset
+        return min(max(i, 0), len(self.counts) - 1)
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if v <= self._min_value:
+                self.zero_count += 1
+            else:
+                self.counts[self._index(v)] += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at rank ``max(1, ceil(q * count))`` within
+        ``relative_accuracy`` (None while empty)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self.count == 0:
+                return None
+            # the 1e-9 slack keeps ceil() from bumping a rank whose
+            # q*count is mathematically integral but lands epsilon high
+            # in floats (0.999*5000 = 4995.000000000001) — matching
+            # numpy.percentile(method="inverted_cdf") exactly
+            rank = max(1, int(math.ceil(q * self.count - 1e-9)))
+            if rank <= self.zero_count:
+                return max(0.0, self.min)
+            cum = self.zero_count
+            for i, c in enumerate(self.counts):
+                cum += c
+                if cum >= rank:
+                    est = (2.0 * self._gamma ** (i + self._offset)
+                           / (self._gamma + 1.0))
+                    return min(max(est, self.min), self.max)
+            return self.max
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def snapshot(self) -> dict:
+        qs = {("%g" % q): self.quantile(q) for q in DEFAULT_QUANTILES}
+        return {"name": self.name, "type": self.kind,
+                "labels": dict(self.labels),
+                "relative_accuracy": self.relative_accuracy,
+                "quantiles": qs, "min": self.min, "max": self.max,
+                "sum": self.sum, "count": self.count}
+
+
+def _round6(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(float(v), 6)
+
+
+class SLOReport:
+    """Per-request TTFT/TPOT samples folded into percentiles + goodput.
+
+    ``add(ttft_s, tpot_s, tokens)`` once per finished request
+    (``tpot_s=None`` for one-token requests — they have no decode steps
+    and cannot miss a TPOT target). A request is *good* when it meets
+    BOTH targets; **goodput** is the token-weighted fraction
+    ``good_tokens / tokens`` — a 500-token answer that blows its SLO
+    costs 500 tokens of goodput, not 1/N of a request count. Targets
+    left as ``None`` are not enforced (and with neither set, goodput is
+    omitted from ``bench_fields()`` rather than reported as a
+    vacuous 1.0).
+    """
+
+    def __init__(self, ttft_slo_s: Optional[float] = None,
+                 tpot_slo_s: Optional[float] = None,
+                 relative_accuracy: float = 0.01):
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        self.ttft = QuantileSketch("ttft_s",
+                                   relative_accuracy=relative_accuracy)
+        self.tpot = QuantileSketch("tpot_s",
+                                   relative_accuracy=relative_accuracy)
+        self.requests = 0
+        self.good_requests = 0
+        self.tokens = 0
+        self.good_tokens = 0
+
+    def add(self, ttft_s: float, tpot_s: Optional[float],
+            tokens: int = 1) -> bool:
+        """Record one finished request; returns whether it met the SLO."""
+        self.requests += 1
+        self.tokens += int(tokens)
+        self.ttft.observe(ttft_s)
+        if tpot_s is not None:
+            self.tpot.observe(tpot_s)
+        good = not (self.ttft_slo_s is not None
+                    and ttft_s > self.ttft_slo_s) \
+            and not (self.tpot_slo_s is not None and tpot_s is not None
+                     and tpot_s > self.tpot_slo_s)
+        if good:
+            self.good_requests += 1
+            self.good_tokens += int(tokens)
+        return good
+
+    @property
+    def goodput(self) -> float:
+        """Token-weighted fraction of requests meeting the SLO target."""
+        return self.good_tokens / self.tokens if self.tokens else 0.0
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {
+            "ttft_p50_s": _round6(self.ttft.quantile(0.5)),
+            "ttft_p95_s": _round6(self.ttft.quantile(0.95)),
+            "ttft_p99_s": _round6(self.ttft.quantile(0.99)),
+            "tpot_p50_s": _round6(self.tpot.quantile(0.5)),
+            "tpot_p95_s": _round6(self.tpot.quantile(0.95)),
+            "tpot_p99_s": _round6(self.tpot.quantile(0.99)),
+        }
+
+    def bench_fields(self) -> Dict:
+        """The optional percentile/goodput fields of the
+        ``paddle_tpu.bench/v1`` schema (``schema.validate_bench``),
+        ready to splat into ``bench_record(...)``."""
+        out: Dict = dict(self.percentiles())
+        if self.ttft_slo_s is not None or self.tpot_slo_s is not None:
+            out["goodput"] = round(self.goodput, 4)
+            out["slo_ttft_s"] = self.ttft_slo_s
+            out["slo_tpot_s"] = self.tpot_slo_s
+        return out

@@ -168,6 +168,15 @@ def _count_fired(site: str, kind: str):
     # (and this runs only when a fault actually fires — off the hot path)
     from paddle_tpu.observability import registry
     registry().counter("resilience.faults_fired", site=site, kind=kind).inc()
+    # postmortem seam: a fired fault snapshots every flight recorder that
+    # has an auto-dump path configured (no-op otherwise) BEFORE any
+    # raising kind unwinds the stack — the dump must not depend on the
+    # caller surviving the fault
+    try:
+        from paddle_tpu.observability import flight
+        flight.auto_dump_all(f"fault:{site}:{kind}")
+    except Exception:
+        pass    # telemetry must never mask the injected fault itself
 
 
 _armed: Optional[FaultPlan] = None

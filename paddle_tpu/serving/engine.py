@@ -144,6 +144,14 @@ class ServingEngine:
     prefill is its own calibration pass (per-SLOT scales — an isolated
     b=1 ``generate`` computes the same scales, which is what keeps int8
     parity token-exact).
+
+    Observability: every ``step()`` is wall-timed in four segments
+    (``serving.step_*_s`` histograms), per-request TTFT/TPOT land in
+    the ``serving.ttft_s``/``serving.tpot_s`` quantile sketches, and a
+    flight-recorder ring (last ``flight_capacity`` step events,
+    auto-dumped to ``flight_dump_path`` on a fired fault /
+    ``PoolExhausted`` / deadline retirement) keeps the postmortem
+    trail — docs/OBSERVABILITY.md has the event format.
     """
 
     def __init__(self, model, *, max_slots: int = 4,
@@ -154,8 +162,11 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  prefix_caching: bool = True,
                  prefix_cache_blocks: int = 256,
+                 flight_capacity: int = 256,
+                 flight_dump_path: Optional[str] = None,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
+        from paddle_tpu.observability.flight import FlightRecorder
 
         self.model = model
         self._state = state if state is not None else _inference_state(model)
@@ -248,10 +259,20 @@ class ServingEngine:
         self._dev = None
         self._dirty = True
         self._jit_cache: Dict = {}
-        self.stats = dict(steps=0, decode_tokens=0, idle_slot_steps=0,
-                          prefill_tokens=0, prefill_tokens_reused=0,
-                          requests_finished=0)
+        self.stats = self._fresh_stats()
         self._finished_tick: List[int] = []
+        # flight recorder: one compact event per step() into a fixed
+        # ring; auto-dumped at the resilience seams when a dump path is
+        # configured (fired fault / PoolExhausted / deadline retirement)
+        self.flight = FlightRecorder(capacity=flight_capacity,
+                                     auto_dump_path=flight_dump_path,
+                                     name="serving-engine")
+        self._step_seq = 0              # flight event ordinal
+        self._dump_pending: Optional[str] = None
+        self._tick_admitted: List[int] = []
+        self._tick_retired: List = []
+        self._tick_prefills: List = []
+        self._tick_prefill_s = 0.0
         self._gauges_init()
 
     # ------------------------------------------------------------- helpers
@@ -276,12 +297,24 @@ class ServingEngine:
             r.gauge("serving.prefix_hit_rate").set(
                 self.prefix_cache.hit_rate)
 
+    def _fresh_stats(self) -> Dict:
+        """The ONE definition of the cumulative stats dict — __init__
+        and reset_stats both take it from here, so a new field (the
+        step-segment times, admission count) cannot drift between the
+        two copies. ``step_*_s`` are cumulative wall seconds per step
+        segment; per-step distributions live in the
+        ``serving.step_*_s`` registry histograms."""
+        return dict(steps=0, decode_tokens=0, idle_slot_steps=0,
+                    prefill_tokens=0, prefill_tokens_reused=0,
+                    requests_finished=0, requests_admitted=0,
+                    step_admit_s=0.0, step_prefill_s=0.0,
+                    step_dispatch_s=0.0, step_sync_s=0.0)
+
     def reset_stats(self):
-        """Zero the cumulative throughput counters (and the prefix
-        cache's hit accounting) — bench warmup -> measured pass."""
-        self.stats = dict(steps=0, decode_tokens=0, idle_slot_steps=0,
-                          prefill_tokens=0, prefill_tokens_reused=0,
-                          requests_finished=0)
+        """Zero the cumulative throughput counters and step-segment
+        times (and the prefix cache's hit accounting) — bench warmup ->
+        measured pass."""
+        self.stats = self._fresh_stats()
         if self.prefix_cache is not None:
             self.prefix_cache.hit_blocks = 0
             self.prefix_cache.lookup_blocks = 0
@@ -320,6 +353,7 @@ class ServingEngine:
         lookup = ((P - 1) // self.block_tokens
                   if self.prefix_cache is not None else 0)
         if worst - lookup > self.pool.num_blocks - 1:
+            self.flight.auto_dump("pool_exhausted:submit")
             raise PoolExhausted(
                 f"request needs at least {worst - lookup} blocks; the "
                 f"whole pool has {self.pool.num_blocks - 1}")
@@ -486,6 +520,8 @@ class ServingEngine:
                 slot.ntab = n0
                 self._reserved += worst - n0
                 self._slots[slot_idx] = slot
+                self._tick_admitted.append(req.request_id)
+                self.stats["requests_admitted"] += 1
                 wave.append((slot_idx, slot, hits, R, s_pad))
             if not wave:
                 return
@@ -500,9 +536,12 @@ class ServingEngine:
 
     def _run_prefill_group(self, R, s_pad, grp):
         """Run one batched prefill program and adopt each row's slot
-        into the running decode batch."""
+        into the running decode batch. The whole group (program + host
+        pulls + slot adoption) is timed as the step's wave-prefill
+        segment."""
         from paddle_tpu.observability import registry
 
+        t_pf0 = time.perf_counter()
         n = len(grp)
         BT = self.block_tokens
         L = self._num_layers
@@ -591,6 +630,8 @@ class ServingEngine:
                 self._retire(slot_idx,
                              "eos" if eos is not None
                              and slot.tok == int(eos) else "length")
+        self._tick_prefills.append((R, s_pad, n))
+        self._tick_prefill_s += time.perf_counter() - t_pf0
 
     # -------------------------------------------------------------- decode
     def _build_step_fn(self):
@@ -685,8 +726,19 @@ class ServingEngine:
                             finish, ttft, tpot, s.prefix_hit_blocks)
         self.results[s.req.request_id] = res
         self._finished_tick.append(s.req.request_id)
+        self._tick_retired.append((s.req.request_id, finish))
         self.stats["requests_finished"] += 1
-        registry().counter("serving.requests", finish=finish).inc()
+        r = registry()
+        r.counter("serving.requests", finish=finish).inc()
+        # the SLO percentile layer: per-request TTFT/TPOT land in
+        # bounded-relative-error sketches (docs/OBSERVABILITY.md)
+        r.sketch("serving.ttft_s").observe(ttft)
+        if tpot is not None:
+            r.sketch("serving.tpot_s").observe(tpot)
+        if finish == "deadline":
+            # postmortem seam: snapshot the flight ring once this tick's
+            # event (the one recording this retirement) has been written
+            self._dump_pending = "deadline_retirement"
         tr = obs.active_tracer()
         if tr is not None:
             # _t_submit is monotonic (perf_counter); span ts must share
@@ -704,15 +756,49 @@ class ServingEngine:
     def step(self) -> Dict:
         """One scheduler tick: admit what fits, retire expired deadlines,
         run ONE fused paged decode step for every active slot, retire
-        slots that finished. Returns a small status dict."""
+        slots that finished. Returns a small status dict.
+
+        Each tick is wall-timed in four segments — admit (scheduling +
+        deadline sweep + block-table bookkeeping), wave-prefill, fused
+        decode dispatch (program call; on async backends this is enqueue
+        time), host sync (the sampled-token D2H pull, where device wait
+        surfaces) — recorded into the ``serving.step_*_s`` histograms,
+        the cumulative ``stats["step_*_s"]`` fields and this tick's
+        flight-recorder event, so a TPOT spike is attributable to a
+        phase. A tick that dies mid-flight (injected fault,
+        ``PoolExhausted``) still records a partial event carrying the
+        error, auto-dumps the ring, and re-raises.
+        """
+        self._finished_tick = []
+        self._tick_admitted = []
+        self._tick_retired = []
+        self._tick_prefills = []
+        self._tick_prefill_s = 0.0
+        t0 = time.perf_counter()
+        try:
+            return self._step_inner(t0)
+        except Exception as e:
+            admit_s = max(0.0,
+                          time.perf_counter() - t0 - self._tick_prefill_s)
+            self._record_flight(admit_s, None, None,
+                                err=f"{type(e).__name__}: {e}")
+            self.flight.auto_dump(f"error:{type(e).__name__}")
+            # the error dump supersedes any dump this tick queued (e.g.
+            # a deadline retirement swept just before the dispatch died)
+            # — without this, the NEXT successful tick would emit a
+            # spurious "deadline_retirement" dump
+            self._dump_pending = None
+            raise
+
+    def _step_inner(self, t0: float) -> Dict:
         from paddle_tpu.observability import registry
         from paddle_tpu.resilience import faults as _faults
         from paddle_tpu.resilience import record_event
 
         # every _retire this tick (deadline sweep, instant finish on the
-        # prefill sample inside _admit, decode finish) lands here, so the
-        # returned `finished` list is complete for result collection
-        self._finished_tick = []
+        # prefill sample inside _admit, decode finish) lands in
+        # _finished_tick, so the returned `finished` list is complete
+        # for result collection
         self._admit()
         now = time.perf_counter()
         for i, s in enumerate(self._slots):
@@ -720,6 +806,7 @@ class ServingEngine:
                     and now > s.deadline_at:
                 record_event("deadline_exceeded")
                 self._retire(i, "deadline")
+        dispatch_s = sync_s = None
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if active:
             if self._step_fn is None:
@@ -735,12 +822,20 @@ class ServingEngine:
                              jnp.asarray(self._counts),
                              jnp.asarray(self._kv_scales))
                 self._dirty = False
+        # everything up to the dispatch call is the admit segment
+        # (minus the prefill programs, which _run_prefill_group timed)
+        admit_s = max(0.0, time.perf_counter() - t0 - self._tick_prefill_s)
+        if active:
+            t_d0 = time.perf_counter()
             d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
                 self.kv_pool, *self._dev)
             # toks <- sampled ids; tables/seeds/scales are event-driven
             self._dev = (self._dev[0], d_pos, d_nxt, self._dev[3], d_cnt,
                          self._dev[5])
-            nxt = np.asarray(d_nxt)
+            t_s0 = time.perf_counter()
+            dispatch_s = t_s0 - t_d0
+            nxt = np.asarray(d_nxt)     # host pull == completion fence
+            sync_s = time.perf_counter() - t_s0
             self.stats["steps"] += 1
             self.stats["decode_tokens"] += len(active)
             self.stats["idle_slot_steps"] += self.max_slots - len(active)
@@ -764,9 +859,55 @@ class ServingEngine:
                     self._retire(i, "eos")
                 elif s.count >= s.req.max_new_tokens:
                     self._retire(i, "length")
+        self._record_segments(admit_s, dispatch_s, sync_s)
+        self._record_flight(admit_s, dispatch_s, sync_s)
+        if self._dump_pending is not None:
+            self.flight.auto_dump(self._dump_pending)
+            self._dump_pending = None
         self._update_gauges()
         return dict(active=self.active_slots, queued=len(self._queue),
                     finished=self._finished_tick)
+
+    def _record_segments(self, admit_s, dispatch_s, sync_s):
+        """Step-segment telemetry: cumulative stats + registry
+        histograms. admit is observed every tick; prefill only on ticks
+        that ran a wave, dispatch/sync only on ticks that decoded — so
+        each histogram is the distribution of the segment when it
+        actually happened, not diluted by structural zeros."""
+        from paddle_tpu.observability import registry
+        st = self.stats
+        st["step_admit_s"] += admit_s
+        st["step_prefill_s"] += self._tick_prefill_s
+        r = registry()
+        r.histogram("serving.step_admit_s").observe(admit_s)
+        if self._tick_prefills:
+            r.histogram("serving.step_prefill_s").observe(
+                self._tick_prefill_s)
+        if dispatch_s is not None:
+            st["step_dispatch_s"] += dispatch_s
+            st["step_sync_s"] += sync_s
+            r.histogram("serving.step_dispatch_s").observe(dispatch_s)
+            r.histogram("serving.step_sync_s").observe(sync_s)
+
+    def _record_flight(self, admit_s, dispatch_s, sync_s, err=None):
+        """One compact JSON-ready event per tick into the flight ring."""
+        evt = {"step": self._step_seq, "ts": round(time.time(), 6),
+               "active": self.active_slots, "queued": len(self._queue),
+               "blocks_used": self.pool.used_blocks,
+               "blocks_reserved": self._reserved,
+               "admitted": list(self._tick_admitted),
+               "retired": [[rid, fin] for rid, fin in self._tick_retired],
+               "prefills": [[R, s_pad, n]
+                            for R, s_pad, n in self._tick_prefills],
+               "t_admit_s": round(admit_s, 6),
+               "t_prefill_s": round(self._tick_prefill_s, 6),
+               "t_dispatch_s": (None if dispatch_s is None
+                                else round(dispatch_s, 6)),
+               "t_sync_s": (None if sync_s is None else round(sync_s, 6))}
+        if err is not None:
+            evt["err"] = err
+        self.flight.record(evt)
+        self._step_seq += 1
 
     def pop_result(self, request_id: int) -> RequestResult:
         """Remove and return a finished request's result. ``results``
@@ -797,6 +938,7 @@ class ServingEngine:
                 break
             if q0 > 0 and self.active_slots == 0 and len(self._queue) == q0:
                 head = self._queue[0]
+                self.flight.auto_dump("pool_exhausted:drain_stall")
                 raise PoolExhausted(
                     f"drain stalled: request {head.request_id} "
                     f"({len(head.prompt)}+{head.max_new_tokens} tokens) "
