@@ -1,0 +1,281 @@
+"""Chaos soak: overload + injected faults + crash/restore, zero loss.
+
+``load_bench.py`` measures how latency degrades under overload;
+this harness asserts the engine *survives* it. It drives Poisson
+arrivals at ``--load`` times the calibrated capacity (default 1.5 —
+deliberately past the goodput knee) with the PR 8 overload controls
+armed (bounded queue, deadline-infeasibility shedding, priority mix →
+displacement + preemption), while a ``FaultPlan`` fires
+raise / RESOURCE_EXHAUSTED faults at the serving ``decode.dispatch``
+site every ``--fault_every`` dispatches. Every crash takes the
+snapshot → integrity-manifest commit → ``ServingEngine.restore`` path
+(a fresh engine re-admits all in-flight and queued work via
+token-exact resume).
+
+Exit contract (the acceptance bar, enforced with a non-zero exit):
+
+* **zero loss** — every accepted submit ends in ``results`` with a
+  finish reason (``eos``/``length``/``deadline``/``shed``); nothing
+  vanishes across any number of crashes;
+* **token parity across restores** — ``--verify`` randomly chosen
+  completed requests are replayed through isolated ``generate`` and
+  must match token-for-token (greedy default);
+* **reported shedding** — the final ``paddle_tpu.bench/v1`` record
+  carries ``shed_rate``, ``preemptions``, ``restores`` and
+  ``lost_requests`` (== 0), and the flight ring/dump holds the
+  preempt/shed/restore markers a postmortem would replay.
+
+Run::
+
+    python examples/chaos_bench.py [--model llama-tiny] [--requests 40]
+        [--load 1.5] [--fault_every 25] [--deadline_frac 0.25]
+        [--flight_dump /tmp/chaos_flight.jsonl]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from load_bench import calibrate, gen_arrivals, make_requests
+from serving_bench import build_model
+
+
+def build_engine(model, ns, flight_dump):
+    from paddle_tpu import serving
+
+    return serving.ServingEngine(
+        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+        max_seq_len=ns.max_seq_len,
+        cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
+        flight_dump_path=flight_dump,
+        max_queue=ns.max_queue, shed_infeasible=True)
+
+
+def drive_chaos(model, eng, ns, reqs, arrivals, snap_root):
+    """Open-loop drive with crash/restore: any exception out of
+    ``step()`` (an injected fault, a simulated device OOM) snapshots
+    the engine through the integrity-manifest path, closes it, and
+    resumes on a restored engine. Returns
+    (engine, accepted_ids, rejected, restores, wall_s)."""
+    from paddle_tpu import serving
+
+    n = len(reqs)
+    i = rejected = restores = 0
+    accepted = []
+    t0 = time.perf_counter()
+    while i < n or not eng.idle:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            r = reqs[i]
+            try:
+                rid = eng.submit(serving.Request(
+                    r["prompt"], max_new_tokens=r["budget"],
+                    priority=r.get("priority", "normal"),
+                    deadline_s=r.get("deadline")))
+                accepted.append(rid)
+            except serving.Rejected:
+                rejected += 1
+            i += 1
+        if eng.idle and i < n:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+            continue
+        try:
+            eng.step()
+        except Exception as e:      # noqa: BLE001 — chaos is the point
+            print(f"# crash #{restores + 1}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            eng.save_snapshot(snap_root)
+            eng.close()
+            eng = type(eng).restore(model, snap_root)
+            restores += 1
+    return eng, accepted, rejected, restores, time.perf_counter() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-tiny")
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--block_tokens", type=int, default=16)
+    ap.add_argument("--max_seq_len", type=int, default=None)
+    ap.add_argument("--min_prompt", type=int, default=6)
+    ap.add_argument("--max_prompt", type=int, default=20)
+    ap.add_argument("--min_new", type=int, default=4)
+    ap.add_argument("--max_new", type=int, default=16)
+    ap.add_argument("--load", type=float, default=1.5,
+                    help="offered load as a multiple of calibrated "
+                    "capacity (>1 = deliberate overload)")
+    ap.add_argument("--fault_every", type=int, default=25,
+                    help="fire a fault every N decode.dispatch calls "
+                    "(alternating raise / RESOURCE_EXHAUSTED)")
+    ap.add_argument("--max_faults", type=int, default=4)
+    ap.add_argument("--max_queue", type=int, default=8)
+    ap.add_argument("--priority_mix", default="low:1,normal:2,high:1")
+    ap.add_argument("--deadline_frac", type=float, default=0.25,
+                    help="fraction of requests carrying a --deadline_s "
+                    "deadline (the infeasibility-shed targets)")
+    ap.add_argument("--deadline_s", type=float, default=5.0)
+    ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--verify", type=int, default=3,
+                    help="completed requests spot-checked token-exact "
+                    "against isolated generate (greedy only)")
+    ap.add_argument("--snapshot_dir", default=None)
+    ap.add_argument("--flight_dump", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ns = ap.parse_args()
+
+    dev = jax.devices()[0]
+    cfg, model = build_model(ns.model)
+    ns.vocab = cfg.vocab_size
+    if ns.max_seq_len is None:
+        need = ns.max_prompt + ns.max_new
+        ns.max_seq_len = -(-need // ns.block_tokens) * ns.block_tokens
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.resilience import faults
+
+    snap_root = ns.snapshot_dir or tempfile.mkdtemp(prefix="chaos_snap_")
+    flight_dump = ns.flight_dump or os.path.join(snap_root,
+                                                 "flight.jsonl")
+
+    rng = np.random.RandomState(ns.seed)
+    reqs = make_requests(ns, rng)
+    for r in reqs:      # only a fraction carries a deadline
+        if rng.rand() >= ns.deadline_frac:
+            r["deadline"] = None
+
+    eng = build_engine(model, ns, flight_dump)
+    # calibration runs unshedded (the saturated closed-loop warmup
+    # would shed itself against the bounded queue)
+    eng.shed_infeasible = False
+    eng.max_queue = None
+    calibrate(eng, reqs)
+    eng.reset_stats()
+    eng.results.clear()
+    cap_tok_s, cap_rps = calibrate(eng, reqs)
+    eng.reset_stats()
+    eng.results.clear()
+    eng.shed_infeasible = True
+    eng.max_queue = ns.max_queue
+    print(f"# calibrated capacity: {cap_tok_s:.1f} tokens/s "
+          f"~ {cap_rps:.2f} req/s; offering {ns.load:g}x",
+          file=sys.stderr)
+
+    plan = faults.FaultPlan(*[
+        faults.Fault("decode.dispatch",
+                     kind=("raise" if k % 2 == 0
+                           else "resource_exhausted"),
+                     at=(k + 1) * ns.fault_every)
+        for k in range(ns.max_faults)])
+    faults.arm(plan)
+    arrivals = gen_arrivals(ns.requests, ns.load * cap_rps, "poisson",
+                            rng)
+    try:
+        eng, accepted, rejected, restores, wall = drive_chaos(
+            model, eng, ns, reqs, arrivals, snap_root)
+    finally:
+        faults.disarm()
+
+    # ---- the contract ----------------------------------------------------
+    lost = [rid for rid in accepted if rid not in eng.results]
+    finishes = {}
+    for rid in accepted:
+        if rid in eng.results:
+            f = eng.results[rid].finish
+            finishes[f] = finishes.get(f, 0) + 1
+    shed = rejected + finishes.get("shed", 0)
+    fired = len(plan.fired())
+    # whole-run marker census: the auto-dump file spans every engine
+    # incarnation (each crash + each restore dumped); the live ring only
+    # covers the last one
+    markers = {"preempted": 0, "shed": 0, "restore": 0}
+
+    def _count(evt):
+        if evt.get("kind") == "restore":
+            markers["restore"] += 1
+        markers["preempted"] += len(evt.get("preempted", []))
+        markers["shed"] += len(evt.get("shed", []))
+
+    if os.path.isfile(flight_dump):
+        seen = set()
+        with open(flight_dump) as f:
+            for ln in f:
+                evt = json.loads(ln)
+                if evt.get("kind") == "flight_dump":
+                    continue
+                # dumps overlap (each snapshots the whole ring): dedup
+                # step events by (step, ts), markers by ts
+                key = (evt.get("step"), evt.get("kind"), evt.get("ts"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                _count(evt)
+    else:
+        for evt in eng.flight.events():
+            _count(evt)
+
+    parity_checked = 0
+    if ns.verify and eng.temperature == 0.0:
+        from paddle_tpu.inference import generate
+        done = [rid for rid in accepted
+                if rid in eng.results
+                and eng.results[rid].finish in ("eos", "length")]
+        rng.shuffle(done)
+        for rid in done[:ns.verify]:
+            res = eng.results[rid]
+            ref = np.asarray(generate(
+                model, res.prompt[None],
+                max_new_tokens=len(res.tokens), temperature=0.0,
+                cache_dtype=jnp.int8 if ns.cache_int8
+                else jnp.bfloat16))[0, len(res.prompt):]
+            if res.tokens.tolist() != ref.tolist():
+                print(f"# PARITY FAILURE request {rid}", file=sys.stderr)
+                sys.exit(2)
+            parity_checked += 1
+
+    st = eng.stats
+    reg = obs.registry()
+    rec = obs.bench_record(
+        f"{ns.model} chaos soak {ns.load:g}x survivors",
+        float(len(accepted) - len(lost)), "requests",
+        device=dev.device_kind, timing="wall",
+        load_mult=ns.load, n_requests=ns.requests,
+        offered_rps=round(ns.load * cap_rps, 4),
+        faults_fired=fired, restores=restores,
+        preemptions=reg.counter_total("serving.preemptions"),
+        shed_rate=round(shed / ns.requests, 4),
+        lost_requests=len(lost), finishes=finishes,
+        flight_markers=markers, parity_checked=parity_checked,
+        wall_s=round(wall, 3))
+    print(json.dumps(rec))
+    eng.close()
+    if ns.snapshot_dir is None:
+        shutil.rmtree(snap_root, ignore_errors=True)
+
+    if lost:
+        print(f"# LOST {len(lost)} accepted requests: {lost}",
+              file=sys.stderr)
+        sys.exit(1)
+    if fired and restores == 0:
+        print("# faults fired but no restore happened — the chaos path "
+              "was not exercised", file=sys.stderr)
+        sys.exit(1)
+    print(f"# zero loss across {restores} restores / {fired} faults; "
+          f"shed {shed}/{ns.requests}, parity x{parity_checked} OK",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,16 @@ chunked-prefill / speculative PRs will move). Run:
         [--arrivals poisson|bursty] [--loads 0.5,0.9,1.5]
         [--slo_ttft_s 2.0] [--slo_tpot_s 0.25]
         [--flight_dump /tmp/flight.jsonl]
+        [--shed [--max_queue N] [--deadline_s D]]
+        [--priority_mix "low:1,normal:2,high:1"]
+
+``--shed`` arms the PR 8 overload controls (bounded queue +
+deadline-infeasibility rejection) for the measured points — the A/B
+against unshedded collapse: past the knee the unshedded queue grows
+without bound and ``ttft_p99_s`` explodes, while the shedded run keeps
+the ADMITTED requests' tails flat and reports the drop as
+``shed_rate``. ``--priority_mix`` adds classes, which also exercises
+displacement shedding and slot preemption (``preemptions`` field).
 
 Prefix caching is off here (random prompts never share blocks) and
 prompt lengths quantize to few pad shapes, keeping prefill compile
@@ -51,15 +61,35 @@ import numpy as np
 from serving_bench import build_model
 
 
+def parse_priority_mix(spec):
+    """``"low:1,normal:2,high:1"`` -> (names, weights). Empty/None means
+    every request rides the default class."""
+    if not spec:
+        return None
+    names, weights = [], []
+    for part in spec.split(","):
+        name, _, w = part.partition(":")
+        names.append(name.strip())
+        weights.append(float(w) if w else 1.0)
+    total = sum(weights)
+    return names, [w / total for w in weights]
+
+
 def make_requests(ns, rng):
     """N requests with uniform prompt lengths / budgets (the queueing
-    dynamics, not the length mix, are under test here)."""
+    dynamics, not the length mix, are under test here); ``--priority_mix``
+    assigns classes, ``--deadline_s`` attaches a deadline to every
+    request (what infeasibility shedding prices)."""
+    mix = parse_priority_mix(getattr(ns, "priority_mix", None))
     reqs = []
     for _ in range(ns.requests):
         plen = int(rng.randint(ns.min_prompt, ns.max_prompt + 1))
         budget = int(rng.randint(ns.min_new, ns.max_new + 1))
+        prio = (mix[0][int(rng.choice(len(mix[0]), p=mix[1]))]
+                if mix else "normal")
         reqs.append(dict(prompt=rng.randint(3, ns.vocab, (plen,)),
-                         budget=budget))
+                         budget=budget, priority=prio,
+                         deadline=getattr(ns, "deadline_s", None)))
     return reqs
 
 
@@ -92,18 +122,27 @@ def gen_arrivals(n, rps, mode, rng, on_s=0.5, off_s=0.5):
 def drive_open_loop(eng, reqs, arrivals):
     """Submit request i once the wall clock passes ``arrivals[i]``,
     stepping the engine regardless of queue state (open loop). Returns
-    wall seconds from first arrival epoch to full drain."""
+    (wall seconds from first arrival epoch to full drain, rejected
+    count) — with shedding enabled a submit may raise
+    ``serving.Rejected`` (queue full / deadline infeasible), which is a
+    *measured outcome* here, not an error."""
     from paddle_tpu import serving
 
     n = len(reqs)
     i = 0
+    rejected = 0
     t0 = time.perf_counter()
     while i < n or not eng.idle:
         now = time.perf_counter() - t0
         while i < n and arrivals[i] <= now:
             r = reqs[i]
-            eng.submit(serving.Request(r["prompt"],
-                                       max_new_tokens=r["budget"]))
+            try:
+                eng.submit(serving.Request(
+                    r["prompt"], max_new_tokens=r["budget"],
+                    priority=r.get("priority", "normal"),
+                    deadline_s=r.get("deadline")))
+            except serving.Rejected:
+                rejected += 1
             i += 1
         if eng.idle and i < n:
             # nothing in flight: sleep toward the next arrival instead
@@ -111,7 +150,7 @@ def drive_open_loop(eng, reqs, arrivals):
             time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
             continue
         eng.step()
-    return time.perf_counter() - t0
+    return time.perf_counter() - t0, rejected
 
 
 def calibrate(eng, reqs):
@@ -164,6 +203,19 @@ def main():
     ap.add_argument("--knee_goodput", type=float, default=0.9,
                     help="goodput threshold defining the knee")
     ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--shed", action="store_true",
+                    help="enable load shedding: bounded queue "
+                    "(--max_queue) + deadline-infeasibility rejection — "
+                    "the A/B against unshedded overload collapse")
+    ap.add_argument("--max_queue", type=int, default=None,
+                    help="queue bound when --shed (default 4*slots)")
+    ap.add_argument("--priority_mix", default=None,
+                    help='e.g. "low:1,normal:2,high:1" — weighted '
+                    "random priority classes (exercises displacement "
+                    "shedding and slot preemption)")
+    ap.add_argument("--deadline_s", type=float, default=None,
+                    help="per-request deadline (what --shed's "
+                    "infeasibility estimator prices)")
     ap.add_argument("--flight_dump", default=None,
                     help="flight-recorder auto-dump path (postmortems "
                     "on fault/pool/deadline events)")
@@ -182,6 +234,8 @@ def main():
     from paddle_tpu import observability as obs
     from paddle_tpu import serving
 
+    max_queue = (ns.max_queue if ns.max_queue is not None
+                 else 4 * ns.slots) if ns.shed else None
     eng = serving.ServingEngine(
         model, max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
@@ -196,6 +250,11 @@ def main():
     cap_tok_s, cap_rps = calibrate(eng, reqs)   # warm pass: the estimate
     print(f"# calibrated capacity: {cap_tok_s:.1f} tokens/s "
           f"~ {cap_rps:.2f} req/s", file=sys.stderr)
+    # shedding arms AFTER calibration (the saturated closed-loop pass
+    # would otherwise shed its own warmup) — the measured points see the
+    # bounded queue + infeasibility estimator
+    eng.max_queue = max_queue
+    eng.shed_infeasible = ns.shed
 
     curve = []
     loads = [float(x) for x in ns.loads.split(",") if x]
@@ -205,27 +264,35 @@ def main():
                                 ns.burst_on_s, ns.burst_off_s)
         eng.reset_stats()
         eng.results.clear()
-        wall = drive_open_loop(eng, reqs, arrivals)
+        wall, rejected = drive_open_loop(eng, reqs, arrivals)
         rep = obs.SLOReport(ns.slo_ttft_s, ns.slo_tpot_s)
+        served = 0
         for res in eng.results.values():
+            if res.finish == "shed":
+                continue        # displaced: counted in shed_rate, not
+            served += 1         # in the served-latency percentiles
             rep.add(res.ttft_s, res.tpot_s, tokens=max(1, res.gen_len))
         st = eng.stats
-        tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall
+        shed = rejected + st["requests_shed"]
+        tok_s = (st["decode_tokens"] + served) / wall
         rec = obs.bench_record(
             f"{name} open-loop {ns.arrivals} {mult:g}x tokens/s",
             round(tok_s, 1), "tokens/s", device=dev.device_kind,
             timing="wall", batch=ns.slots, mode=ns.arrivals,
             load_mult=mult, n_requests=ns.requests,
             offered_rps=round(rps, 4),
-            achieved_rps=round(st["requests_finished"] / wall, 4),
+            achieved_rps=round(served / wall, 4),
             occupancy=round(st["decode_tokens"] / max(
                 st["decode_tokens"] + st["idle_slot_steps"], 1), 3),
             step_breakdown_s=step_breakdown(st),
+            shed_rate=round(shed / ns.requests, 4),
+            preemptions=st["preemptions"],
             **rep.bench_fields())
         print(json.dumps(rec))
         curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),
                           tokens_per_s=round(tok_s, 1),
                           goodput=rec["goodput"],
+                          shed_rate=rec["shed_rate"],
                           ttft_p99_s=rec["ttft_p99_s"],
                           tpot_p99_s=rec["tpot_p99_s"]))
 
@@ -242,6 +309,7 @@ def main():
         knee_load_mult=knee["load_mult"] if knee else None,
         calibrated_capacity_rps=round(cap_rps, 4), curve=curve)
     print(json.dumps(rec))
+    eng.close()         # free the KV pool (long sweeps, repeated runs)
 
 
 if __name__ == "__main__":

@@ -323,6 +323,7 @@ def main():
         prefill_tokens_reused=st["prefill_tokens_reused"],
         pool_blocks=eng.pool.num_blocks - 1,
         block_tokens=ns.block_tokens, **slo.bench_fields(), **common)))
+    eng.close()         # free the KV pool (back-to-back bench runs)
 
 
 if __name__ == "__main__":

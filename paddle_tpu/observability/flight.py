@@ -68,6 +68,15 @@ class FlightRecorder:
             self._buf[self._n % self.capacity] = event
             self._n += 1
 
+    def mark(self, kind: str, **fields):
+        """Record a non-step marker event (``{"kind": kind, "ts": ...}``
+        + fields) — engine restores, operator annotations. Markers ride
+        the same ring as step events, so a dump shows them in sequence
+        with the scheduler ticks around them."""
+        evt = {"kind": kind, "ts": round(time.time(), 6)}
+        evt.update(fields)
+        self.record(evt)
+
     @property
     def total_events(self) -> int:
         return self._n

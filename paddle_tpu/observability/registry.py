@@ -239,6 +239,14 @@ class MetricsRegistry:
     def snapshot(self) -> List[dict]:
         return [m.snapshot() for m in list(self._metrics.values())]
 
+    def counter_total(self, name: str) -> int:
+        """Sum a counter across every label set it was created with —
+        e.g. ``counter_total("serving.rejected")`` is total sheds over
+        all ``reason`` labels (the shed-rate numerator the load/chaos
+        harnesses report)."""
+        return sum(m.value for (n, kind, _), m in list(self._metrics.items())
+                   if n == name and kind == "counter")
+
     def export_jsonl(self, path: str, extra: Optional[Dict] = None) -> int:
         """Append one JSON line per metric. The whole snapshot goes out
         as ONE O_APPEND write (``append_jsonl_lines``), so concurrent

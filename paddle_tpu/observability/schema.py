@@ -58,6 +58,13 @@ _BENCH_OPTIONAL = {
     "goodput": numbers.Real,
     "slo_ttft_s": numbers.Real,
     "slo_tpot_s": numbers.Real,
+    # overload-robustness fields (load_bench --shed / chaos_bench):
+    # shed_rate = shed+rejected submissions / offered requests;
+    # preemptions / restores are engine counters over the run
+    "shed_rate": numbers.Real,
+    "preemptions": numbers.Integral,
+    "restores": numbers.Integral,
+    "lost_requests": numbers.Integral,
 }
 
 
@@ -83,10 +90,11 @@ def validate_bench(rec: Dict) -> Dict:
             problems.append(
                 f"field {field!r} must be {getattr(typ, '__name__', typ)} "
                 f"or null, got {type(v).__name__}")
-    g = rec.get("goodput")
-    if isinstance(g, numbers.Real) and not isinstance(g, bool) \
-            and not 0.0 <= g <= 1.0:
-        problems.append(f"goodput must be in [0, 1], got {g}")
+    for frac in ("goodput", "shed_rate"):
+        g = rec.get(frac)
+        if isinstance(g, numbers.Real) and not isinstance(g, bool) \
+                and not 0.0 <= g <= 1.0:
+            problems.append(f"{frac} must be in [0, 1], got {g}")
     if "roofline_plan" in rec and isinstance(rec["roofline_plan"], dict):
         try:
             validate_roofline_plan(rec["roofline_plan"])

@@ -18,9 +18,18 @@ site                        fired from / index
 ``elastic.heartbeat``       ``ElasticManager.register`` — call counter
 ``decode.dispatch``         ``inference.generate`` / ``StackedLlamaDecoder
                             .generate`` — per-process dispatch-attempt
-                            counter (each degradation retry is a new call)
+                            counter (each degradation retry is a new call);
+                            also fired by ``ServingEngine`` at each
+                            admission pop AND each fused decode dispatch —
+                            both BEFORE state mutates, so a raising fault
+                            never loses the request (it stays queued /
+                            its tokens stay un-appended)
 ``kv.op``                   ``collective._kv_put_get`` /
                             ``CoordinationServiceStore`` — call counter
+``serving.snapshot``        ``ServingEngine.save_snapshot`` — call
+                            counter (a raising fault aborts the commit
+                            BEFORE the manifest, so restore walks back
+                            to the previous intact snapshot)
 ==========================  ================================================
 
 Zero-overhead contract: with no plan armed, ``maybe_fire`` is ONE global

@@ -38,7 +38,8 @@ logger = logging.getLogger("paddle_tpu.resilience")
 __all__ = [
     "MANIFEST_SCHEMA", "MANIFEST_SUBDIR",
     "tensor_checksums", "file_checksums", "manifest_path",
-    "write_manifest", "read_manifest", "verify_files", "verify_tensors",
+    "write_manifest", "read_manifest", "manifest_steps",
+    "verify_files", "verify_tensors",
     "is_content_failure", "corrupt_checkpoint",
 ]
 
@@ -132,6 +133,22 @@ def write_manifest(ckpt_dir: str, step: int, files: Dict[str, dict],
         os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
+
+
+def manifest_steps(ckpt_dir: str) -> list:
+    """Step numbers with a committed manifest under
+    ``<ckpt_dir>/integrity/``, newest first — the generic walk-back
+    order for any consumer of this commit protocol (checkpoint resume
+    via orbax's own step scan, serving-engine snapshots via this)."""
+    d = os.path.join(ckpt_dir, MANIFEST_SUBDIR)
+    out = []
+    if os.path.isdir(d):
+        for fn in os.listdir(d):
+            if fn.startswith("step_") and fn.endswith(".json"):
+                digits = fn[len("step_"):-len(".json")]
+                if digits.isdigit():
+                    out.append(int(digits))
+    return sorted(out, reverse=True)
 
 
 def read_manifest(ckpt_dir: str, step: int) -> Optional[dict]:

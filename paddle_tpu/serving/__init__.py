@@ -16,12 +16,13 @@ in the PAPERS lineage).
 """
 
 from paddle_tpu.serving.engine import (  # noqa: F401
-    Request, RequestResult, ServingEngine)
+    ENGINE_SNAPSHOT_SCHEMA, PRIORITIES, Rejected, Request, RequestResult,
+    ServingEngine)
 from paddle_tpu.serving.pool import (  # noqa: F401
     SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
 
 __all__ = [
     "Request", "RequestResult", "ServingEngine",
     "BlockPool", "PoolExhausted", "PrefixCache", "PrefixEntry",
-    "SCRATCH_BLOCK",
+    "SCRATCH_BLOCK", "Rejected", "PRIORITIES", "ENGINE_SNAPSHOT_SCHEMA",
 ]

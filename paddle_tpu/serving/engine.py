@@ -25,12 +25,39 @@ an isolated ``generate`` call — per-request RNG streams
 (``fold_in(PRNGKey(request_seed), t)``) make that hold for sampled
 tokens too, because a row's stream never depends on its batch
 neighbours.
+
+Overload robustness (docs/SERVING.md §Overload behavior,
+tests/test_serving_robustness.py):
+
+* **bounded admission + typed shedding** — ``max_queue`` caps the
+  queue; ``shed_infeasible`` rejects requests whose deadline cannot
+  even reach a first token under the EWMA capacity estimate. Both shed
+  paths raise :class:`Rejected` with a machine-readable ``reason``
+  (counted under ``serving.rejected{reason}``) instead of queuing work
+  that can only expire;
+* **priority preemption with token-exact resume** — per-request
+  ``priority`` classes order the queue; when a higher-priority request
+  cannot be admitted, the scheduler retires the
+  lowest-priority/loosest-deadline slot, frees its blocks and requeues
+  it with its generated-so-far tokens. Resume re-prefills
+  prompt+generated through the normal wave-prefill program and
+  continues sampling at ``fold_in(seed, count)`` — the same RNG stream
+  position an uninterrupted run would use, which is what keeps
+  preempt/resume token-identical (greedy and sampled, bf16 and int8);
+* **crash-recoverable state** — :meth:`ServingEngine.snapshot` /
+  :meth:`save_snapshot` serialize the queue, per-slot generated tokens
+  and finished results through the PR 4 integrity-manifest commit
+  protocol; :meth:`ServingEngine.restore` re-admits every request via
+  the resume path, so a mid-step fault loses nothing.
 """
 
-import itertools
+import heapq
+import json
 import logging
+import numbers
+import os
+import threading
 import time
-from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -42,9 +69,51 @@ from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool, PoolExhausted,
 
 logger = logging.getLogger("paddle_tpu.serving")
 
-__all__ = ["Request", "RequestResult", "ServingEngine"]
+__all__ = ["PRIORITIES", "Rejected", "Request", "RequestResult",
+           "ServingEngine", "ENGINE_SNAPSHOT_SCHEMA"]
 
-_req_ids = itertools.count()
+ENGINE_SNAPSHOT_SCHEMA = "paddle_tpu.engine_snapshot/v1"
+
+#: admission classes, lowest to highest. The queue orders by (priority,
+#: submit order); preemption only ever evicts a STRICTLY lower class, so
+#: two requests of the same class can never ping-pong each other.
+PRIORITIES = ("low", "normal", "high")
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+# module-wide request-id source. Locked (concurrent submitter threads
+# must never mint the same id — results are keyed by it) and bumpable:
+# restore() pushes it past every id a snapshot carries so a restored
+# engine's NEW submissions cannot collide with re-admitted ones.
+_req_id_state = {"next": 0}
+_req_id_lock = threading.Lock()
+
+
+def _next_req_id() -> int:
+    with _req_id_lock:
+        v = _req_id_state["next"]
+        _req_id_state["next"] = v + 1
+        return v
+
+
+def _note_req_id(rid: int):
+    """Keep the auto-id source ahead of every explicitly assigned id."""
+    with _req_id_lock:
+        if rid >= _req_id_state["next"]:
+            _req_id_state["next"] = rid + 1
+
+
+class Rejected(RuntimeError):
+    """Typed load-shed signal raised by :meth:`ServingEngine.submit`.
+
+    ``reason`` is machine-readable: ``queue_full`` (bounded queue at
+    capacity, no lower-priority victim to displace) or
+    ``deadline_infeasible`` (the EWMA capacity estimate says the
+    request's deadline expires before its first token). Each rejection
+    also increments ``serving.rejected{reason=...}``."""
+
+    def __init__(self, reason: str, msg: str):
+        super().__init__(msg)
+        self.reason = reason
 
 
 class Request:
@@ -55,37 +124,88 @@ class Request:
     request: the prompt, the token budget, the RNG ``seed`` (defaults to
     a fresh engine-assigned seed; pass the seed an isolated
     ``generate(..., request_seeds=[seed])`` call would use to reproduce
-    it exactly), and an optional wall-clock ``deadline_s`` measured from
+    it exactly), an optional wall-clock ``deadline_s`` measured from
     submit (queue wait included) — on expiry the request retires with
-    the tokens it has, mirroring ``generate(deadline_s=...)``.
+    the tokens it has, mirroring ``generate(deadline_s=...)`` — and a
+    ``priority`` class (one of :data:`PRIORITIES`) that orders
+    admission and decides who sheds/preempts whom under overload.
+
+    Every argument is validated HERE with a plain ``ValueError`` — a
+    bad budget or unknown priority must not surface as an opaque
+    failure deep inside the scheduler's ``_admit``.
     """
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "seed",
-                 "deadline_s", "_t_submit")
+                 "deadline_s", "priority", "_t_submit", "_t_first",
+                 "_resume_tokens", "_seq")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  seed: Optional[int] = None,
                  deadline_s: Optional[float] = None,
+                 priority: str = "normal",
                  request_id: Optional[int] = None):
-        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt = np.asarray(prompt)
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError(
+                f"prompt must hold integer token ids, got dtype "
+                f"{prompt.dtype}")
+        self.prompt = prompt.astype(np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
+        if isinstance(max_new_tokens, bool) \
+                or not isinstance(max_new_tokens, numbers.Integral):
+            raise ValueError(
+                f"max_new_tokens must be an int, got "
+                f"{type(max_new_tokens).__name__}")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
         self.max_new_tokens = int(max_new_tokens)
-        self.seed = seed
+        if seed is not None and (isinstance(seed, bool)
+                                 or not isinstance(seed, numbers.Integral)):
+            raise ValueError(f"seed must be an int or None, got "
+                             f"{type(seed).__name__}")
+        self.seed = None if seed is None else int(seed)
+        if deadline_s is not None:
+            if isinstance(deadline_s, bool) \
+                    or not isinstance(deadline_s, numbers.Real):
+                raise ValueError(f"deadline_s must be a number or None, "
+                                 f"got {type(deadline_s).__name__}")
+            if not deadline_s > 0:
+                raise ValueError(
+                    f"deadline_s must be > 0 (it is a wall-clock budget "
+                    f"from submit), got {deadline_s}")
+            deadline_s = float(deadline_s)
         self.deadline_s = deadline_s
-        self.request_id = (next(_req_ids) if request_id is None
-                           else int(request_id))
+        if priority not in _PRIORITY_RANK:
+            raise ValueError(f"unknown priority {priority!r}; one of "
+                             f"{PRIORITIES}")
+        self.priority = priority
+        if request_id is None:
+            self.request_id = _next_req_id()
+        else:
+            self.request_id = int(request_id)
+            _note_req_id(self.request_id)
         self._t_submit: Optional[float] = None
+        # preempt/resume state: the generated-so-far tokens a requeued
+        # request re-prefills from (None = fresh), and the original
+        # first-token timestamp so TTFT survives a preemption
+        self._resume_tokens: Optional[List[int]] = None
+        self._t_first: Optional[float] = None
+        self._seq: int = 0          # engine submit ordinal (FIFO tiebreak)
+
+    @property
+    def rank(self) -> int:
+        return _PRIORITY_RANK[self.priority]
 
 
 class RequestResult:
     """Terminal state of a request. ``tokens`` are the generated ids
     (eos included when hit); ``gen_len`` counts tokens before the first
     eos — the same accounting ``generate(return_lengths=True)`` reports.
-    ``finish`` is one of ``eos`` / ``length`` / ``deadline``."""
+    ``finish`` is one of ``eos`` / ``length`` / ``deadline`` / ``shed``
+    (a queued request displaced by a higher-priority submit under a
+    full bounded queue — ``tokens`` is empty, ``ttft_s`` None)."""
 
     __slots__ = ("request_id", "prompt", "tokens", "gen_len", "finish",
                  "ttft_s", "tpot_s", "prefix_hit_blocks")
@@ -110,10 +230,11 @@ class RequestResult:
 class _Slot:
     __slots__ = ("req", "tok", "pos", "count", "tokens", "blocks", "ntab",
                  "worst_blocks", "t_first", "deadline_at",
-                 "prefix_hit_blocks")
+                 "prefix_hit_blocks", "feed", "resume")
 
     def __init__(self, req: Request, worst_blocks: int,
-                 prefix_hit_blocks: int):
+                 prefix_hit_blocks: int, feed: np.ndarray,
+                 resume: Optional[List[int]]):
         self.req = req
         self.tok = 0            # last sampled, kv not yet appended
         self.pos = 0            # append position of the next decode step
@@ -125,6 +246,94 @@ class _Slot:
         self.t_first: Optional[float] = None
         self.deadline_at: Optional[float] = None
         self.prefix_hit_blocks = prefix_hit_blocks
+        # what the prefill program runs over: the prompt for a fresh
+        # request, prompt+generated[:-1] for a preempt/restore resume
+        # (the final generated token is NOT appended — it becomes the
+        # next decode step's input, exactly where an uninterrupted run
+        # left off)
+        self.feed = feed
+        self.resume = resume            # generated-so-far tokens, or None
+
+
+class _PriorityQueue:
+    """Priority-then-FIFO request queue: a heap ordered by
+    (-priority_rank, submit_seq) with lazy deletion. push/pop are
+    O(log n); the displacement-victim scan and the estimator walk are
+    O(n) over the raw heap (``items()``, no sort — neither cares about
+    order); only ``__iter__`` (snapshots) pays a sort."""
+
+    def __init__(self):
+        self._heap: List = []           # (neg_rank, seq, req)
+        self._removed = set()           # request_ids shed before pop
+        self._live = 0
+
+    def __len__(self) -> int:
+        return self._live
+
+    def __bool__(self) -> bool:
+        return self._live > 0
+
+    def push(self, req: Request):
+        heapq.heappush(self._heap, (-req.rank, req._seq, req))
+        self._live += 1
+
+    def _prune(self):
+        while self._heap and self._heap[0][2].request_id in self._removed:
+            self._removed.discard(heapq.heappop(self._heap)[2].request_id)
+
+    def peek(self) -> Optional[Request]:
+        self._prune()
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> Request:
+        self._prune()
+        self._live -= 1
+        return heapq.heappop(self._heap)[2]
+
+    def remove(self, req: Request):
+        self._removed.add(req.request_id)
+        self._live -= 1
+
+    def items(self):
+        """Live requests in arbitrary (heap) order — the O(n) walk for
+        order-insensitive consumers (victim scan, TTFT estimator)."""
+        return (r for _, _, r in self._heap
+                if r.request_id not in self._removed)
+
+    def __iter__(self):
+        """Live requests in pop order (snapshots). seq is unique per
+        engine, so sorting never compares requests."""
+        return (r for _, _, r in sorted(self._heap, key=lambda e: e[:2])
+                if r.request_id not in self._removed)
+
+    def lowest_below(self, rank: int) -> Optional[Request]:
+        """The displacement victim: lowest-priority, most-recently
+        queued request STRICTLY below ``rank``; None when every queued
+        request is at least ``rank``."""
+        best = None
+        for r in self.items():
+            if r.rank >= rank:
+                continue
+            if best is None or (r.rank, -r._seq) < (best.rank, -best._seq):
+                best = r
+        return best
+
+
+class _Ewma:
+    """One exponentially-weighted moving average (the engine's capacity
+    estimator state — fed the SAME per-segment wall times the PR 7
+    ``serving.step_*_s`` histograms observe)."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = 0.25):
+        self.alpha = float(alpha)
+        self.value: Optional[float] = None
+
+    def update(self, x: float):
+        self.value = (float(x) if self.value is None
+                      else (1.0 - self.alpha) * self.value
+                      + self.alpha * float(x))
 
 
 class ServingEngine:
@@ -150,8 +359,17 @@ class ServingEngine:
     the ``serving.ttft_s``/``serving.tpot_s`` quantile sketches, and a
     flight-recorder ring (last ``flight_capacity`` step events,
     auto-dumped to ``flight_dump_path`` on a fired fault /
-    ``PoolExhausted`` / deadline retirement) keeps the postmortem
-    trail — docs/OBSERVABILITY.md has the event format.
+    ``PoolExhausted`` / deadline retirement / preemption / shed) keeps
+    the postmortem trail — docs/OBSERVABILITY.md has the event format.
+
+    Overload control (all off by default — the unbounded engine is the
+    PR 5 behavior): ``max_queue`` bounds the queue (a submit against a
+    full queue displaces a strictly lower-priority queued victim, else
+    raises :class:`Rejected`); ``shed_infeasible=True`` rejects
+    deadline-carrying requests whose deadline the EWMA capacity
+    estimate says cannot reach a first token. Priority preemption is
+    always armed but only ever fires across *different* priority
+    classes, so all-default-priority workloads never preempt.
     """
 
     def __init__(self, model, *, max_slots: int = 4,
@@ -164,6 +382,8 @@ class ServingEngine:
                  prefix_cache_blocks: int = 256,
                  flight_capacity: int = 256,
                  flight_dump_path: Optional[str] = None,
+                 max_queue: Optional[int] = None,
+                 shed_infeasible: bool = False,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -224,7 +444,13 @@ class ServingEngine:
         self.top_p = float(top_p)
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
-        self._seed_counter = itertools.count()
+        self._seeds_issued = 0
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 or None, got "
+                             f"{max_queue}")
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.shed_infeasible = bool(shed_infeasible)
+        self._closed = False
 
         from paddle_tpu.ops import rope as rope_ops
         self._cos_tab, self._sin_tab = rope_ops.rope_cos_sin(
@@ -241,7 +467,8 @@ class ServingEngine:
         self._kv_scales = np.ones((L, ms, 2 * self._dkv), np.float32)
 
         self._slots: List[Optional[_Slot]] = [None] * ms
-        self._queue: deque = deque()
+        self._queue = _PriorityQueue()
+        self._submit_seq = 0
         self.results: Dict[int, RequestResult] = {}
         self._reserved = 0      # blocks promised to in-flight slots
         self._step_fn = None
@@ -273,6 +500,14 @@ class ServingEngine:
         self._tick_retired: List = []
         self._tick_prefills: List = []
         self._tick_prefill_s = 0.0
+        # overload-control tick markers + capacity estimator state
+        self._tick_preempted: List[int] = []
+        self._tick_resumed: List[int] = []
+        self._tick_shed: List = []      # (request_id, reason) pairs
+        self._pending_finished: List[int] = []  # shed between ticks
+        self._ewma_step = _Ewma()       # decode dispatch+sync per step
+        self._ewma_prefill = _Ewma()    # per prefill-wave group
+        self._step_fn_warm = False      # first dispatch pays the compile
         self._gauges_init()
 
     # ------------------------------------------------------------- helpers
@@ -307,6 +542,8 @@ class ServingEngine:
         return dict(steps=0, decode_tokens=0, idle_slot_steps=0,
                     prefill_tokens=0, prefill_tokens_reused=0,
                     requests_finished=0, requests_admitted=0,
+                    preemptions=0, requests_resumed=0,
+                    requests_shed=0, requests_rejected=0,
                     step_admit_s=0.0, step_prefill_s=0.0,
                     step_dispatch_s=0.0, step_sync_s=0.0)
 
@@ -332,14 +569,84 @@ class ServingEngine:
         return self.active_slots == 0 and not self._queue
 
     # ---------------------------------------------------------- submission
+    def _count_rejected(self, request: Request, reason: str):
+        from paddle_tpu.observability import registry
+        registry().counter("serving.rejected", reason=reason).inc()
+        self.stats["requests_rejected"] += 1
+        self._tick_shed.append((request.request_id, reason))
+        # at most one overload dump per tick, at the next step boundary
+        # (a per-rejection dump would flood the sink under sustained
+        # overload — the ring already carries the lead-up)
+        if self._dump_pending is None:
+            self._dump_pending = f"rejected:{reason}"
+
+    def _shed_queued(self, victim: Request, reason: str):
+        """Drop a queued request (displacement under a full bounded
+        queue): it finishes with ``finish='shed'`` — reported, never
+        silently lost. A previously-preempted victim keeps the tokens
+        it already generated (like a deadline cut), not an empty
+        result."""
+        from paddle_tpu.observability import registry
+        self._queue.remove(victim)
+        toks = victim._resume_tokens or []
+        ttft = (victim._t_first - victim._t_submit
+                if victim._t_first is not None
+                and victim._t_submit is not None else None)
+        res = RequestResult(victim.request_id, victim.prompt, toks,
+                            len(toks), "shed", ttft, None, 0)
+        self.results[victim.request_id] = res
+        self._pending_finished.append(victim.request_id)
+        r = registry()
+        r.counter("serving.rejected", reason=reason).inc()
+        r.counter("serving.requests", finish="shed").inc()
+        self.stats["requests_shed"] += 1
+        self._tick_shed.append((victim.request_id, reason))
+        if self._dump_pending is None:
+            self._dump_pending = "shed"
+
+    def estimated_ttft_s(self, request: Request) -> Optional[float]:
+        """EWMA-capacity estimate of ``request``'s queue-wait + prefill
+        time (the earliest its first token could land): work ahead of
+        it (active slots' remaining budgets + queued requests at >= its
+        priority) spread over ``max_slots``, priced at the EWMA decode
+        step time, plus one EWMA prefill wave. Fed by the same segment
+        wall times the ``serving.step_*_s`` histograms observe; None
+        until the engine has decoded at least one step (a cold engine
+        must not shed on a guess)."""
+        if self._ewma_step.value is None:
+            return None
+        # only work at >= this request's priority counts as "ahead":
+        # strictly lower-priority slots are exactly what admission
+        # would preempt for it, and lower-priority queue entries sort
+        # behind it — counting either would shed feasible high-priority
+        # deadlines
+        ahead = sum(s.req.max_new_tokens - s.count
+                    for s in self._slots
+                    if s is not None and s.req.rank >= request.rank)
+        ahead += sum(r.max_new_tokens - len(r._resume_tokens or [])
+                     for r in self._queue.items()
+                     if r.rank >= request.rank)
+        prefill = self._ewma_prefill.value or 0.0
+        return prefill + (ahead / self.max_slots) * self._ewma_step.value
+
     def submit(self, request) -> int:
         """Queue a request (accepts a :class:`Request` or a 1-D prompt).
-        Returns the request id; the result lands in ``self.results``."""
+        Returns the request id; the result lands in ``self.results``.
+
+        May raise: ``ValueError`` (request cannot fit a slot at all),
+        :class:`PoolExhausted` (needs more blocks than the whole pool),
+        :class:`Rejected` (load shedding — bounded queue full with no
+        lower-priority victim, or deadline infeasible under the current
+        capacity estimate). Every shed path is counted under
+        ``serving.rejected{reason}``."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
         if not isinstance(request, Request):
             request = Request(request)
         P = len(request.prompt)
         worst = -(-(P + request.max_new_tokens - 1) // self.block_tokens)
         if worst > self.max_blocks_per_slot:
+            self._count_rejected(request, "too_long")
             raise ValueError(
                 f"request needs {worst} blocks "
                 f"({P}+{request.max_new_tokens} tokens) but max_seq_len "
@@ -353,14 +660,37 @@ class ServingEngine:
         lookup = ((P - 1) // self.block_tokens
                   if self.prefix_cache is not None else 0)
         if worst - lookup > self.pool.num_blocks - 1:
+            self._count_rejected(request, "never_fits")
             self.flight.auto_dump("pool_exhausted:submit")
             raise PoolExhausted(
                 f"request needs at least {worst - lookup} blocks; the "
                 f"whole pool has {self.pool.num_blocks - 1}")
+        if self.shed_infeasible and request.deadline_s is not None:
+            est = self.estimated_ttft_s(request)
+            if est is not None and est > request.deadline_s:
+                self._count_rejected(request, "deadline_infeasible")
+                raise Rejected(
+                    "deadline_infeasible",
+                    f"request {request.request_id} deadline "
+                    f"{request.deadline_s:.3f}s < estimated "
+                    f"queue-wait+prefill {est:.3f}s — it would expire "
+                    f"before its first token")
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            victim = self._queue.lowest_below(request.rank)
+            if victim is None:
+                self._count_rejected(request, "queue_full")
+                raise Rejected(
+                    "queue_full",
+                    f"queue at capacity ({self.max_queue}) with no "
+                    f"lower-priority request to displace")
+            self._shed_queued(victim, "displaced")
         if request.seed is None:
-            request.seed = self.seed + next(self._seed_counter)
+            request.seed = self.seed + self._seeds_issued
+            self._seeds_issued += 1
         request._t_submit = time.perf_counter()
-        self._queue.append(request)
+        request._seq = self._submit_seq
+        self._submit_seq += 1
+        self._queue.push(request)
         self._update_gauges()
         return request.request_id
 
@@ -374,7 +704,14 @@ class ServingEngine:
         short prompt streams every weight once — the same traffic as a
         whole decode step — so admissions that land on the same tick
         share one weight pass and one pool write instead of paying both
-        per request."""
+        per request.
+
+        Returns ``(fn, cached)`` — ``cached=False`` means this call
+        will pay the trace+compile, which the EWMA capacity estimator
+        must not ingest (a multi-second compile spike would make
+        ``shed_infeasible`` reject feasible deadlines for dozens of
+        steps; the ``serving.step_prefill_s`` histogram still sees it).
+        """
         from paddle_tpu.inference import (_fold_rows, _row_keys,
                                           _sample_logits)
         from paddle_tpu.nn.layer import functional_call
@@ -382,7 +719,7 @@ class ServingEngine:
         key = ("prefill", self.kv_int8, R, s_pad, n)
         fn = self._jit_cache.get(key)
         if fn is not None:
-            return fn
+            return fn, True
         nkv, hd = self.meta["num_kv_heads"], self.meta["head_dim"]
         dkv = self._dkv
         BT = self.block_tokens
@@ -454,85 +791,252 @@ class ServingEngine:
         jitted = jax.jit(impl, donate_argnums=(1,))
         fn = lambda *a: jitted(self._state, *a)   # noqa: E731
         self._jit_cache[key] = fn
-        return fn
+        return fn, False
+
+    def _release_slot(self, slot_idx: int):
+        """Free a slot's blocks and reservation and zero its block
+        table + host mirrors — the ONE teardown behind retire, preempt
+        and wave-unwind (a new per-slot mirror array must be reset
+        here, nowhere else)."""
+        s = self._slots[slot_idx]
+        for bid in s.blocks:
+            self.pool.free(bid)
+        self._reserved -= s.worst_blocks - s.ntab
+        self._slots[slot_idx] = None
+        self._tables[slot_idx][:] = SCRATCH_BLOCK
+        self._positions[slot_idx] = 0
+        self._toks[slot_idx] = 0
+        self._counts[slot_idx] = 0
+        self._dirty = True
+
+    def _preempt_victim(self, rank: int, exclude) -> Optional[int]:
+        """Slot index of the lowest-priority, loosest-deadline active
+        slot with priority STRICTLY below ``rank`` (preemption never
+        crosses within a class, so a preempted-then-requeued request
+        can never preempt its preemptor back — no ping-pong). ``exclude``
+        holds this tick's freshly admitted slots (their prefill has not
+        run; there is nothing to resume from)."""
+        best = best_key = None
+        for i, s in enumerate(self._slots):
+            if s is None or i in exclude or s.req.rank >= rank:
+                continue
+            slack = (float("inf") if s.deadline_at is None
+                     else s.deadline_at)
+            key = (s.req.rank, -slack)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _preempt(self, slot_idx: int):
+        """Retire a slot back to the queue with its generated-so-far
+        tokens: frees its blocks (bf16: after donating its full
+        immutable blocks to the prefix cache, so resume re-prefill
+        adopts instead of recomputing), releases its reservation, and
+        requeues the request for a token-exact resume."""
+        from paddle_tpu.observability import registry
+
+        s = self._slots[slot_idx]
+        req = s.req
+        req._resume_tokens = list(s.tokens)
+        req._t_first = s.t_first
+        if self.prefix_cache is not None and not self.kv_int8:
+            # feed = prompt + generated[:-1]: exactly the s.pos written
+            # positions; its full blocks are append-proof and already
+            # physically populated — cache them (the cache takes its own
+            # refs) so the resume prefill mostly gathers instead of
+            # recomputing
+            full = s.pos // self.block_tokens
+            if full:
+                self.prefix_cache.insert(
+                    np.concatenate([req.prompt, np.asarray(
+                        s.tokens[:-1], np.int32)]),
+                    0, block_ids=s.blocks[:full])
+        self._release_slot(slot_idx)
+        self._queue.push(req)
+        self.stats["preemptions"] += 1
+        registry().counter("serving.preemptions").inc()
+        self._tick_preempted.append(req.request_id)
+        if self._dump_pending is None:
+            self._dump_pending = "preemption"
 
     def _admit(self):
-        """FIFO admission: while a slot and the head request's
+        """Priority admission: while a slot and the head request's
         worst-case block reservation both fit, pop it into the current
         wave; the wave is grouped by prefill shape ``(R, s_pad)`` and
-        each group runs as ONE batched prefill program."""
-        from paddle_tpu.resilience import faults as _faults
-
-        BT = self.block_tokens
+        each group runs as ONE batched prefill program. The queue is
+        ordered (priority, submit order) and stays head-of-line WITHIN
+        that order; when the head cannot be placed, strictly
+        lower-priority slots are preempted (requeued resumable, never
+        dropped) to make room — first for a slot, then for blocks."""
         while self._queue:
             wave = []           # (slot_idx, slot, hits, R, s_pad)
-            while self._queue:
-                try:
-                    slot_idx = self._slots.index(None)
-                except ValueError:
-                    break
-                req = self._queue[0]
-                P = len(req.prompt)
-                n_lookup = (P - 1) // BT
-                hits = (self.prefix_cache.lookup(req.prompt, n_lookup,
-                                                 record=False)
-                        if self.prefix_cache is not None else [])
-                worst = -(-(P + req.max_new_tokens - 1) // BT)
-                # bf16 hits ride the cached PHYSICAL blocks (refcount++,
-                # no fresh allocation); int8 hits only skip prefill
-                # FLOPs — the slot still allocates every prompt block,
-                # so they don't reduce the worst-case reservation
-                spare = 0 if self.kv_int8 else len(hits)
-                short = (worst - spare
-                         - (self.pool.free_blocks - self._reserved))
-                if short > 0 and self.prefix_cache is not None:
-                    # cached-but-idle prefix blocks are reclaimable pool
-                    # capacity — evict LRU entries (never this request's
-                    # own hits) before declaring the pool full
-                    self.prefix_cache.evict_free(short, keep=hits)
-                    short = (worst - spare
-                             - (self.pool.free_blocks - self._reserved))
-                if short > 0:
-                    break       # head-of-line: keep arrival order
-                # fault site BEFORE the pop: a raising fault (the PR 4
-                # injection contract for decode.dispatch) leaves the
-                # request queued — a retried step() re-admits it; firing
-                # after the pop would lose it (no queue, slot or result)
-                _faults.maybe_fire("decode.dispatch")
-                self._queue.popleft()
-                if self.prefix_cache is not None:
-                    self.prefix_cache.commit(hits, n_lookup)
-
-                R = len(hits) * BT
-                n0 = -(-P // BT)        # blocks covering the prompt
-                s_pad = -(-(P - R) // BT) * BT
-                slot = _Slot(req, worst, len(hits))
-                row = self._tables[slot_idx]
-                row[:] = SCRATCH_BLOCK
-                if self.kv_int8:
-                    slot.blocks = self.pool.alloc(n0)
-                else:
-                    for e in hits:  # slot's own ref on shared blocks
-                        self.pool.ref(e.block_id)
-                    slot.blocks = ([e.block_id for e in hits]
-                                   + self.pool.alloc(n0 - len(hits)))
-                row[:n0] = slot.blocks
-                slot.ntab = n0
-                self._reserved += worst - n0
-                self._slots[slot_idx] = slot
-                self._tick_admitted.append(req.request_id)
-                self.stats["requests_admitted"] += 1
-                wave.append((slot_idx, slot, hits, R, s_pad))
+            wave_idx = set()    # slots admitted this wave: not preemptable
+            try:
+                self._collect_wave(wave, wave_idx)
+            except BaseException:
+                # a raising fault at a MID-wave admission pop (or any
+                # error before the wave's prefill ran) must not leave
+                # earlier same-wave slots active with unwritten KV — a
+                # retried step() would decode them from position 0 over
+                # garbage. Unwind every un-prefilled slot back to the
+                # queue (resumable, like a preemption) and re-raise.
+                self._unwind_wave(wave)
+                raise
             if not wave:
                 return
             self._dirty = True
             groups: Dict = {}
             for item in wave:
                 groups.setdefault((item[3], item[4]), []).append(item)
-            for (R, s_pad), grp in groups.items():
-                self._run_prefill_group(R, s_pad, grp)
+            try:
+                for (R, s_pad), grp in groups.items():
+                    self._run_prefill_group(R, s_pad, grp)
+            except BaseException:
+                self._unwind_wave(wave)     # only count==0 slots unwind
+                raise
             # an instantly-finished admission (eos/1-token budget on the
             # prefill sample) frees its slot — loop for the next wave
+
+    def _unwind_wave(self, wave):
+        """Return every slot in ``wave`` whose prefill never ran
+        (``count == 0`` — no KV written, no tokens) to the queue,
+        releasing its blocks and reservation; prefilled slots are fully
+        valid actives and stay."""
+        for slot_idx, slot, _hits, _R, _s_pad in wave:
+            if slot.count != 0 or self._slots[slot_idx] is not slot:
+                continue
+            req = slot.req
+            self._release_slot(slot_idx)
+            req._resume_tokens = slot.resume
+            self._queue.push(req)
+            if req.request_id in self._tick_admitted:
+                self._tick_admitted.remove(req.request_id)
+                self.stats["requests_admitted"] -= 1
+            if slot.resume and req.request_id in self._tick_resumed:
+                self._tick_resumed.remove(req.request_id)
+                self.stats["requests_resumed"] -= 1
+
+    def _collect_wave(self, wave, wave_idx):
+        """Pop admissible requests into ``wave`` (see :meth:`_admit`
+        for the policy; :meth:`_unwind_wave` for the fault contract)."""
+        from paddle_tpu.resilience import faults as _faults
+
+        BT = self.block_tokens
+        while self._queue:
+            req = self._queue.peek()
+            rank = req.rank
+            resume = req._resume_tokens
+            feed = (req.prompt if not resume else np.concatenate(
+                [req.prompt, np.asarray(resume[:-1], np.int32)]))
+            P = len(feed)
+            n_lookup = (P - 1) // BT
+            hits = (self.prefix_cache.lookup(feed, n_lookup,
+                                             record=False)
+                    if self.prefix_cache is not None else [])
+            # worst case covers the FINAL sequence (original prompt
+            # + full budget) — identical for fresh and resumed
+            # admissions, so a resume can always re-reserve what its
+            # first admission could
+            worst = -(-(len(req.prompt) + req.max_new_tokens - 1)
+                      // BT)
+            # bf16 hits ride the cached PHYSICAL blocks (refcount++,
+            # no fresh allocation); int8 hits only skip prefill
+            # FLOPs — the slot still allocates every prompt block,
+            # so they don't reduce the worst-case reservation
+            spare = 0 if self.kv_int8 else len(hits)
+            short = worst - spare - (self.pool.free_blocks
+                                     - self._reserved)
+            if short > 0:
+                # feasibility BEFORE destroying live work: preempting a
+                # victim gains at most its full reservation (physical
+                # blocks freed + blocks shifted to cache-only + the
+                # unreserved tail = worst_blocks), and eviction at most
+                # the cache-only blocks. If even that optimistic total
+                # cannot cover the shortfall, the head cannot be placed
+                # this tick — break with zero preemptions instead of
+                # evicting every lower-priority slot for nothing.
+                potential = sum(
+                    s.worst_blocks for i, s in enumerate(self._slots)
+                    if s is not None and i not in wave_idx
+                    and s.req.rank < rank)
+                if self.prefix_cache is not None:
+                    potential += self.prefix_cache.evictable_count(
+                        keep=hits)
+                if short > potential:
+                    break
+            try:
+                slot_idx = self._slots.index(None)
+            except ValueError:
+                victim = self._preempt_victim(rank, wave_idx)
+                if victim is None:
+                    break
+                self._preempt(victim)
+                slot_idx = victim
+                if self.prefix_cache is not None:
+                    # the preempt's cache insert may have LRU-evicted
+                    # stale `hits` entries (their blocks are gone) and
+                    # donated new shareable ones — re-probe before the
+                    # hits are adopted
+                    hits = self.prefix_cache.lookup(feed, n_lookup,
+                                                    record=False)
+                    spare = 0 if self.kv_int8 else len(hits)
+            while True:
+                short = (worst - spare
+                         - (self.pool.free_blocks - self._reserved))
+                if short <= 0:
+                    break
+                if self.prefix_cache is not None:
+                    # cached-but-idle prefix blocks are reclaimable
+                    # pool capacity — evict LRU entries (never this
+                    # request's own hits) before preempting live work
+                    if self.prefix_cache.evict_free(short, keep=hits):
+                        continue
+                victim = self._preempt_victim(rank, wave_idx)
+                if victim is None:
+                    break
+                self._preempt(victim)
+                if self.prefix_cache is not None:
+                    # the victim donated its blocks to the cache —
+                    # re-probe: the head may now share them
+                    hits = self.prefix_cache.lookup(feed, n_lookup,
+                                                    record=False)
+                    spare = 0 if self.kv_int8 else len(hits)
+            if short > 0:
+                break       # head-of-line within priority order
+            # fault site BEFORE the pop: a raising fault (the PR 4
+            # injection contract for decode.dispatch) leaves the
+            # request queued — a retried step() re-admits it; firing
+            # after the pop would lose it (no queue, slot or result)
+            _faults.maybe_fire("decode.dispatch")
+            self._queue.pop()
+            req._resume_tokens = None   # consumed; _preempt re-sets
+            if self.prefix_cache is not None:
+                self.prefix_cache.commit(hits, n_lookup)
+
+            R = len(hits) * BT
+            n0 = -(-P // BT)        # blocks covering the feed
+            s_pad = -(-(P - R) // BT) * BT
+            slot = _Slot(req, worst, len(hits), feed, resume)
+            row = self._tables[slot_idx]
+            row[:] = SCRATCH_BLOCK
+            if self.kv_int8:
+                slot.blocks = self.pool.alloc(n0)
+            else:
+                for e in hits:  # slot's own ref on shared blocks
+                    self.pool.ref(e.block_id)
+                slot.blocks = ([e.block_id for e in hits]
+                               + self.pool.alloc(n0 - len(hits)))
+            row[:n0] = slot.blocks
+            slot.ntab = n0
+            self._reserved += worst - n0
+            self._slots[slot_idx] = slot
+            self._tick_admitted.append(req.request_id)
+            self.stats["requests_admitted"] += 1
+            if resume:
+                self.stats["requests_resumed"] += 1
+                self._tick_resumed.append(req.request_id)
+            wave.append((slot_idx, slot, hits, R, s_pad))
+            wave_idx.add(slot_idx)
 
     def _run_prefill_group(self, R, s_pad, grp):
         """Run one batched prefill program and adopt each row's slot
@@ -551,12 +1055,18 @@ class ServingEngine:
         seeds = np.zeros(n, np.uint32)
         valid = np.zeros(n, np.int32)
         for r, (slot_idx, slot, hits, _, _) in enumerate(grp):
-            P = len(slot.req.prompt)
-            ids[r, :P - R] = slot.req.prompt[R:]
+            P = len(slot.feed)
+            ids[r, :P - R] = slot.feed[R:]
             last_idx[r] = P - 1 - R
             seeds[r] = np.uint32(slot.req.seed)
-            valid[r] = P
-        fn = self._prefill_wave_fn(R, s_pad, n)
+            # int8 calibration runs over the ORIGINAL prompt positions
+            # only — for a fresh request that is the whole feed; for a
+            # resume it reproduces the scales the uninterrupted run
+            # calibrated at ITS prefill (appends beyond the prompt were
+            # quantized with prompt-only scales there too, so resume
+            # stays token-exact)
+            valid[r] = len(slot.req.prompt)
+        fn, warm = self._prefill_wave_fn(R, s_pad, n)
         if self.kv_int8:
             new_bids = np.asarray([s.blocks for _, s, _, _, _ in grp],
                                   np.int32)                    # (n, n0)
@@ -583,30 +1093,47 @@ class ServingEngine:
                 jnp.asarray(new_bids), jnp.asarray(valid))
             lanes_np = kv_np = None
         tok_np = np.asarray(tok)
-        # the prefill sample is each request's first GENERATED token
-        # (stats["decode_tokens"] counts only decode-step tokens)
-        registry().counter("serving.tokens_generated").inc(n)
+        # the prefill sample is each FRESH request's first GENERATED
+        # token (stats["decode_tokens"] counts only decode-step tokens);
+        # a resumed row's sample is discarded — its next token comes
+        # from the next decode step at fold_in(seed, count), exactly
+        # where the uninterrupted run's stream stood
+        fresh = sum(1 for _, s, _, _, _ in grp if not s.resume)
+        if fresh:
+            registry().counter("serving.tokens_generated").inc(fresh)
+        if fresh != n:
+            registry().counter("serving.resumed").inc(n - fresh)
         eos = self.eos_token_id
         for r, (slot_idx, slot, hits, _, _) in enumerate(grp):
             req = slot.req
-            P = len(req.prompt)
+            P = len(slot.feed)
             if lanes_np is not None:
                 self._kv_scales[:, slot_idx, :] = lanes_np[:, r]
             slot.pos = P
-            slot.count = 1
-            slot.tok = int(tok_np[r])
-            slot.tokens = [slot.tok]
-            slot.t_first = time.perf_counter()
+            if slot.resume:
+                slot.count = len(slot.resume)
+                slot.tok = int(slot.resume[-1])
+                slot.tokens = list(slot.resume)
+                # TTFT is measured once, at the ORIGINAL first token —
+                # a preemption must not reset it (crash restore has no
+                # surviving monotonic base; it restarts the clock)
+                slot.t_first = (req._t_first if req._t_first is not None
+                                else time.perf_counter())
+            else:
+                slot.count = 1
+                slot.tok = int(tok_np[r])
+                slot.tokens = [slot.tok]
+                slot.t_first = time.perf_counter()
             if req.deadline_s is not None:
                 slot.deadline_at = req._t_submit + req.deadline_s
             self._positions[slot_idx] = P
             self._toks[slot_idx] = slot.tok
             self._seeds[slot_idx] = np.uint32(req.seed)
-            self._counts[slot_idx] = 1
+            self._counts[slot_idx] = slot.count
             self.stats["prefill_tokens"] += P - R
             self.stats["prefill_tokens_reused"] += R
             if self.prefix_cache is not None:
-                # full prompt blocks are append-proof (appends land at
+                # full feed blocks are append-proof (appends land at
                 # pos >= P) — bf16 shares them as-is, copy-on-write by
                 # construction; int8 keeps exact bf16 copies host-side.
                 # Inserts land AFTER the wave program so a same-wave
@@ -617,13 +1144,13 @@ class ServingEngine:
                     # copy the slices: a view would pin the whole wave's
                     # (L, n, cache_len, 2dkv) buffer per cached block
                     self.prefix_cache.insert(
-                        req.prompt, nh,
+                        slot.feed, nh,
                         kv_host=[np.ascontiguousarray(
                             kv_np[:, r, c * BT:(c + 1) * BT])
                                  for c in range(nh, P // BT)])
                 else:
                     self.prefix_cache.insert(
-                        req.prompt, nh,
+                        slot.feed, nh,
                         block_ids=slot.blocks[nh:P // BT])
             if (eos is not None and slot.tok == int(eos)) \
                     or slot.count >= req.max_new_tokens:
@@ -631,7 +1158,10 @@ class ServingEngine:
                              "eos" if eos is not None
                              and slot.tok == int(eos) else "length")
         self._tick_prefills.append((R, s_pad, n))
-        self._tick_prefill_s += time.perf_counter() - t_pf0
+        t_grp = time.perf_counter() - t_pf0
+        self._tick_prefill_s += t_grp
+        if warm:        # compile spikes must not poison the estimator
+            self._ewma_prefill.update(t_grp)
 
     # -------------------------------------------------------------- decode
     def _build_step_fn(self):
@@ -704,15 +1234,7 @@ class ServingEngine:
 
         s = self._slots[slot_idx]
         now = time.perf_counter()
-        for bid in s.blocks:
-            self.pool.free(bid)
-        self._reserved -= s.worst_blocks - s.ntab
-        self._slots[slot_idx] = None
-        self._tables[slot_idx][:] = SCRATCH_BLOCK
-        self._positions[slot_idx] = 0
-        self._toks[slot_idx] = 0
-        self._counts[slot_idx] = 0
-        self._dirty = True
+        self._release_slot(slot_idx)
 
         toks = np.asarray(s.tokens, np.int32)
         eos = self.eos_token_id
@@ -769,11 +1291,21 @@ class ServingEngine:
         ``PoolExhausted``) still records a partial event carrying the
         error, auto-dumps the ring, and re-raises.
         """
-        self._finished_tick = []
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        # shed events between ticks (submit-time displacement) surface
+        # in THIS tick's finished list — step()['finished'] stays the
+        # complete result-collection contract
+        self._finished_tick = list(self._pending_finished)
+        self._pending_finished = []
         self._tick_admitted = []
         self._tick_retired = []
         self._tick_prefills = []
         self._tick_prefill_s = 0.0
+        self._tick_preempted = []
+        self._tick_resumed = []
+        # _tick_shed keeps accumulating across submit() calls between
+        # ticks; _record_flight drains it into this tick's event
         t0 = time.perf_counter()
         try:
             return self._step_inner(t0)
@@ -888,6 +1420,15 @@ class ServingEngine:
             st["step_sync_s"] += sync_s
             r.histogram("serving.step_dispatch_s").observe(dispatch_s)
             r.histogram("serving.step_sync_s").observe(sync_s)
+            # capacity-estimator feed: the same decode-step cost the
+            # histograms just observed (shed_infeasible prices deadlines
+            # against this EWMA) — except the first dispatch, whose
+            # trace+compile would poison the estimate for dozens of
+            # steps and shed feasible deadlines right after startup
+            if self._step_fn_warm:
+                self._ewma_step.update(dispatch_s + sync_s)
+            else:
+                self._step_fn_warm = True
 
     def _record_flight(self, admit_s, dispatch_s, sync_s, err=None):
         """One compact JSON-ready event per tick into the flight ring."""
@@ -897,6 +1438,9 @@ class ServingEngine:
                "blocks_reserved": self._reserved,
                "admitted": list(self._tick_admitted),
                "retired": [[rid, fin] for rid, fin in self._tick_retired],
+               "preempted": list(self._tick_preempted),
+               "resumed": list(self._tick_resumed),
+               "shed": [[rid, reason] for rid, reason in self._tick_shed],
                "prefills": [[R, s_pad, n]
                             for R, s_pad, n in self._tick_prefills],
                "t_admit_s": round(admit_s, 6),
@@ -907,6 +1451,7 @@ class ServingEngine:
         if err is not None:
             evt["err"] = err
         self.flight.record(evt)
+        self._tick_shed = []    # drained into this tick's event
         self._step_seq += 1
 
     def pop_result(self, request_id: int) -> RequestResult:
@@ -937,7 +1482,7 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 break
             if q0 > 0 and self.active_slots == 0 and len(self._queue) == q0:
-                head = self._queue[0]
+                head = self._queue.peek()
                 self.flight.auto_dump("pool_exhausted:drain_stall")
                 raise PoolExhausted(
                     f"drain stalled: request {head.request_id} "
@@ -952,3 +1497,234 @@ class ServingEngine:
                for p in prompts]
         self.drain()
         return [self.results[i].ids for i in ids]
+
+    # ------------------------------------------------- lifecycle: close
+    def close(self):
+        """Release the engine's device and host memory: the KV pool and
+        stacked-weight arrays, the device mirrors, the jitted programs,
+        and the prefix cache's host copies. In-flight and queued
+        requests are DROPPED — :meth:`save_snapshot` first if they must
+        survive. Idempotent; a closed engine rejects ``submit``/``step``
+        with ``RuntimeError``. Long-running benches and tests should
+        close (or use the engine as a context manager) so back-to-back
+        engines don't stack live KV pools."""
+        if self._closed:
+            return
+        self._closed = True
+        for a in (self.kv_pool, self._stacked):
+            try:
+                if a is not None:
+                    jax.tree_util.tree_map(
+                        lambda x: x.delete() if hasattr(x, "delete")
+                        else None, a)
+            except Exception:   # noqa: BLE001 — best-effort release
+                pass
+        self.kv_pool = None
+        self._stacked = None
+        self._dev = None
+        self._step_fn = None
+        self._jit_cache.clear()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self._slots = [None] * self.max_slots
+        self._queue = _PriorityQueue()
+        self._tables = self._positions = self._toks = None
+        self._seeds = self._counts = self._kv_scales = None
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -------------------------------------- crash-recoverable snapshot
+    def snapshot(self) -> Dict:
+        """Serializable engine state (``paddle_tpu.engine_snapshot/v1``):
+        the queue and every active slot as resumable requests (id,
+        prompt, generated-so-far tokens, seed, priority, remaining
+        deadline), finished results, the prefix-cache keys, and the
+        constructor config + a model fingerprint. Token-exact by
+        construction: a request's tokens and RNG seed are the COMPLETE
+        decode state — :meth:`restore` re-prefills prompt+generated and
+        continues the same ``fold_in(seed, count)`` stream, so KV never
+        needs to survive the crash.
+
+        Call between ``step()`` calls, or after a ``step()`` that died
+        on a fault — the host-side scheduler state stays consistent
+        across an aborted tick (the fault sites fire *before* queue
+        pops / token appends)."""
+        now = time.perf_counter()
+
+        def _req(req: Request, tokens, deadline_at=None):
+            if deadline_at is not None:
+                rem = max(deadline_at - now, 1e-9)
+            elif req.deadline_s is not None and req._t_submit is not None:
+                rem = max(req._t_submit + req.deadline_s - now, 1e-9)
+            else:
+                rem = req.deadline_s
+            return {"request_id": req.request_id,
+                    "prompt": [int(t) for t in req.prompt],
+                    "max_new_tokens": req.max_new_tokens,
+                    "seed": int(req.seed) if req.seed is not None else None,
+                    "priority": req.priority, "seq": req._seq,
+                    "deadline_remaining_s": rem,
+                    "tokens": [int(t) for t in tokens]}
+
+        slots = [_req(s.req, s.tokens, s.deadline_at)
+                 for s in self._slots if s is not None]
+        queue = [_req(r, r._resume_tokens or []) for r in self._queue]
+        results = [{"request_id": res.request_id,
+                    "prompt": [int(t) for t in res.prompt],
+                    "tokens": [int(t) for t in res.tokens],
+                    "gen_len": res.gen_len, "finish": res.finish,
+                    "ttft_s": res.ttft_s, "tpot_s": res.tpot_s,
+                    "prefix_hit_blocks": res.prefix_hit_blocks}
+                   for res in self.results.values()]
+        config = {"max_slots": self.max_slots,
+                  "block_tokens": self.block_tokens,
+                  "num_blocks": self.pool.num_blocks,
+                  "max_seq_len": self.max_seq_len,
+                  "cache_dtype": jnp.dtype(self.cache_dtype).name,
+                  "temperature": self.temperature, "top_k": self.top_k,
+                  "top_p": self.top_p,
+                  "eos_token_id": self.eos_token_id, "seed": self.seed,
+                  "prefix_caching": self.prefix_cache is not None,
+                  "prefix_cache_blocks": (
+                      self.prefix_cache.capacity
+                      if self.prefix_cache is not None else 256),
+                  "flight_capacity": self.flight.capacity,
+                  "flight_dump_path": self.flight.auto_dump_path,
+                  "max_queue": self.max_queue,
+                  "shed_infeasible": self.shed_infeasible}
+        fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
+                       "dkv": self._dkv}
+        return {"schema": ENGINE_SNAPSHOT_SCHEMA, "ts": time.time(),
+                "step_seq": self._step_seq, "config": config,
+                "model": fingerprint, "slots": slots, "queue": queue,
+                "results": results,
+                "prefix_keys": (self.prefix_cache.keys()
+                                if self.prefix_cache is not None else []),
+                "seeds_issued": self._seeds_issued,
+                "submit_seq": self._submit_seq}
+
+    def save_snapshot(self, root: str) -> str:
+        """Commit :meth:`snapshot` to disk through the PR 4 integrity
+        path: ``<root>/step_<seq>/engine.json`` (atomic tmp+rename),
+        then the ``<root>/integrity/step_<seq>.json`` manifest whose
+        existence IS the commit marker — :meth:`restore` walks back
+        past uncommitted or corrupt snapshots exactly like checkpoint
+        resume does. Returns the step directory."""
+        from paddle_tpu.observability import registry
+        from paddle_tpu.resilience import faults as _faults
+        from paddle_tpu.resilience import integrity as _integ
+
+        _faults.maybe_fire("serving.snapshot")
+        snap = self.snapshot()
+        step = snap["step_seq"]
+        step_dir = os.path.join(root, f"step_{step}")
+        os.makedirs(step_dir, exist_ok=True)
+        path = os.path.join(step_dir, "engine.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _integ.write_manifest(root, step, _integ.file_checksums(step_dir))
+        registry().counter("serving.snapshots").inc()
+        return step_dir
+
+    @staticmethod
+    def load_snapshot(root: str) -> Dict:
+        """Newest committed-and-intact snapshot under ``root``: walk the
+        manifest steps newest-first, skip any whose files fail the
+        size/crc check (``resilience.snapshot_corrupt_skipped``) — one
+        torn snapshot write must not strand the restore."""
+        from paddle_tpu.resilience import integrity as _integ
+        from paddle_tpu.resilience import record_event
+
+        for step in _integ.manifest_steps(root):
+            manifest = _integ.read_manifest(root, step)
+            if manifest is None:
+                continue
+            step_dir = os.path.join(root, f"step_{step}")
+            ok, reason = _integ.verify_files(manifest, step_dir)
+            if not ok:
+                record_event("snapshot_corrupt_skipped")
+                logger.warning("engine snapshot step %d failed "
+                               "verification (%s); walking back",
+                               step, reason)
+                continue
+            with open(os.path.join(step_dir, "engine.json")) as f:
+                return json.load(f)
+        raise FileNotFoundError(
+            f"no committed intact engine snapshot under {root}")
+
+    @classmethod
+    def restore(cls, model, source, *, state: Optional[Dict] = None,
+                **overrides) -> "ServingEngine":
+        """Rebuild an engine from a snapshot (dict, or a
+        :meth:`save_snapshot` root directory) and re-admit EVERY
+        request — in-flight slots and queued work alike — through the
+        token-exact resume path: zero loss across a crash. Finished
+        results carry over. ``overrides`` replace constructor config
+        (e.g. a new ``flight_dump_path``)."""
+        from paddle_tpu.observability import registry
+        from paddle_tpu.resilience import record_event
+
+        snap = (cls.load_snapshot(source) if isinstance(source, str)
+                else source)
+        if snap.get("schema") != ENGINE_SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"not an engine snapshot: schema "
+                f"{snap.get('schema')!r} != {ENGINE_SNAPSHOT_SCHEMA!r}")
+        cfg = dict(snap["config"])
+        cfg["cache_dtype"] = jnp.dtype(cfg["cache_dtype"])
+        cfg.update(overrides)
+        eng = cls(model, state=state, **cfg)
+        fp = snap.get("model", {})
+        if fp and (fp.get("arch") != eng.arch
+                   or fp.get("num_layers") != eng._num_layers
+                   or fp.get("dkv") != eng._dkv):
+            raise ValueError(
+                f"model mismatch: snapshot was taken on "
+                f"{fp}, restoring onto arch={eng.arch} "
+                f"L={eng._num_layers} dkv={eng._dkv}")
+        eng._seeds_issued = int(snap.get("seeds_issued", 0))
+        eng._submit_seq = int(snap.get("submit_seq", 0))
+        now = time.perf_counter()
+        # in-flight slots first, then the queue — both were serialized
+        # in scheduling order and keep their original seq, so the
+        # restored queue pops in the order the crashed engine would have
+        restored = []
+        for rs in snap["slots"] + snap["queue"]:
+            req = Request(np.asarray(rs["prompt"], np.int32),
+                          rs["max_new_tokens"], seed=rs["seed"],
+                          deadline_s=rs["deadline_remaining_s"],
+                          priority=rs.get("priority", "normal"),
+                          request_id=rs["request_id"])
+            req._seq = int(rs.get("seq", 0))
+            eng._submit_seq = max(eng._submit_seq, req._seq + 1)
+            req._t_submit = now     # remaining deadline re-anchors here
+            req._resume_tokens = list(rs["tokens"]) or None
+            eng._queue.push(req)
+            restored.append(req.request_id)
+        for rr in snap.get("results", []):
+            eng.results[rr["request_id"]] = RequestResult(
+                rr["request_id"], np.asarray(rr["prompt"], np.int32),
+                rr["tokens"], rr["gen_len"], rr["finish"], rr["ttft_s"],
+                rr["tpot_s"], rr["prefix_hit_blocks"])
+        eng._step_seq = int(snap.get("step_seq", 0)) + 1
+        registry().counter("serving.restores").inc()
+        record_event("engine_restored")
+        eng.flight.mark("restore", restored=restored,
+                        results_carried=len(snap.get("results", [])),
+                        from_step_seq=snap.get("step_seq"))
+        eng.flight.auto_dump("restore")
+        eng._update_gauges()
+        return eng

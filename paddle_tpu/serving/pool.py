@@ -245,6 +245,17 @@ class PrefixCache:
             if e.block_id is not None:
                 self.pool.free(e.block_id)
 
+    def evictable_count(self, keep: Sequence = ()) -> int:
+        """How many physical blocks :meth:`evict_free` could reclaim
+        right now (cache-only references, not in ``keep``) — the upper
+        bound admission's preemption feasibility pre-check adds to the
+        free pool before deciding whether evicting/preempting can ever
+        cover a shortfall."""
+        skip = {id(e) for e in keep}
+        return sum(1 for e in self._entries.values()
+                   if e.block_id is not None and id(e) not in skip
+                   and self.pool.refcount(e.block_id) == 1)
+
     def evict_free(self, n_blocks: int, keep: Sequence = ()) -> int:
         """Return up to ``n_blocks`` physical blocks to the pool by
         evicting LRU entries the cache ALONE still references (refcount
@@ -268,6 +279,13 @@ class PrefixCache:
                 del self._entries[key]
                 freed += 1
         return freed
+
+    def keys(self) -> List[str]:
+        """Hex digests of every cached chain key (engine snapshots carry
+        them so a postmortem can see what was shared at crash time; the
+        payloads — device blocks / host KV copies — do not survive a
+        restore, which re-populates the cache organically)."""
+        return [k.hex() for k in self._entries]
 
     def clear(self):
         for e in self._entries.values():

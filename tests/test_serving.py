@@ -286,7 +286,7 @@ def test_deadline_evicted_slot_frees_blocks():
     eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
                                 max_seq_len=128, prefix_caching=False)
     rid = eng.submit(serving.Request(p, max_new_tokens=64,
-                                     deadline_s=0.0))
+                                     deadline_s=1e-9))
     eng.step()                      # admit + prefill
     # expired before the next dispatch: retired with >= 1 token, blocks
     # returned, reservation released

@@ -299,7 +299,7 @@ def test_engine_step_segments_flight_and_auto_dumps(tmp_path):
     assert not os.path.exists(dump)     # nothing dumped on a clean run
 
     # -- (3): deadline retirement auto-dumps --------------------------------
-    rd = eng.submit(serving.Request(p, max_new_tokens=4, deadline_s=0.0))
+    rd = eng.submit(serving.Request(p, max_new_tokens=4, deadline_s=1e-9))
     eng.step()
     assert eng.results[rd].finish == "deadline"
     secs = _dump_sections(dump)
@@ -371,17 +371,21 @@ def test_metric_names_documented_in_observability_table():
 # ---- load_bench smoke (open-loop harness, BENCH percentile fields) ----------
 
 def test_load_bench_smoke_emits_slo_percentiles():
-    """`not slow` CI smoke: load_bench at tiny CPU scale must emit one
-    schema-valid record per offered-load point carrying p50/p95/p99
-    TTFT+TPOT, goodput-under-SLO and the step-segment breakdown, plus
-    the final knee record with the full curve."""
+    """`not slow` CI smoke: load_bench at tiny CPU scale (with the PR 8
+    overload knobs armed: --shed bounded queue + a priority mix) must
+    emit one schema-valid record per offered-load point carrying
+    p50/p95/p99 TTFT+TPOT, goodput-under-SLO, the step-segment
+    breakdown and the shed_rate/preemptions robustness fields, plus the
+    final knee record with the full curve."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", "load_bench.py"),
          "--model", "llama-tiny", "--requests", "5", "--slots", "2",
          "--block_tokens", "16", "--min_prompt", "4", "--max_prompt",
          "12", "--min_new", "2", "--max_new", "6", "--loads", "0.5,2.0",
-         "--slo_ttft_s", "30", "--slo_tpot_s", "30"],
+         "--slo_ttft_s", "30", "--slo_tpot_s", "30",
+         "--shed", "--max_queue", "8",
+         "--priority_mix", "low:1,normal:2,high:1"],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     recs = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -399,6 +403,11 @@ def test_load_bench_smoke_emits_slo_percentiles():
         assert 0.0 <= rec["goodput"] <= 1.0
         assert set(rec["step_breakdown_s"]) == {"admit", "prefill",
                                                 "dispatch", "sync"}
+        # the robustness fields ride every point (small queue bound +
+        # no deadlines here, so typically zero — presence and type are
+        # the contract, schema-validated above)
+        assert 0.0 <= rec["shed_rate"] <= 1.0
+        assert rec["preemptions"] >= 0
     assert recs[0]["offered_rps"] < recs[1]["offered_rps"]
     knee = recs[2]
     assert knee["unit"] == "req/s" and len(knee["curve"]) == 2
