@@ -148,6 +148,11 @@ def main():
                     "to `python examples/scale_report.py --report "
                     "/tmp/decode_bench_prof --plan PATH` for the "
                     "per-phase %%-of-roofline table")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="pin the warm path: after warmup, one "
+                         "generate pair runs under no_recompile "
+                         "and dies on any compile "
+                         "(paddle_tpu.analysis.runtime)")
     ap.add_argument("--reps", type=int, default=3,
                     help="wall-timing repetitions (CI smoke uses 1)")
     ap.add_argument("--eos", type=int, default=None,
@@ -238,6 +243,15 @@ def main():
     n_short = max(8, ns.new_tokens // 4)
     timed(n_short)            # compile both lengths
     timed(ns.new_tokens)
+    if ns.sanitize:
+        # warm-path pin: the measured reps below must be pure cache
+        # hits — a recompile here is exactly the silent regression the
+        # sanitizer exists to catch (docs/ANALYSIS.md)
+        from paddle_tpu.analysis import runtime as _sanitizer
+        with _sanitizer.no_recompile(
+                what="warm decode_bench generate pair"):
+            timed(n_short)
+            timed(ns.new_tokens)
     # the tunnel adds 10-300 ms of nondeterministic wall overhead per
     # dispatch; measure the DEVICE clock via the xplane parser when
     # available (min-of-reps wall marginal as fallback), marginal between

@@ -219,6 +219,11 @@ def main():
     ap.add_argument("--flight_dump", default=None,
                     help="flight-recorder auto-dump path (postmortems "
                     "on fault/pool/deadline events)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="arm the dispatch sanitizer: steady-state "
+                         "engine steps must perform 0 H2D transfers "
+                         "and 0 recompiles or the bench dies "
+                         "(paddle_tpu.analysis.runtime)")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -240,7 +245,8 @@ def main():
         model, max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
-        prefix_caching=False, flight_dump_path=ns.flight_dump)
+        prefix_caching=False, flight_dump_path=ns.flight_dump,
+        sanitize=ns.sanitize)
 
     rng = np.random.RandomState(ns.seed)
     reqs = make_requests(ns, rng)

@@ -163,7 +163,8 @@ def run_continuous(model, reqs, ns):
     eng = serving.ServingEngine(
         model, max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
-        cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16)
+        cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
+        sanitize=getattr(ns, "sanitize", False))
     return drive(eng, reqs), eng
 
 
@@ -224,6 +225,11 @@ def main():
                     "goodput-under-SLO (examples/load_bench.py is the "
                     "open-loop harness built around that number)")
     ap.add_argument("--slo_tpot_s", type=float, default=None)
+    ap.add_argument("--sanitize", action="store_true",
+                    help="arm the dispatch sanitizer: steady-state "
+                         "engine steps must perform 0 H2D transfers "
+                         "and 0 recompiles or the bench dies "
+                         "(paddle_tpu.analysis.runtime)")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 

@@ -1,0 +1,37 @@
+"""Correctness tooling for the hot-path invariants (docs/ANALYSIS.md).
+
+Two layers:
+
+* **static** — ``tpu-lint`` (:mod:`paddle_tpu.analysis.lint`,
+  ``python -m paddle_tpu.analysis``): AST rules for implicit host
+  syncs, Python branches on traced values in jit-reachable code,
+  float64 defaults in kernel files, metric-name drift vs the docs
+  table, and unregistered fault sites — with a checked-in baseline
+  and ``# tpu-lint: allow(<rule>)`` inline suppressions.
+* **runtime** — the dispatch sanitizer
+  (:mod:`paddle_tpu.analysis.runtime`): ``no_transfer`` /
+  ``no_recompile`` / ``sanitize`` context guards, wired into
+  ``ServingEngine(sanitize=True)`` and the benches' ``--sanitize``.
+
+The lint layer never imports jax (it must run in seconds as a tier-1
+gate); the runtime layer does. Importing the runtime names through
+this package is lazy for that reason.
+"""
+
+from paddle_tpu.analysis.lint import (ALL_RULES, Finding, LintResult,
+                                      run_lint)
+
+_RUNTIME_NAMES = ("CompileCounter", "RecompileError", "TransferError",
+                  "count_compiles", "no_recompile", "no_transfer",
+                  "sanitize", "compile_events_supported")
+
+__all__ = ["ALL_RULES", "Finding", "LintResult", "run_lint",
+           *_RUNTIME_NAMES]
+
+
+def __getattr__(name):
+    if name in _RUNTIME_NAMES:
+        from paddle_tpu.analysis import runtime
+        return getattr(runtime, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,103 @@
+"""``python -m paddle_tpu.analysis`` — the tpu-lint CLI.
+
+Modes:
+
+* (default) — print every unsuppressed, non-baselined finding.
+* ``--check`` — same, but exit 1 if any exist (the tier-1 gate; a
+  stale baseline entry is reported but does not fail).
+* ``--update-baseline`` — regenerate analysis/baseline.json from the
+  current unsuppressed findings (deterministic: sorted,
+  path-relative; see analysis/baseline.py).
+
+``--rules r1,r2`` restricts the rule set, ``--paths a b`` restricts
+reported findings to repo-relative prefixes, ``--json`` emits a
+machine-readable report, ``--show-baselined`` / ``--show-suppressed``
+include the pinned/annotated sites in the listing.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    from paddle_tpu.analysis import baseline as baseline_mod
+    from paddle_tpu.analysis import lint
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tpu-lint: static enforcement of the hot-path "
+                    "invariants (docs/ANALYSIS.md)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any unsuppressed, "
+                         "non-baselined finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate analysis/baseline.json from the "
+                         "current unsuppressed findings")
+    ap.add_argument("--rules", default=None,
+                    help=f"comma-separated subset of "
+                         f"{','.join(lint.ALL_RULES)}")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="repo-relative path prefixes to report on "
+                         "(the call graph still spans the package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--show-baselined", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, pin ignored")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and (args.rules or args.paths):
+        # a filtered run sees a SUBSET of findings; writing it would
+        # silently erase every other pinned entry and fail the next
+        # plain --check on all of them
+        ap.error("--update-baseline regenerates the whole pin and "
+                 "cannot be combined with --rules/--paths")
+    rules = (tuple(r.strip() for r in args.rules.split(","))
+             if args.rules else lint.ALL_RULES)
+    t0 = time.perf_counter()
+    root = lint.repo_root()
+    result = lint.run_lint(
+        root, rules=rules, paths=args.paths,
+        respect_baseline=not (args.no_baseline or args.update_baseline))
+    wall = time.perf_counter() - t0
+
+    if args.update_baseline:
+        path = baseline_mod.write(root, result.findings)
+        print(f"tpu-lint: wrote {len(result.findings)} pinned "
+              f"finding(s) to {path}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": [f.to_json() for f in result.suppressed],
+            "baselined": [f.to_json() for f in result.baselined],
+            "stale_baseline": [list(k) for k in result.stale_baseline],
+            "wall_s": round(wall, 3)}, indent=1))
+    else:
+        shown = list(result.findings)
+        if args.show_baselined:
+            shown += result.baselined
+        if args.show_suppressed:
+            shown += result.suppressed
+        for f in sorted(shown, key=lambda f: f.sort_key()):
+            tag = ("" if f in result.findings else
+                   " (baselined)" if f in result.baselined
+                   else " (suppressed)")
+            print(f"{f.path}:{f.line}:{f.col}: [{f.rule}] "
+                  f"{f.message}{tag}")
+        for key in result.stale_baseline:
+            print(f"stale baseline entry (site fixed or moved — rerun "
+                  f"--update-baseline): {key[1]}: [{key[0]}] "
+                  f"{key[2][:60]}")
+        print(f"tpu-lint: {result.summary()} in {wall:.2f}s")
+    if args.check and not result.ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

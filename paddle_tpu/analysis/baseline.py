@@ -1,0 +1,91 @@
+"""The lint baseline: pinned pre-existing violations.
+
+``analysis/baseline.json`` is a checked-in multiset of findings that
+predate the linter (schema ``paddle_tpu.lint_baseline/v1``). A
+``--check`` run fails only on findings NOT in the pin, so the gate can
+land without a flag-day cleanup of every legacy site — while any *new*
+sync/branch/dtype regression fails immediately.
+
+Matching is by ``(rule, path, stripped-source-line)`` — not line
+number — so unrelated edits that shift a file don't invalidate the
+pin; editing the flagged line itself DOES (the site changed; it must
+be re-classified: fixed, suppressed with a reason, or re-pinned).
+
+``--update-baseline`` regenerates the file deterministically: findings
+sorted by (path, line, rule), repo-relative paths, LF, trailing
+newline — two runs over the same tree are byte-identical (pinned by
+tests/test_analysis.py).
+"""
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_SCHEMA = "paddle_tpu.lint_baseline/v1"
+
+__all__ = ["BASELINE_SCHEMA", "baseline_path", "load", "apply", "render",
+           "write"]
+
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "paddle_tpu", "analysis", "baseline.json")
+
+
+def load(root: str) -> Counter:
+    """(rule, path, code) -> pinned count. Missing file = empty pin."""
+    path = baseline_path(root)
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {data.get('schema')!r} != "
+            f"{BASELINE_SCHEMA!r}")
+    pinned: Counter = Counter()
+    for e in data.get("findings", []):
+        pinned[(e["rule"], e["path"], e.get("code", ""))] += 1
+    return pinned
+
+
+def apply(findings: List, pinned: Counter
+          ) -> Tuple[List, List, List[Tuple]]:
+    """Partition ``findings`` into (new, baselined) against the pin and
+    report stale pin entries (pinned but no longer produced). Multiset
+    semantics: a file with two identical flagged lines needs two pin
+    entries — fixing one of them retires one."""
+    budget = Counter(pinned)
+    new, baselined = [], []
+    for f in findings:
+        k = f.key()
+        if budget[k] > 0:
+            budget[k] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = sorted(budget.elements())
+    return new, baselined, stale
+
+
+def render(findings: List) -> str:
+    """Deterministic baseline document for the given findings (which
+    should be the run's unsuppressed findings, pre-baseline)."""
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "code": f.code}
+               for f in sorted(findings, key=lambda f: f.sort_key())]
+    doc = {"schema": BASELINE_SCHEMA,
+           "note": ("pre-existing tpu-lint violations; only NEW findings "
+                    "fail --check. Regenerate with "
+                    "`python -m paddle_tpu.analysis --update-baseline`; "
+                    "burn entries down by fixing the site or annotating "
+                    "it with `# tpu-lint: allow(<rule>): reason`."),
+           "findings": entries}
+    return json.dumps(doc, indent=1, sort_keys=False) + "\n"
+
+
+def write(root: str, findings: List) -> str:
+    path = baseline_path(root)
+    with open(path, "w", encoding="utf-8", newline="\n") as fh:
+        fh.write(render(findings))
+    return path

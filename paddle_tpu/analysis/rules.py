@@ -1,0 +1,638 @@
+"""The tpu-lint rule set — repo-specific hot-path invariants as checks.
+
+Every rule yields :class:`Finding`s; the driver (analysis/lint.py)
+applies inline suppressions (``# tpu-lint: allow(<rule>)``) and the
+checked-in baseline on top, so a rule is free to be *conservative*
+(flag everything that is shaped like a violation) and let intentional
+sites be annotated where they live.
+
+Rule catalog (docs/ANALYSIS.md has the workflow):
+
+``host-sync``
+    Implicit host synchronization: ``.item()``, ``np.asarray`` /
+    ``np.array`` / ``np.ascontiguousarray`` on non-literal arguments
+    (a device array operand forces a D2H pull), ``jax.device_get``,
+    ``block_until_ready``, and — inside jit-reachable functions only —
+    ``float()/int()/bool()`` on array-shaped values (a concretization
+    sync under trace). One stray site on the decode hot path regresses
+    dispatch latency silently; every intentional site must say why.
+
+``traced-branch``
+    Python ``if``/``while``/``assert``/ternary on a value produced by
+    a ``jnp``/``lax`` computation inside a function reachable from a
+    ``jax.jit``/``pjit`` entry point (analysis/callgraph.py) — under
+    trace this is a ConcretizationError at best, a silent
+    recompile-per-value at worst. Static extractions (``.shape``,
+    ``.ndim``, ``.dtype``, ``len()``, ``is None``) are exempt.
+
+``default-dtype``
+    Kernel files (``ops/``, ``inference/``, ``serving/``): numpy array
+    creation with the implicit float64/int64 default dtype, and any
+    explicit ``float64`` — a float64 operand silently doubles memory
+    traffic and detunes TPU-shaped kernels.
+
+``metric-drift``
+    Every ``counter/gauge/histogram/sketch("serving.|resilience.|
+    decode.*")`` literal in the package must appear in
+    docs/OBSERVABILITY.md (the PR 7 drift grep, promoted to a rule —
+    tests/test_slo.py delegates here).
+
+``fault-site``
+    ``maybe_fire(...)`` / ``Fault(...)`` site literals must be
+    registered in ``resilience.faults.KNOWN_SITES`` — an unregistered
+    site is a hook the fault-injection docs and chaos tooling cannot
+    see.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+__all__ = ["Finding", "ALL_RULES", "KERNEL_DIRS", "collect_metric_names",
+           "known_fault_sites", "run_rules"]
+
+KERNEL_DIRS = ("paddle_tpu/ops", "paddle_tpu/inference",
+               "paddle_tpu/serving")
+
+_NUMPY_CREATORS = {"zeros", "ones", "empty", "full", "arange",
+                   "linspace", "eye", "identity"}
+_DTYPE_NAMES = {"float32", "float16", "bfloat16", "float64", "int8",
+                "int16", "int32", "int64", "uint8", "uint16", "uint32",
+                "uint64", "bool_", "complex64", "intp", "float0"}
+#: jnp/lax attribute calls that return static METADATA, not traced data
+_STATIC_MODULE_CALLS = {"dtype", "issubdtype", "result_type",
+                        "promote_types", "iinfo", "finfo", "shape",
+                        "ndim", "size"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize",
+                 "weak_type", "sharding", "nbytes"}
+_TRACED_ROOTS = {"jnp", "lax"}
+_TRACED_JAX_SUBMODULES = {"nn", "random", "numpy", "lax", "scipy"}
+
+_METRIC_CALL = re.compile(
+    r'(?:counter|gauge|histogram|sketch)\(\s*'
+    r'"((?:serving|resilience|decode)\.[a-z0-9_.]+)"')
+
+
+class Finding:
+    """One lint violation. ``code`` is the stripped source line — the
+    baseline matches on (rule, path, code), so findings survive
+    unrelated edits that only shift line numbers."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "code")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, code: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.code = code
+
+    def key(self):
+        return (self.rule, self.path, self.code)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_json(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "code": self.code}
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}:{self.col}: [{self.rule}] "
+                f"{self.message}")
+
+
+class SourceFile:
+    __slots__ = ("path", "source", "lines", "tree")
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        return Finding(rule, self.path, node.lineno, node.col_offset,
+                       message, self.line_text(node.lineno))
+
+
+# --------------------------------------------------------------- helpers
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    names.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom) and node.module == "numpy":
+            names.add("__from_numpy__")
+    return names
+
+
+def _attr_root(node) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_host_literal(node) -> bool:
+    """Arguments that are host data by construction: literals,
+    comprehensions, and pure-numpy expressions."""
+    if isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                         ast.ListComp, ast.GeneratorExp, ast.DictComp,
+                         ast.SetComp)):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_host_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_host_literal(node.left) and _is_host_literal(node.right)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        # list(...)/sorted(...) results are host objects by construction
+        return node.func.id in ("list", "tuple", "sorted", "range")
+    return False
+
+
+def _looks_like_dtype(node) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in _DTYPE_NAMES or node.attr == "dtype"
+    if isinstance(node, ast.Name):
+        return node.id in _DTYPE_NAMES or "dtype" in node.id.lower()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _DTYPE_NAMES
+    if isinstance(node, ast.Call):
+        # np.dtype(...), jnp.dtype(...), x.astype's operand etc.
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "dtype")
+    return False
+
+
+def _static_extraction(node) -> bool:
+    """Expressions whose VALUE is static under trace even when the
+    operand is traced: shape/dtype attributes, len(), isinstance(),
+    identity comparisons."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _static_extraction(node.value)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("len", "isinstance", "hasattr", "getattr",
+                                "type")
+    return False
+
+
+def _tainted(node, traced: Set[str]) -> bool:
+    """Does this expression's value depend on traced array DATA (as
+    opposed to static metadata)?"""
+    if node is None or isinstance(node, ast.Constant):
+        return False
+    if _static_extraction(node):
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        return _tainted(node.value, traced)
+    if isinstance(node, ast.Subscript):
+        return _tainted(node.value, traced)
+    if isinstance(node, ast.Call):
+        root = _attr_root(node.func)
+        if root in _TRACED_ROOTS:
+            return (not isinstance(node.func, ast.Attribute)
+                    or node.func.attr not in _STATIC_MODULE_CALLS)
+        if root == "jax" and isinstance(node.func, ast.Attribute):
+            # jax.nn.softmax(x) / jax.random.fold_in(...) return traced
+            # data; jax.default_backend() and friends do not
+            chain = _jax_chain(node.func)
+            if len(chain) >= 2 and chain[1] in _TRACED_JAX_SUBMODULES:
+                return True
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(node.func, ast.Attribute) \
+                and _tainted(node.func.value, traced):
+            return True         # x.astype(...), x.sum() on tainted x
+        return any(_tainted(a, traced) for a in args)
+    if isinstance(node, ast.BinOp):
+        return _tainted(node.left, traced) or _tainted(node.right, traced)
+    if isinstance(node, ast.UnaryOp):
+        return _tainted(node.operand, traced)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in node.ops):
+            return False        # identity / membership: host semantics
+        return _tainted(node.left, traced) \
+            or any(_tainted(c, traced) for c in node.comparators)
+    if isinstance(node, ast.BoolOp):
+        return any(_tainted(v, traced) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return _tainted(node.body, traced) or _tainted(node.orelse, traced)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_tainted(e, traced) for e in node.elts)
+    return False
+
+
+def _jax_chain(node) -> List[str]:
+    chain = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        chain.append(node.id)
+    return list(reversed(chain))
+
+
+class _FuncScoper(ast.NodeVisitor):
+    """Shared walk that attributes nodes to their enclosing function's
+    qualname (matching analysis/callgraph.py) before dispatching to a
+    per-rule ``handle(node, qualname)``."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+
+    def _visit_func(self, node):
+        self.stack.append(node.name)
+        self.enter_function(node, ".".join(self.stack))
+        self.generic_visit(node)
+        self.exit_function(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def enter_function(self, node, qualname):   # pragma: no cover
+        pass
+
+    def exit_function(self, node):              # pragma: no cover
+        pass
+
+
+# ----------------------------------------------------------- host-sync
+
+class _HostSyncVisitor(_FuncScoper):
+    def __init__(self, sf: SourceFile, np_aliases: Set[str],
+                 is_traced_fn, findings: List[Finding]):
+        super().__init__()
+        self.sf = sf
+        self.np = np_aliases
+        self.is_traced_fn = is_traced_fn
+        self.findings = findings
+
+    def visit_Call(self, node):
+        f = node.func
+        sf = self.sf
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    ".item() forces a device sync + D2H scalar pull"))
+            elif f.attr == "block_until_ready":
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    "block_until_ready blocks the host on device work"))
+            elif f.attr == "device_get" and _attr_root(f) == "jax":
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    "jax.device_get is an explicit D2H transfer"))
+            elif (f.attr in ("asarray", "array", "ascontiguousarray")
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in self.np and node.args
+                  and not _is_host_literal(node.args[0])
+                  and not self._numpy_arg(node.args[0])):
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    f"np.{f.attr} on a possibly-device value syncs and "
+                    f"copies to host"))
+        elif isinstance(f, ast.Name):
+            if f.id == "block_until_ready":
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    "block_until_ready blocks the host on device work"))
+            elif f.id in ("float", "int", "bool") and len(node.args) == 1 \
+                    and self._in_traced_function() \
+                    and self._concretizes(node.args[0]):
+                self.findings.append(sf.finding(
+                    "host-sync", node,
+                    f"{f.id}() on an array value in jit-reachable code "
+                    f"is a concretization sync"))
+        self.generic_visit(node)
+
+    def _numpy_arg(self, node) -> bool:
+        """np.asarray(np.stack(...)) — already host, not a sync."""
+        return (isinstance(node, ast.Call)
+                and _attr_root(node.func) in self.np)
+
+    def _in_traced_function(self) -> bool:
+        return bool(self.stack) and self.is_traced_fn(
+            ".".join(self.stack))
+
+    def _concretizes(self, arg) -> bool:
+        """float(x)-style casts that force a device value concrete:
+        calls and subscripts of non-static expressions. Plain names and
+        static metadata (shape/len/...) stay un-flagged — config casts
+        are the common benign case."""
+        if _static_extraction(arg) or isinstance(arg, (ast.Constant,
+                                                       ast.Name,
+                                                       ast.Attribute)):
+            # plain names and attribute reads are the benign config-cast
+            # case; only value-producing expressions (calls, subscripts)
+            # can force a device array concrete
+            return False
+        if isinstance(arg, (ast.Call, ast.Subscript)):
+            return not _static_extraction(arg)
+        if isinstance(arg, ast.BinOp):
+            return self._concretizes(arg.left) \
+                or self._concretizes(arg.right)
+        if isinstance(arg, ast.UnaryOp):
+            return self._concretizes(arg.operand)
+        return False
+
+
+def check_host_sync(sf: SourceFile, graph) -> List[Finding]:
+    module = _module_name(sf.path)
+    findings: List[Finding] = []
+    v = _HostSyncVisitor(
+        sf, _numpy_aliases(sf.tree),
+        lambda qual: graph.is_traced(module, qual), findings)
+    v.visit(sf.tree)
+    return findings
+
+
+# -------------------------------------------------------- traced-branch
+
+class _TracedBranchVisitor(_FuncScoper):
+    def __init__(self, sf: SourceFile, is_traced_fn,
+                 findings: List[Finding]):
+        super().__init__()
+        self.sf = sf
+        self.is_traced_fn = is_traced_fn
+        self.findings = findings
+        self.traced_vars: List[Set[str]] = []
+
+    def enter_function(self, node, qualname):
+        # locals assigned from jnp/lax computations are traced values;
+        # two forward passes so `y = x + 1` after `x = jnp.sum(...)`
+        # taints even with one-pass visiting order quirks
+        traced: Set[str] = set()
+        for _ in range(2):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _tainted(sub.value,
+                                                            traced):
+                    for t in sub.targets:
+                        self._taint_target(t, traced)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)) \
+                        and sub.value is not None \
+                        and _tainted(sub.value, traced):
+                    self._taint_target(sub.target, traced)
+        self.traced_vars.append(traced)
+
+    def exit_function(self, node):
+        self.traced_vars.pop()
+
+    @staticmethod
+    def _taint_target(t, traced: Set[str]):
+        if isinstance(t, ast.Name):
+            traced.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _TracedBranchVisitor._taint_target(e, traced)
+
+    def _check_test(self, test, what: str):
+        if not self.traced_vars or not self.stack:
+            return
+        if not self.is_traced_fn(".".join(self.stack)):
+            return
+        if _tainted(test, self.traced_vars[-1]):
+            self.findings.append(self.sf.finding(
+                "traced-branch", test,
+                f"Python {what} on a traced value in jit-reachable "
+                f"code — use lax.cond/jnp.where or hoist the check"))
+
+    def visit_If(self, node):
+        self._check_test(node.test, "branch")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node.test, "while-loop")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_test(node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_test(node.test, "assert")
+        self.generic_visit(node)
+
+
+def check_traced_branch(sf: SourceFile, graph) -> List[Finding]:
+    module = _module_name(sf.path)
+    findings: List[Finding] = []
+    v = _TracedBranchVisitor(
+        sf, lambda qual: graph.is_traced(module, qual), findings)
+    v.visit(sf.tree)
+    return findings
+
+
+# -------------------------------------------------------- default-dtype
+
+class _DefaultDtypeVisitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, np_aliases: Set[str],
+                 findings: List[Finding]):
+        self.sf = sf
+        self.np = np_aliases
+        self.findings = findings
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in self.np:
+            if f.attr in _NUMPY_CREATORS:
+                has_dtype = any(kw.arg == "dtype" for kw in node.keywords) \
+                    or any(_looks_like_dtype(a) for a in node.args)
+                if not has_dtype:
+                    self.findings.append(self.sf.finding(
+                        "default-dtype", node,
+                        f"np.{f.attr} without an explicit dtype defaults "
+                        f"to float64/int64 in kernel code"))
+                for a in node.args:
+                    # a POSITIONAL float64 dtype must not escape just
+                    # because it satisfied has_dtype
+                    if self._is_float64(a):
+                        self.findings.append(self.sf.finding(
+                            "default-dtype", a,
+                            "explicit float64 dtype in kernel code"))
+            elif f.attr == "float64":
+                self.findings.append(self.sf.finding(
+                    "default-dtype", node,
+                    "explicit float64 scalar in kernel code"))
+            elif f.attr in ("asarray", "array") and node.args:
+                for a in node.args[1:]:     # positional dtype
+                    if self._is_float64(a):
+                        self.findings.append(self.sf.finding(
+                            "default-dtype", a,
+                            "explicit float64 dtype in kernel code"))
+                if self._bare_float_literal(node.args[0]) \
+                        and not any(kw.arg == "dtype"
+                                    for kw in node.keywords) \
+                        and not any(_looks_like_dtype(a)
+                                    for a in node.args[1:]):
+                    self.findings.append(self.sf.finding(
+                        "default-dtype", node,
+                        "bare float literal arrayified at float64"))
+        for kw in getattr(node, "keywords", []):
+            if kw.arg == "dtype" and self._is_float64(kw.value):
+                self.findings.append(self.sf.finding(
+                    "default-dtype", kw.value,
+                    "explicit float64 dtype in kernel code"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_float64(node) -> bool:
+        if isinstance(node, ast.Attribute):
+            return node.attr == "float64"
+        if isinstance(node, ast.Constant):
+            return node.value in ("float64", "double")
+        return False
+
+    @staticmethod
+    def _bare_float_literal(node) -> bool:
+        """A float scalar, or a list/tuple literal containing one —
+        numpy infers float64 for both."""
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, float)
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return any(_DefaultDtypeVisitor._bare_float_literal(e)
+                       for e in node.elts)
+        return False
+
+
+def check_default_dtype(sf: SourceFile, graph=None) -> List[Finding]:
+    norm = sf.path.replace(os.sep, "/")
+    if not any(norm.startswith(d + "/") or os.path.dirname(norm) == d
+               for d in KERNEL_DIRS):
+        return []
+    findings: List[Finding] = []
+    _DefaultDtypeVisitor(sf, _numpy_aliases(sf.tree) | {"np"},
+                         findings).visit(sf.tree)
+    return findings
+
+
+# --------------------------------------------------------- metric-drift
+
+def collect_metric_names(sources: Dict[str, str]) -> Dict[str, List]:
+    """name -> [(path, line)] for every serving./resilience./decode.*
+    metric literal created in the package. The ONE implementation both
+    the lint rule and tests/test_slo.py use. Scans whole files (the
+    ``\\s*`` crosses newlines), so a call wrapped for line length is
+    still seen."""
+    names: Dict[str, List] = {}
+    for path, src in sources.items():
+        for m in _METRIC_CALL.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            names.setdefault(m.group(1), []).append((path, line))
+    return names
+
+
+def check_metric_drift(sources: Dict[str, str], docs_text: str,
+                       line_lookup) -> List[Finding]:
+    findings = []
+    for name, sites in sorted(collect_metric_names(sources).items()):
+        if name in docs_text:
+            continue
+        for path, line in sites:
+            findings.append(Finding(
+                "metric-drift", path, line, 0,
+                f"metric {name!r} is not documented in "
+                f"docs/OBSERVABILITY.md", line_lookup(path, line)))
+    return findings
+
+
+# ----------------------------------------------------------- fault-site
+
+def known_fault_sites(faults_source: str) -> Set[str]:
+    """Parse resilience/faults.py for the KNOWN_SITES literal — the
+    linter must not import the package (no jax import on the lint
+    path)."""
+    tree = ast.parse(faults_source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_SITES":
+                    return {e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)}
+    return set()
+
+
+def check_fault_site(sf: SourceFile, sites: Set[str]) -> List[Finding]:
+    if sf.path.replace(os.sep, "/").endswith("resilience/faults.py"):
+        return []       # the registry itself (defaults, docstrings)
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name not in ("maybe_fire", "Fault"):
+            continue
+        site = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            site = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "site" and isinstance(kw.value, ast.Constant):
+                    site = kw.value.value
+        if site is not None and site not in sites:
+            findings.append(sf.finding(
+                "fault-site", node,
+                f"fault site {site!r} is not registered in "
+                f"resilience.faults.KNOWN_SITES"))
+    return findings
+
+
+# -------------------------------------------------------------- driver
+
+def _module_name(path: str) -> str:
+    module = os.path.splitext(path.replace(os.sep, "/"))[0].replace(
+        "/", ".")
+    if module.endswith(".__init__"):
+        module = module[: -len(".__init__")]
+    return module
+
+
+ALL_RULES = ("host-sync", "traced-branch", "default-dtype",
+             "metric-drift", "fault-site")
+
+
+def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
+              fault_sites: Set[str],
+              rules=ALL_RULES) -> List[Finding]:
+    findings: List[Finding] = []
+    per_file = {"host-sync": lambda sf: check_host_sync(sf, graph),
+                "traced-branch": lambda sf: check_traced_branch(sf, graph),
+                "default-dtype": check_default_dtype,
+                "fault-site": lambda sf: check_fault_site(sf, fault_sites)}
+    for rule in rules:
+        if rule == "metric-drift":
+            sources = {p: sf.source for p, sf in files.items()}
+            findings.extend(check_metric_drift(
+                sources, docs_text,
+                lambda p, ln: files[p].line_text(ln)))
+            continue
+        fn = per_file[rule]
+        for sf in files.values():
+            findings.extend(fn(sf))
+    findings.sort(key=Finding.sort_key)
+    return findings

@@ -1,0 +1,166 @@
+"""Runtime dispatch sanitizer: transfer and recompile guards.
+
+The static pass (analysis/lint.py) sees where code *could* sync; this
+module enforces what a region *actually does* at runtime:
+
+* :func:`no_transfer` — a context in which implicit AND explicit
+  host->device transfers raise (``jax.transfer_guard_host_to_device
+  ("disallow_explicit")``): the enforcement form of the serving
+  engine's "no steady-state H2D" claim. D2H is allowed by default —
+  the one sampled-token pull per step IS the completion fence — and
+  guardable with ``d2h=True``. (On the CPU backend D2H is zero-copy
+  and the guard never fires; H2D fires at jit argument placement and
+  ``jnp.asarray`` alike, so the invariant is testable without a TPU.)
+* :func:`no_recompile` / :func:`count_compiles` — XLA backend-compile
+  events captured via ``jax.monitoring`` (one
+  ``/jax/core/compile/backend_compile_duration`` event per real
+  compile; jit-cache hits emit nothing): a region that claims "warm"
+  must compile nothing.
+* :func:`sanitize` — both at once; what ``ServingEngine(sanitize=True)``
+  wraps steady-state dispatches in and the benches arm under
+  ``--sanitize``.
+
+Guards compose with ``with`` nesting and are thread-visible the way
+jax's own context managers are; the compile listener is registered
+once, process-wide, and costs one list-append per *compile* (never on
+a cache-hit dispatch), so leaving it registered is free on the hot
+path.
+"""
+
+import threading
+from contextlib import contextmanager
+from typing import List, Optional
+
+import jax
+
+__all__ = ["CompileCounter", "RecompileError", "TransferError",
+           "count_compiles", "no_recompile", "no_transfer", "sanitize",
+           "compile_events_supported"]
+
+#: the monitoring event one real XLA backend compile emits (jax 0.4+);
+#: trace-only events (jaxpr_trace) deliberately NOT counted — a
+#: retrace that hits the compile cache costs µs, a backend compile
+#: costs seconds
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompileError(RuntimeError):
+    """A ``no_recompile`` region compiled."""
+
+
+class TransferError(RuntimeError):
+    """Raised by :func:`no_transfer` wrapping for a uniform excepting
+    type; the underlying jax error is chained as ``__cause__``."""
+
+
+class CompileCounter:
+    """Collects backend-compile events while registered as active."""
+
+    __slots__ = ("events",)
+
+    def __init__(self):
+        self.events: List[str] = []
+
+    @property
+    def count(self) -> int:
+        return len(self.events)
+
+
+_active_counters: List[CompileCounter] = []
+_listener_lock = threading.Lock()
+_listener_state = {"registered": False, "supported": None}
+
+
+def _on_event(name: str, dur: float, **kwargs):
+    if name == BACKEND_COMPILE_EVENT and _active_counters:
+        for c in list(_active_counters):
+            c.events.append(name)
+
+
+def _ensure_listener() -> bool:
+    with _listener_lock:
+        if not _listener_state["registered"]:
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(_on_event)
+                _listener_state["supported"] = True
+            except Exception:   # pragma: no cover - jax too old
+                _listener_state["supported"] = False
+            _listener_state["registered"] = True
+    return bool(_listener_state["supported"])
+
+
+def compile_events_supported() -> bool:
+    """Whether this jax exposes the monitoring seam the compile guards
+    need (True on the supported 0.4.x/0.9 fleet)."""
+    return _ensure_listener()
+
+
+@contextmanager
+def count_compiles():
+    """``with count_compiles() as c: ...; c.count`` — the number of XLA
+    backend compiles the block performed."""
+    _ensure_listener()
+    c = CompileCounter()
+    _active_counters.append(c)
+    try:
+        yield c
+    finally:
+        _active_counters.remove(c)
+
+
+@contextmanager
+def no_recompile(allow: int = 0, what: str = "region"):
+    """Raise :class:`RecompileError` if the block backend-compiles more
+    than ``allow`` programs. The expected-compile form (``allow=n``)
+    pins e.g. "a join at a NEW prompt shape compiles exactly one
+    prefill program"."""
+    with count_compiles() as c:
+        yield c
+    if c.count > allow:
+        raise RecompileError(
+            f"{what} compiled {c.count} program(s) "
+            f"(allowed {allow}) — a warm hot path must not recompile; "
+            f"shapes or static arguments are churning")
+
+
+@contextmanager
+def no_transfer(h2d: bool = True, d2h: bool = False, d2d: bool = False,
+                what: str = "region"):
+    """Disallow device transfers inside the block (explicit AND
+    implicit — a ``jnp.asarray`` upload and a jit-argument placement
+    both count). Violations raise jax's ``XlaRuntimeError`` at the
+    transfer site, chained into :class:`TransferError` with the region
+    name."""
+    ctxs = []
+    if h2d:
+        ctxs.append(jax.transfer_guard_host_to_device("disallow_explicit"))
+    if d2h:
+        ctxs.append(jax.transfer_guard_device_to_host("disallow_explicit"))
+    if d2d:
+        ctxs.append(
+            jax.transfer_guard_device_to_device("disallow_explicit"))
+    try:
+        for c in ctxs:
+            c.__enter__()
+        try:
+            yield
+        finally:
+            for c in reversed(ctxs):
+                c.__exit__(None, None, None)
+    except Exception as e:
+        if "Disallowed" in str(e) and "transfer" in str(e):
+            raise TransferError(
+                f"{what} performed a guarded device transfer: {e}") from e
+        raise
+
+
+@contextmanager
+def sanitize(what: str = "region", h2d: bool = True, d2h: bool = False,
+             allow_compiles: int = 0):
+    """The combined guard: no H2D transfers (optionally D2H) and no
+    backend compiles. The ``ServingEngine(sanitize=True)`` steady-state
+    contract and the benches' ``--sanitize`` wrap."""
+    with no_transfer(h2d=h2d, d2h=d2h, what=what), \
+            no_recompile(allow=allow_compiles, what=what):
+        yield

@@ -199,9 +199,13 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
     if plan is not None:
         total = -(-total // 128) * 128
     # int8 mode prefills through the layered path in bf16 (the
-    # calibration pass); the cache is quantized after stacking
-    cache = model.init_cache(
-        b, total, dtype=jnp.bfloat16 if kv_int8 else cache_dtype)
+    # calibration pass); the cache is quantized after stacking.
+    # The cache is created INSIDE the prefill program (matching the
+    # serving engine's wave prefill): an eager jnp.zeros here would
+    # compile a per-shape zeros program and upload its fill scalar on
+    # every call — the exact per-request H2D the dispatch sanitizer
+    # (paddle_tpu.analysis.runtime) guards against.
+    cache_init_dtype = jnp.bfloat16 if kv_int8 else cache_dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
     # One decode program per static configuration, cached on the model so
@@ -240,10 +244,11 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             cos_tab, sin_tab = rope_ops.rope_cos_sin(
                 total, plan["head_dim"], base=plan["rope_base"])
 
-            def _prefill_impl(state, cache, ids, seeds):
+            def _prefill_impl(state, ids, seeds):
                 # rebuild the plan from the traced state so the stacked
                 # weights flow from the `state` argument (not constants)
                 plan_t = model.fused_decode_plan(state)
+                cache = model.init_cache(b, total, dtype=cache_init_dtype)
                 # prefill on the layered path, then stack for the kernel
                 with jax.named_scope("decode.prefill"):
                     out, cache = functional_call(model, state, ids,
@@ -297,7 +302,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
 
                 return lax.scan(step, carry, i0 + jnp.arange(nsteps))
         else:
-            def _prefill_impl(state, cache, ids, seeds):
+            def _prefill_impl(state, ids, seeds):
+                cache = model.init_cache(b, total, dtype=cache_init_dtype)
                 with jax.named_scope("decode.prefill"):
                     out, cache = functional_call(model, state, ids,
                                                  cache=cache, start_pos=0)
@@ -325,8 +331,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                 return lax.scan(step, carry, i0 + jnp.arange(nsteps))
 
         if tracer is None:
-            def run_impl(state, cache, ids, seeds):
-                carry, aux = _prefill_impl(state, cache, ids, seeds)
+            def run_impl(state, ids, seeds):
+                carry, aux = _prefill_impl(state, ids, seeds)
                 tok = carry[0]
                 carry, toks = _decode_impl(state, carry, aux, 1,
                                            max_new_tokens - 1)
@@ -335,15 +341,14 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             run = jax.jit(run_impl)
             jit_cache[jit_key] = run
         else:
-            # donate the cache/carry across the chunk dispatches so XLA
+            # donate the carry across the chunk dispatches so XLA
             # aliases the KV buffer instead of copying it per chunk (a 7B
             # cache copied every 32 tokens would skew the TPOT this mode
             # measures and double peak HBM). CPU never implements
             # donation — skip there to avoid per-program warnings.
             don = jax.default_backend() != "cpu"
             traced_fns = (
-                jax.jit(_prefill_impl,
-                        donate_argnums=(1,) if don else ()),
+                jax.jit(_prefill_impl),
                 jax.jit(_decode_impl, static_argnums=(4,),
                         donate_argnums=(1,) if don else ()))
             jit_cache[jit_key + ("traced",)] = traced_fns
@@ -361,19 +366,24 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
         # injectable accelerator-OOM site (one global read when disarmed)
         _faults.maybe_fire("decode.dispatch")
         if tracer is None:
-            new_tokens = run(state, cache, input_ids, seeds0)
+            new_tokens = run(state, input_ids, seeds0)
         else:
             # analytic cache accounting for the request span: total
             # allocated KV bytes at the cache dtype, and the avg bytes a
-            # decode step streams (cache fill averaged over the window)
-            leaves = jax.tree_util.tree_leaves(cache)
+            # decode step streams (cache fill averaged over the window).
+            # eval_shape: the cache lives only inside the programs now,
+            # so size it abstractly (no allocation, no transfer)
+            cache_shapes = jax.eval_shape(
+                lambda: model.init_cache(b, total,
+                                         dtype=cache_init_dtype))
+            leaves = jax.tree_util.tree_leaves(cache_shapes)
             itemsize = 1 if kv_int8 else jnp.dtype(cache_dtype).itemsize
             kv_cache_bytes = int(sum(l.size * itemsize for l in leaves))
             avg_len = min(prompt_len + max_new_tokens / 2.0, total)
             pf, dc = traced_fns
             pieces = obs.run_traced_decode(
                 tracer,
-                lambda: pf(state, cache, input_ids, seeds0),
+                lambda: pf(state, input_ids, seeds0),
                 lambda carry, aux, i0, c: dc(state, carry, aux, i0, c),
                 batch=b, max_new_tokens=max_new_tokens,
                 deadline_s=deadline_s,
@@ -413,6 +423,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                             **retry_kw)
         raise
     if eos_token_id is not None:
+        # tpu-lint: allow(host-sync): once-per-request D2H — the eos
+        # trim + gen_len accounting need the tokens on host anyway
         arr = np.asarray(new_tokens)
         # per-row generated length: tokens before the first eos
         hit = arr == eos_token_id

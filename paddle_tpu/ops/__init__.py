@@ -22,6 +22,7 @@ def on_tpu() -> bool:
 
 
 def use_pallas() -> bool:
+    # tpu-lint: allow(host-sync): flag() is a host-side config read
     return bool(flag("FLAGS_use_pallas_kernels")) and on_tpu()
 
 

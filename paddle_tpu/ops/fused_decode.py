@@ -1555,6 +1555,7 @@ def fused_decode_step(x, params, kv_cache, pos, cos, sin, *,
     from paddle_tpu.core.flags import flag
     from paddle_tpu.ops import use_pallas
     dkv = kv_cache.shape[-1] // 2
+    # tpu-lint: allow(host-sync): flag() is a host-side config read
     interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
     if (use_pallas() or interp) and dkv % 128 == 0 \
             and kv_cache.shape[2] % 128 == 0:
@@ -2203,6 +2204,7 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
             f"paged decode supports arch llama/gpt, got {arch!r}")
     dkv = kv_pool.shape[-1] // 2
     BT = kv_pool.shape[2]
+    # tpu-lint: allow(host-sync): flag() is a host-side config read
     interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
     if (use_pallas() or interp) and dkv % 128 == 0 and BT % 8 == 0:
         cb = jnp.dtype(kv_pool.dtype).itemsize

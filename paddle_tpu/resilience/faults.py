@@ -54,12 +54,20 @@ from typing import Dict, List, Optional, Tuple
 logger = logging.getLogger("paddle_tpu.resilience")
 
 __all__ = [
-    "Fault", "FaultPlan", "SimulatedResourceExhausted",
+    "Fault", "FaultPlan", "KNOWN_SITES", "SimulatedResourceExhausted",
     "arm", "disarm", "armed", "maybe_fire", "plan",
 ]
 
 RAISING_KINDS = ("raise", "resource_exhausted")
 COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat")
+
+#: The registered fault sites — the module-docstring table in code.
+#: tpu-lint's `fault-site` rule pins every `maybe_fire(...)`/`Fault(...)`
+#: literal in the package against this tuple, so a new injection hook
+#: cannot land without registering (and documenting) its site; `arm()`
+#: warns on plans naming unknown sites (tests may use ad-hoc ones).
+KNOWN_SITES = ("train.step", "checkpoint.save", "elastic.heartbeat",
+               "decode.dispatch", "kv.op", "serving.snapshot")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -192,7 +200,15 @@ _armed: Optional[FaultPlan] = None
 
 
 def arm(fault_plan: FaultPlan) -> FaultPlan:
-    """Make `fault_plan` the process-wide armed plan (replacing any)."""
+    """Make `fault_plan` the process-wide armed plan (replacing any).
+    Unknown sites are legal (tests hook ad-hoc seams) but warned: a
+    typo'd site silently never fires."""
+    for f in fault_plan.faults:
+        if f.site not in KNOWN_SITES:
+            logger.warning(
+                "fault plan names unregistered site %r (known: %s) — "
+                "it will only fire if something calls maybe_fire(%r)",
+                f.site, ", ".join(KNOWN_SITES), f.site)
     global _armed
     _armed = fault_plan
     return fault_plan

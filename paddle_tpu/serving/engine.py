@@ -144,6 +144,7 @@ class Request:
                  deadline_s: Optional[float] = None,
                  priority: str = "normal",
                  request_id: Optional[int] = None):
+        # tpu-lint: allow(host-sync): API boundary — prompts are host ids
         prompt = np.asarray(prompt)
         if not np.issubdtype(prompt.dtype, np.integer):
             raise ValueError(
@@ -214,6 +215,7 @@ class RequestResult:
                  ttft_s, tpot_s, prefix_hit_blocks):
         self.request_id = request_id
         self.prompt = prompt
+        # tpu-lint: allow(host-sync): generated tokens are a host list
         self.tokens = np.asarray(tokens, np.int32)
         self.gen_len = int(gen_len)
         self.finish = finish
@@ -370,6 +372,12 @@ class ServingEngine:
     estimate says cannot reach a first token. Priority preemption is
     always armed but only ever fires across *different* priority
     classes, so all-default-priority workloads never preempt.
+
+    ``sanitize=True`` (debug; docs/ANALYSIS.md) arms the dispatch
+    sanitizer: every steady-state decode dispatch runs under
+    ``analysis.runtime.sanitize()`` — zero H2D transfers, zero
+    recompiles, or it RAISES at the offending step.
+    ``stats["sanitized_steps"]`` counts the guarded dispatches.
     """
 
     def __init__(self, model, *, max_slots: int = 4,
@@ -384,6 +392,7 @@ class ServingEngine:
                  flight_dump_path: Optional[str] = None,
                  max_queue: Optional[int] = None,
                  shed_infeasible: bool = False,
+                 sanitize: bool = False,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -508,6 +517,13 @@ class ServingEngine:
         self._ewma_step = _Ewma()       # decode dispatch+sync per step
         self._ewma_prefill = _Ewma()    # per prefill-wave group
         self._step_fn_warm = False      # first dispatch pays the compile
+        # dispatch sanitizer (paddle_tpu.analysis.runtime,
+        # docs/ANALYSIS.md): with sanitize=True every STEADY-STATE
+        # fused dispatch — warm step program, no join/leave/table event
+        # since the last upload — runs under no_transfer(h2d) +
+        # no_recompile, so a stray host upload or shape-churn recompile
+        # raises instead of silently regressing dispatch latency
+        self._sanitize = bool(sanitize)
         self._gauges_init()
 
     # ------------------------------------------------------------- helpers
@@ -544,6 +560,7 @@ class ServingEngine:
                     requests_finished=0, requests_admitted=0,
                     preemptions=0, requests_resumed=0,
                     requests_shed=0, requests_rejected=0,
+                    sanitized_steps=0,
                     step_admit_s=0.0, step_prefill_s=0.0,
                     step_dispatch_s=0.0, step_sync_s=0.0)
 
@@ -847,6 +864,7 @@ class ServingEngine:
             # recomputing
             full = s.pos // self.block_tokens
             if full:
+                # tpu-lint: allow(host-sync): host token-list concat
                 self.prefix_cache.insert(
                     np.concatenate([req.prompt, np.asarray(
                         s.tokens[:-1], np.int32)]),
@@ -926,6 +944,7 @@ class ServingEngine:
             req = self._queue.peek()
             rank = req.rank
             resume = req._resume_tokens
+            # tpu-lint: allow(host-sync): host token-list concat
             feed = (req.prompt if not resume else np.concatenate(
                 [req.prompt, np.asarray(resume[:-1], np.int32)]))
             P = len(feed)
@@ -1078,7 +1097,10 @@ class ServingEngine:
                 self.kv_pool, prefix, jnp.asarray(ids),
                 jnp.asarray(last_idx), jnp.asarray(seeds),
                 jnp.asarray(new_bids), jnp.asarray(valid))
+            # tpu-lint: allow(host-sync): once-per-wave D2H — int8 scales
             lanes_np = np.asarray(lanes)
+            # tpu-lint: allow(host-sync): once-per-wave D2H — the prefix
+            # cache keeps exact bf16 host copies of int8 blocks
             kv_np = (np.asarray(kv_flat)
                      if self.prefix_cache is not None else None)
         else:
@@ -1092,6 +1114,7 @@ class ServingEngine:
                 jnp.asarray(last_idx), jnp.asarray(seeds),
                 jnp.asarray(new_bids), jnp.asarray(valid))
             lanes_np = kv_np = None
+        # tpu-lint: allow(host-sync): once-per-wave D2H — first tokens
         tok_np = np.asarray(tok)
         # the prefill sample is each FRESH request's first GENERATED
         # token (stats["decode_tokens"] counts only decode-step tokens);
@@ -1143,6 +1166,7 @@ class ServingEngine:
                 if self.kv_int8:
                     # copy the slices: a view would pin the whole wave's
                     # (L, n, cache_len, 2dkv) buffer per cached block
+                    # tpu-lint: allow(host-sync): host slice copy (kv_np)
                     self.prefix_cache.insert(
                         slot.feed, nh,
                         kv_host=[np.ascontiguousarray(
@@ -1236,6 +1260,7 @@ class ServingEngine:
         now = time.perf_counter()
         self._release_slot(slot_idx)
 
+        # tpu-lint: allow(host-sync): generated tokens are a host list
         toks = np.asarray(s.tokens, np.int32)
         eos = self.eos_token_id
         if eos is not None and (toks == int(eos)).any():
@@ -1346,6 +1371,11 @@ class ServingEngine:
             for i in active:
                 self._ensure_blocks(i)
             _faults.maybe_fire("decode.dispatch")
+            # steady state = the warm program re-dispatches with NO
+            # host->device upload: no join/leave/lazy-block event made
+            # the mirrors dirty. This is the tick the "no steady-state
+            # H2D" claim is about — and what sanitize mode guards.
+            steady = self._step_fn_warm and not self._dirty
             if self._dirty:
                 self._dev = (jnp.asarray(self._tables),
                              jnp.asarray(self._positions),
@@ -1359,14 +1389,24 @@ class ServingEngine:
         admit_s = max(0.0, time.perf_counter() - t0 - self._tick_prefill_s)
         if active:
             t_d0 = time.perf_counter()
-            d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
-                self.kv_pool, *self._dev)
+            if self._sanitize and steady:
+                from paddle_tpu.analysis import runtime as _sanitizer
+                with _sanitizer.sanitize(
+                        what="steady-state ServingEngine.step dispatch"):
+                    d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
+                        self.kv_pool, *self._dev)
+                self.stats["sanitized_steps"] += 1
+            else:
+                d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
+                    self.kv_pool, *self._dev)
             # toks <- sampled ids; tables/seeds/scales are event-driven
             self._dev = (self._dev[0], d_pos, d_nxt, self._dev[3], d_cnt,
                          self._dev[5])
             t_s0 = time.perf_counter()
             dispatch_s = t_s0 - t_d0
-            nxt = np.asarray(d_nxt)     # host pull == completion fence
+            # tpu-lint: allow(host-sync): THE one per-step D2H — the
+            # sampled-token pull is the step's completion fence
+            nxt = np.asarray(d_nxt)
             sync_s = time.perf_counter() - t_s0
             self.stats["steps"] += 1
             self.stats["decode_tokens"] += len(active)
@@ -1493,6 +1533,7 @@ class ServingEngine:
     def generate(self, prompts: Sequence, **req_kwargs) -> List[np.ndarray]:
         """Batch convenience: submit every prompt, drain, return the
         ``prompt+tokens`` id rows in submission order."""
+        # tpu-lint: allow(host-sync): API boundary — prompts are host ids
         ids = [self.submit(Request(np.asarray(p).reshape(-1), **req_kwargs))
                for p in prompts]
         self.drain()
@@ -1600,7 +1641,8 @@ class ServingEngine:
                   "flight_capacity": self.flight.capacity,
                   "flight_dump_path": self.flight.auto_dump_path,
                   "max_queue": self.max_queue,
-                  "shed_infeasible": self.shed_infeasible}
+                  "shed_infeasible": self.shed_infeasible,
+                  "sanitize": self._sanitize}
         fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
                        "dkv": self._dkv}
         return {"schema": ENGINE_SNAPSHOT_SCHEMA, "ts": time.time(),
@@ -1703,6 +1745,7 @@ class ServingEngine:
         # restored queue pops in the order the crashed engine would have
         restored = []
         for rs in snap["slots"] + snap["queue"]:
+            # tpu-lint: allow(host-sync): snapshot JSON is host data
             req = Request(np.asarray(rs["prompt"], np.int32),
                           rs["max_new_tokens"], seed=rs["seed"],
                           deadline_s=rs["deadline_remaining_s"],
@@ -1715,6 +1758,7 @@ class ServingEngine:
             eng._queue.push(req)
             restored.append(req.request_id)
         for rr in snap.get("results", []):
+            # tpu-lint: allow(host-sync): snapshot JSON is host data
             eng.results[rr["request_id"]] = RequestResult(
                 rr["request_id"], np.asarray(rr["prompt"], np.int32),
                 rr["tokens"], rr["gen_len"], rr["finish"], rr["ttft_s"],

@@ -116,6 +116,7 @@ class BlockPool:
 def _chain_hash(parent: bytes, tokens: np.ndarray) -> bytes:
     h = hashlib.blake2b(digest_size=16)
     h.update(parent)
+    # tpu-lint: allow(host-sync): hashing host token ids (never device)
     h.update(np.ascontiguousarray(tokens, dtype=np.int64).tobytes())
     return h.digest()
 
@@ -176,6 +177,7 @@ class PrefixCache:
         calls :meth:`commit` once when the request is actually admitted.
         """
         bt = self.pool.block_tokens
+        # tpu-lint: allow(host-sync): prompts arrive as host ids
         prompt = np.asarray(prompt)
         n_full = len(prompt) // bt
         if max_blocks is not None:
@@ -215,6 +217,7 @@ class PrefixCache:
         number of entries added.
         """
         bt = self.pool.block_tokens
+        # tpu-lint: allow(host-sync): prompts arrive as host ids
         prompt = np.asarray(prompt)
         n_full = len(prompt) // bt
         parent = b""

@@ -24,6 +24,7 @@ Tensor = jax.Array
 def to_tensor(data, dtype=None, stop_gradient=True):
     if dtype is not None:
         return jnp.asarray(data, dtype=to_jax_dtype(dtype))
+    # tpu-lint: allow(host-sync): guard keeps device arrays out of np
     arr = np.asarray(data) if not isinstance(data, (jax.Array, np.ndarray)) else data
     if isinstance(arr, np.ndarray) and arr.dtype == np.float64:
         arr = arr.astype(np.float32)  # paddle defaults float data to fp32

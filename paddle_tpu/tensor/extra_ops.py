@@ -77,6 +77,7 @@ def ravel(x):
 
 
 def tolist(x):
+    # tpu-lint: allow(host-sync): tolist IS a host conversion by contract
     return np.asarray(x).tolist()
 
 
@@ -209,6 +210,7 @@ def masked_scatter(x, mask, value):
     flat_m = mask.ravel()
     src = jnp.asarray(value).ravel()
     if not isinstance(flat_m, jax.core.Tracer):   # eager: enforce like ref
+        # tpu-lint: allow(host-sync): tracer-guarded eager-only validation
         need = int(np.asarray(flat_m).sum())
         if src.shape[0] < need:
             raise ValueError(
@@ -229,6 +231,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
                        axis=None):
     """Collapse consecutive duplicates (eager: data-dependent output
     shape, same contract as tensor.unique)."""
+    # tpu-lint: allow(host-sync): eager op — data-dependent output shape
     xn = np.asarray(x)
     if axis is None:
         xn = xn.ravel()
@@ -522,6 +525,7 @@ def multinomial(x, num_samples=1, replacement=False):
         return jax.random.categorical(
             _next_key(), logits, shape=(num_samples,))
     if not isinstance(x, jax.core.Tracer):   # eager: enforce like ref
+        # tpu-lint: allow(host-sync): tracer-guarded eager-only validation
         nz = int(np.asarray((x > 0).sum(-1).min()))
         if num_samples > nz:
             raise ValueError(

@@ -6,6 +6,7 @@ batches, class-per-directory image folders). This box has zero egress, so
 point `image_path`/`data_file` at local copies, or use FakeData for
 pipeline tests.
 """
+# tpu-lint: allow-file(host-sync): on-disk → host-numpy parsers
 
 import gzip
 import os

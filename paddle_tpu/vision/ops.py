@@ -75,6 +75,8 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         return keep & ~row
 
     keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # tpu-lint: allow(host-sync): nms is eager by contract (the kept
+    # count is data-dependent) — this pull realizes the keep mask
     kept = np.asarray(order)[np.asarray(keep)]
     if top_k is not None:
         kept = kept[:top_k]
@@ -145,6 +147,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     ph, pw = output_size
     n, c, h, w = x.shape
     # batch index per roi from boxes_num
+    # tpu-lint: allow(host-sync): boxes_num must be concrete (np.repeat)
     bn = np.asarray(boxes_num)
     batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
     off = 0.5 if aligned else 0.0
@@ -173,6 +176,8 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return _roi_align_grid(x, batch_idx, x1, y1, rw, rh, ph, pw, 2, 2)
     # reference-exact adaptive grid: group RoIs by their
     # (ceil(rh/ph), ceil(rw/pw)) sample counts, run each group static
+    # tpu-lint: allow(host-sync): concrete-boxes eager path only — the
+    # adaptive grid groups RoIs by host-computed sample counts
     rh_np, rw_np = np.asarray(rh), np.asarray(rw)
     sry = np.maximum(np.ceil(rh_np / ph), 1).astype(np.int64)
     srx = np.maximum(np.ceil(rw_np / pw), 1).astype(np.int64)
@@ -199,6 +204,7 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
         output_size = (output_size, output_size)
     ph, pw = output_size
     n, c, h, w = x.shape
+    # tpu-lint: allow(host-sync): boxes_num must be concrete (np.repeat)
     bn = np.asarray(boxes_num)
     batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
     x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
@@ -294,6 +300,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         if max_sizes:
             mx = max_sizes[i]               # paired, not cross-product
             whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    # tpu-lint: allow(host-sync): host anchor table (python lists in)
     whs = np.asarray(whs, np.float32)                 # (np_, 2)
     cx = (np.arange(w) + offset) * sw
     cy = (np.arange(h) + offset) * sh
@@ -306,6 +313,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
     out[..., 3] = (cyg[:, :, None] + whs[None, None, :, 1] / 2) / ih
     if clip:
         out = np.clip(out, 0.0, 1.0)
+    # tpu-lint: allow(host-sync): host anchor table (python lists in)
     var = np.broadcast_to(np.asarray(variance, np.float32),
                           out.shape).copy()
     return jnp.asarray(out), jnp.asarray(var)
@@ -319,6 +327,7 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     x = jnp.asarray(x, jnp.float32)
     n, _, h, w = x.shape
     an = len(anchors) // 2
+    # tpu-lint: allow(host-sync): anchors is a host python list
     anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(an, 2))
     p = x.reshape(n, an, 5 + class_num, h, w)
     gx = (jnp.arange(w) + 0.0)[None, None, None, :]
@@ -485,12 +494,14 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
                              refer_scale, rois_num=None):
     """paddle.vision.ops.distribute_fpn_proposals: route each RoI to an
     FPN level by its scale. Eager (data-dependent split sizes)."""
+    # tpu-lint: allow(host-sync): eager op — data-dependent split sizes
     rois = np.asarray(fpn_rois, np.float32)
     scale = np.sqrt(np.maximum(
         (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]), 0))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
     if rois_num is not None:
+        # tpu-lint: allow(host-sync): eager op — data-dependent splits
         rn = np.asarray(rois_num)
         img_of = np.repeat(np.arange(len(rn)), rn)
     outs, idxs, nums = [], [], [] if rois_num is not None else None

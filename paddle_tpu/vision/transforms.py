@@ -1,4 +1,9 @@
-"""Transforms (≈ python/paddle/vision/transforms) — numpy/jnp host-side."""
+"""Transforms (≈ python/paddle/vision/transforms) — numpy/jnp host-side.
+
+Input pipeline stage: operates on host images (PIL/numpy) BEFORE data
+reaches the device; np conversions here are the contract, not syncs.
+"""
+# tpu-lint: allow-file(host-sync): host image pipeline by contract
 
 import numpy as np
 
