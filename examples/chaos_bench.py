@@ -59,6 +59,7 @@ def build_engine(model, ns, flight_dump):
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         flight_dump_path=flight_dump,
+        chunk_tokens=getattr(ns, "chunk_tokens", None),
         max_queue=ns.max_queue, shed_infeasible=True)
 
 
@@ -127,6 +128,12 @@ def main():
                     "deadline (the infeasibility-shed targets)")
     ap.add_argument("--deadline_s", type=float, default=5.0)
     ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--chunk_tokens", type=int, default=None,
+                    help="arm chunked prefill (multiple of "
+                    "--block_tokens): the zero-loss exit contract then "
+                    "also covers crashes landing MID-PREFILL — a "
+                    "chunked slot snapshots as a resumable request "
+                    "with its chunk cursor and re-prefills losslessly")
     ap.add_argument("--verify", type=int, default=3,
                     help="completed requests spot-checked token-exact "
                     "against isolated generate (greedy only)")
@@ -245,7 +252,6 @@ def main():
                 sys.exit(2)
             parity_checked += 1
 
-    st = eng.stats
     reg = obs.registry()
     rec = obs.bench_record(
         f"{ns.model} chaos soak {ns.load:g}x survivors",
@@ -255,6 +261,11 @@ def main():
         offered_rps=round(ns.load * cap_rps, 4),
         faults_fired=fired, restores=restores,
         preemptions=reg.counter_total("serving.preemptions"),
+        chunk_tokens=ns.chunk_tokens,
+        # registry counter, not engine stats: each restore rebuilds the
+        # engine with fresh stats — the whole-run chunk count must
+        # survive the crash/restore loop like preemptions does
+        prefill_chunks=reg.counter_total("serving.prefill_chunks"),
         shed_rate=round(shed / ns.requests, 4),
         lost_requests=len(lost), finishes=finishes,
         flight_markers=markers, parity_checked=parity_checked,

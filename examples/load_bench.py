@@ -39,6 +39,14 @@ the ADMITTED requests' tails flat and reports the drop as
 ``shed_rate``. ``--priority_mix`` adds classes, which also exercises
 displacement shedding and slot preemption (``preemptions`` field).
 
+``--chunk_tokens N`` + ``--prompt_mix long`` is the chunked-prefill
+A/B (docs/SERVING.md §Chunked prefill; BENCH_r06): under a bimodal
+prompt mix a monolithic wave prefill stalls every decode slot per
+long prompt (``tpot_p99_s`` grows with load), while the chunked
+engine bounds the stall at one chunk — run both arms on the same box
+with the same seed and compare ``tpot_p99_s``/goodput per point
+(records carry ``chunk_tokens``/``prefill_chunks``).
+
 Prefix caching is off here (random prompts never share blocks) and
 prompt lengths quantize to few pad shapes, keeping prefill compile
 churn out of the measured tails; the first sweep point still pays any
@@ -79,11 +87,21 @@ def make_requests(ns, rng):
     """N requests with uniform prompt lengths / budgets (the queueing
     dynamics, not the length mix, are under test here); ``--priority_mix``
     assigns classes, ``--deadline_s`` attaches a deadline to every
-    request (what infeasibility shedding prices)."""
+    request (what infeasibility shedding prices).
+
+    ``--prompt_mix long`` makes the length mix bimodal: ``--long_frac``
+    of the requests carry a ``--long_prompt``-token prompt — the
+    head-of-line regime where one monolithic wave prefill stalls every
+    active decode slot (the chunked-prefill A/B; docs/SERVING.md
+    §Chunked prefill)."""
     mix = parse_priority_mix(getattr(ns, "priority_mix", None))
+    long_mix = getattr(ns, "prompt_mix", "uniform") == "long"
     reqs = []
     for _ in range(ns.requests):
-        plen = int(rng.randint(ns.min_prompt, ns.max_prompt + 1))
+        if long_mix and rng.random_sample() < ns.long_frac:
+            plen = int(ns.long_prompt)
+        else:
+            plen = int(rng.randint(ns.min_prompt, ns.max_prompt + 1))
         budget = int(rng.randint(ns.min_new, ns.max_new + 1))
         prio = (mix[0][int(rng.choice(len(mix[0]), p=mix[1]))]
                 if mix else "normal")
@@ -190,6 +208,25 @@ def main():
     ap.add_argument("--max_prompt", type=int, default=24)
     ap.add_argument("--min_new", type=int, default=8)
     ap.add_argument("--max_new", type=int, default=32)
+    ap.add_argument("--prompt_mix", choices=("uniform", "long"),
+                    default="uniform",
+                    help="'long' = bimodal prompt lengths: --long_frac "
+                    "of requests carry a --long_prompt-token prompt "
+                    "(the prefill head-of-line-blocking regime the "
+                    "chunked-prefill A/B measures)")
+    ap.add_argument("--long_prompt", type=int, default=256,
+                    help="long-prompt length for --prompt_mix long")
+    ap.add_argument("--long_frac", type=float, default=0.25,
+                    help="fraction of long prompts for --prompt_mix "
+                    "long")
+    ap.add_argument("--chunk_tokens", type=int, default=None,
+                    help="arm chunked prefill: prompts prefill this "
+                    "many tokens per program, interleaved with decode "
+                    "(None = monolithic wave prefill — the A/B "
+                    "baseline). Must be a multiple of --block_tokens")
+    ap.add_argument("--decode_per_chunk", type=int, default=1,
+                    help="decode dispatches guaranteed between "
+                    "consecutive prefill chunks")
     ap.add_argument("--arrivals", choices=("poisson", "bursty"),
                     default="poisson")
     ap.add_argument("--burst_on_s", type=float, default=0.5)
@@ -233,7 +270,9 @@ def main():
     cfg, model = build_model(name)
     ns.vocab = cfg.vocab_size
     if ns.max_seq_len is None:
-        need = ns.max_prompt + ns.max_new
+        top_prompt = (max(ns.max_prompt, ns.long_prompt)
+                      if ns.prompt_mix == "long" else ns.max_prompt)
+        need = top_prompt + ns.max_new
         ns.max_seq_len = -(-need // ns.block_tokens) * ns.block_tokens
 
     from paddle_tpu import observability as obs
@@ -246,6 +285,8 @@ def main():
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         prefix_caching=False, flight_dump_path=ns.flight_dump,
+        chunk_tokens=ns.chunk_tokens,
+        decode_per_chunk=ns.decode_per_chunk,
         sanitize=ns.sanitize)
 
     rng = np.random.RandomState(ns.seed)
@@ -293,6 +334,9 @@ def main():
             step_breakdown_s=step_breakdown(st),
             shed_rate=round(shed / ns.requests, 4),
             preemptions=st["preemptions"],
+            prompt_mix=ns.prompt_mix,
+            chunk_tokens=ns.chunk_tokens,
+            prefill_chunks=st["prefill_chunks"],
             **rep.bench_fields())
         print(json.dumps(rec))
         curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),
@@ -313,6 +357,7 @@ def main():
         slo_ttft_s=ns.slo_ttft_s, slo_tpot_s=ns.slo_tpot_s,
         knee_goodput=ns.knee_goodput,
         knee_load_mult=knee["load_mult"] if knee else None,
+        prompt_mix=ns.prompt_mix, chunk_tokens=ns.chunk_tokens,
         calibrated_capacity_rps=round(cap_rps, 4), curve=curve)
     print(json.dumps(rec))
     eng.close()         # free the KV pool (long sweeps, repeated runs)

@@ -164,6 +164,7 @@ def run_continuous(model, reqs, ns):
         model, max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
+        chunk_tokens=getattr(ns, "chunk_tokens", None),
         sanitize=getattr(ns, "sanitize", False))
     return drive(eng, reqs), eng
 
@@ -210,6 +211,11 @@ def main():
                     "aligned full blocks are content-hash shared, so "
                     "every request after the first skips that prefill")
     ap.add_argument("--cache_int8", action="store_true")
+    ap.add_argument("--chunk_tokens", type=int, default=None,
+                    help="arm chunked prefill on the engine side: "
+                    "prompts prefill this many tokens per program "
+                    "interleaved with decode (multiple of "
+                    "--block_tokens; None = monolithic wave prefill)")
     ap.add_argument("--load", type=float, default=3.0,
                     help="offered load as a multiple of slot capacity")
     ap.add_argument("--long_frac", type=float, default=0.25,
@@ -327,6 +333,8 @@ def main():
         prefix_hit_rate=round(prefix_hit, 3),
         prefill_tokens=st["prefill_tokens"],
         prefill_tokens_reused=st["prefill_tokens_reused"],
+        chunk_tokens=ns.chunk_tokens,
+        prefill_chunks=st["prefill_chunks"],
         pool_blocks=eng.pool.num_blocks - 1,
         block_tokens=ns.block_tokens, **slo.bench_fields(), **common)))
     eng.close()         # free the KV pool (back-to-back bench runs)

@@ -65,6 +65,12 @@ _BENCH_OPTIONAL = {
     "preemptions": numbers.Integral,
     "restores": numbers.Integral,
     "lost_requests": numbers.Integral,
+    # chunked-prefill fields (load_bench/serving_bench --chunk_tokens):
+    # chunk_tokens = the engine's chunk size (null = monolithic wave
+    # prefill), prefill_chunks = chunk programs run over the measured
+    # pass
+    "chunk_tokens": numbers.Integral,
+    "prefill_chunks": numbers.Integral,
 }
 
 

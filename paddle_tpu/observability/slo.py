@@ -176,16 +176,24 @@ class SLOReport:
         self.tokens = 0
         self.good_tokens = 0
 
-    def add(self, ttft_s: float, tpot_s: Optional[float],
+    def add(self, ttft_s: Optional[float], tpot_s: Optional[float],
             tokens: int = 1) -> bool:
-        """Record one finished request; returns whether it met the SLO."""
+        """Record one finished request; returns whether it met the SLO.
+
+        ``ttft_s=None`` means the request never produced a first token
+        (e.g. a chunked-engine slot whose deadline expired mid-prefill
+        — ``RequestResult.ttft_s is None``): it is excluded from the
+        TTFT percentiles (no sample exists) but, when a TTFT SLO is
+        set, counts as MISSING the SLO — a request that died before
+        its first token must drag goodput down, not vanish from it."""
         self.requests += 1
         self.tokens += int(tokens)
-        self.ttft.observe(ttft_s)
+        if ttft_s is not None:
+            self.ttft.observe(ttft_s)
         if tpot_s is not None:
             self.tpot.observe(tpot_s)
         good = not (self.ttft_slo_s is not None
-                    and ttft_s > self.ttft_slo_s) \
+                    and (ttft_s is None or ttft_s > self.ttft_slo_s)) \
             and not (self.tpot_slo_s is not None and tpot_s is not None
                      and tpot_s > self.tpot_slo_s)
         if good:

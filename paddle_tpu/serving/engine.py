@@ -49,6 +49,21 @@ tests/test_serving_robustness.py):
   and finished results through the PR 4 integrity-manifest commit
   protocol; :meth:`ServingEngine.restore` re-admits every request via
   the resume path, so a mid-step fault loses nothing.
+
+Chunked prefill (``chunk_tokens=``; docs/SERVING.md §Chunked prefill):
+the wave prefill is one blocking program per prompt shape, so a single
+long prompt stalls every active decode slot for its whole prefill — a
+``serving.step_prefill_s`` outlier and a TPOT p99 spike under a
+long-prompt mix. With ``chunk_tokens`` set, an admitted prompt is
+processed ``chunk_tokens`` tokens at a time (Sarathi-style): each tick
+runs at most ONE chunk program for the front prefilling slot, then the
+normal fused paged dispatch serves every decode-ready slot, so decode
+TPOT is bounded by one chunk instead of one whole prompt.
+``decode_per_chunk`` is the interleave budget — every active decode
+slot is guaranteed at least that many tokens between consecutive
+chunks. Chunked prefill is a *scheduling* change only: tokens are
+pinned identical to the monolithic wave (greedy+sampled × bf16+int8,
+prefix-hit and preempt-resume cases — tests/test_serving_chunked.py).
 """
 
 import heapq
@@ -73,6 +88,10 @@ __all__ = ["PRIORITIES", "Rejected", "Request", "RequestResult",
            "ServingEngine", "ENGINE_SNAPSHOT_SCHEMA"]
 
 ENGINE_SNAPSHOT_SCHEMA = "paddle_tpu.engine_snapshot/v1"
+
+# token-count buckets for the serving.chunk_tokens histogram (chunk
+# sizes are powers-of-two-ish token counts, not latencies)
+_CHUNK_SIZE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 #: admission classes, lowest to highest. The queue orders by (priority,
 #: submit order); preemption only ever evicts a STRICTLY lower class, so
@@ -232,7 +251,8 @@ class RequestResult:
 class _Slot:
     __slots__ = ("req", "tok", "pos", "count", "tokens", "blocks", "ntab",
                  "worst_blocks", "t_first", "deadline_at",
-                 "prefix_hit_blocks", "feed", "resume")
+                 "prefix_hit_blocks", "feed", "resume",
+                 "prefilling", "filled", "R", "carry", "hits")
 
     def __init__(self, req: Request, worst_blocks: int,
                  prefix_hit_blocks: int, feed: np.ndarray,
@@ -243,7 +263,7 @@ class _Slot:
         self.count = 0          # tokens generated so far
         self.tokens: List[int] = []
         self.blocks: List[int] = []     # owned pool refs (shared + private)
-        self.ntab = 0                   # table entries populated
+        self.ntab = 0                   # blocks allocated for this slot
         self.worst_blocks = worst_blocks
         self.t_first: Optional[float] = None
         self.deadline_at: Optional[float] = None
@@ -255,6 +275,19 @@ class _Slot:
         # left off)
         self.feed = feed
         self.resume = resume            # generated-so-far tokens, or None
+        # chunked-prefill cursor state (chunk_tokens engines): while
+        # `prefilling`, `filled` counts the feed tokens whose KV is
+        # already written (starts at the prefix depth R), `carry` holds
+        # the bf16 KV of [0, filled) as a device buffer between chunk
+        # programs, and `hits` keeps the prefix-cache entries chunk 0
+        # adopts. A prefilling slot stays OUT of the decode batch (its
+        # mirror table row points at scratch) until its last chunk
+        # samples the first token.
+        self.prefilling = False
+        self.filled = 0
+        self.R = 0                      # prefix-hit depth in tokens
+        self.carry = None
+        self.hits = None
 
 
 class _PriorityQueue:
@@ -373,6 +406,19 @@ class ServingEngine:
     always armed but only ever fires across *different* priority
     classes, so all-default-priority workloads never preempt.
 
+    ``chunk_tokens`` (None = monolithic wave prefill, the PR 5
+    behavior) arms chunked prefill: prompts are prefilled
+    ``chunk_tokens`` tokens per program (must be a multiple of
+    ``block_tokens``), at most one chunk per tick, interleaved with the
+    decode dispatch so a long prompt never stalls active decode slots
+    for more than one chunk. ``decode_per_chunk`` decode dispatches are
+    guaranteed between consecutive chunks while decode-ready slots
+    exist. Chunk programs are keyed by (kind, KV-cursor) — fixed bucket
+    sizes, so the compile set stays small and exactly pinned
+    (tests/test_analysis.py). Tradeoff: chunked admissions prefill one
+    request at a time (no same-tick wave batching) — bounded per-tick
+    prefill work is the point.
+
     ``sanitize=True`` (debug; docs/ANALYSIS.md) arms the dispatch
     sanitizer: every steady-state decode dispatch runs under
     ``analysis.runtime.sanitize()`` — zero H2D transfers, zero
@@ -392,6 +438,8 @@ class ServingEngine:
                  flight_dump_path: Optional[str] = None,
                  max_queue: Optional[int] = None,
                  shed_infeasible: bool = False,
+                 chunk_tokens: Optional[int] = None,
+                 decode_per_chunk: int = 1,
                  sanitize: bool = False,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
@@ -459,6 +507,18 @@ class ServingEngine:
                              f"{max_queue}")
         self.max_queue = None if max_queue is None else int(max_queue)
         self.shed_infeasible = bool(shed_infeasible)
+        if chunk_tokens is not None:
+            chunk_tokens = int(chunk_tokens)
+            if chunk_tokens < block_tokens or chunk_tokens % block_tokens:
+                raise ValueError(
+                    f"chunk_tokens {chunk_tokens} must be a positive "
+                    f"multiple of block_tokens {block_tokens} (chunks "
+                    f"append block-aligned KV)")
+        self.chunk_tokens = chunk_tokens
+        if decode_per_chunk < 1:
+            raise ValueError(f"decode_per_chunk must be >= 1, got "
+                             f"{decode_per_chunk}")
+        self.decode_per_chunk = int(decode_per_chunk)
         self._closed = False
 
         from paddle_tpu.ops import rope as rope_ops
@@ -515,7 +575,21 @@ class ServingEngine:
         self._tick_shed: List = []      # (request_id, reason) pairs
         self._pending_finished: List[int] = []  # shed between ticks
         self._ewma_step = _Ewma()       # decode dispatch+sync per step
-        self._ewma_prefill = _Ewma()    # per prefill-wave group
+        # prefill cost PER TOKEN (wall seconds / new tokens prefilled):
+        # the estimator must price a 2048-token prompt ~64x a 32-token
+        # one, not one flat wave term — deadline-infeasibility shedding
+        # would otherwise over-shed short prompts queued behind long
+        # ones (tests/test_serving_chunked.py pins the bimodal case)
+        self._ewma_prefill_tok = _Ewma()
+        self._ewma_chunk = _Ewma()      # per chunk-program wall time
+        # chunked-prefill scheduler state: FIFO of (slot_idx, slot)
+        # still mid-prefill (stale entries lazily dropped by identity
+        # check), chunk events this tick, and decode dispatches since
+        # the last chunk (the decode_per_chunk interleave budget;
+        # initialized satisfied so the first chunk runs immediately)
+        self._prefill_fifo: List = []
+        self._tick_chunks: List = []    # (request_id, start, ntok)
+        self._decode_since_chunk = self.decode_per_chunk
         self._step_fn_warm = False      # first dispatch pays the compile
         # dispatch sanitizer (paddle_tpu.analysis.runtime,
         # docs/ANALYSIS.md): with sanitize=True every STEADY-STATE
@@ -557,6 +631,7 @@ class ServingEngine:
         ``serving.step_*_s`` registry histograms."""
         return dict(steps=0, decode_tokens=0, idle_slot_steps=0,
                     prefill_tokens=0, prefill_tokens_reused=0,
+                    prefill_chunks=0,
                     requests_finished=0, requests_admitted=0,
                     preemptions=0, requests_resumed=0,
                     requests_shed=0, requests_rejected=0,
@@ -623,15 +698,24 @@ class ServingEngine:
 
     def estimated_ttft_s(self, request: Request) -> Optional[float]:
         """EWMA-capacity estimate of ``request``'s queue-wait + prefill
-        time (the earliest its first token could land): work ahead of
-        it (active slots' remaining budgets + queued requests at >= its
-        priority) spread over ``max_slots``, priced at the EWMA decode
-        step time, plus one EWMA prefill wave. Fed by the same segment
-        wall times the ``serving.step_*_s`` histograms observe; None
-        until the engine has decoded at least one step (a cold engine
-        must not shed on a guess)."""
+        time (the earliest its first token could land): decode work
+        ahead of it (active slots' remaining budgets + queued requests
+        at >= its priority) spread over ``max_slots`` at the EWMA
+        decode step time, plus prefill work priced PER TOKEN — its own
+        prompt AND the >=rank prompts queued/prefilling ahead of it, so
+        a 2048-token prompt costs ~64x a 32-token one instead of one
+        flat wave term (long-prompt bias would over-shed short prompts
+        queued behind long ones). On a chunked engine the request's own
+        prefill is priced as ceil(prompt/chunk_tokens) full chunks plus
+        the ``decode_per_chunk`` decode dispatches interleaved between
+        them. Fed by the same segment wall times the
+        ``serving.step_*_s`` histograms observe; None until the engine
+        has decoded at least one step (a cold engine must not shed on a
+        guess)."""
         if self._ewma_step.value is None:
             return None
+        step_s = self._ewma_step.value
+        tok_s = self._ewma_prefill_tok.value or 0.0
         # only work at >= this request's priority counts as "ahead":
         # strictly lower-priority slots are exactly what admission
         # would preempt for it, and lower-priority queue entries sort
@@ -643,8 +727,25 @@ class ServingEngine:
         ahead += sum(r.max_new_tokens - len(r._resume_tokens or [])
                      for r in self._queue.items()
                      if r.rank >= request.rank)
-        prefill = self._ewma_prefill.value or 0.0
-        return prefill + (ahead / self.max_slots) * self._ewma_step.value
+        # prefill tokens ahead: queued >=rank feeds (prompt + resume
+        # tokens they re-prefill) and the unprefilled remainder of
+        # slots still mid-chunk
+        ahead_pf = sum(len(r.prompt) + len(r._resume_tokens or [])
+                       for r in self._queue.items()
+                       if r.rank >= request.rank)
+        ahead_pf += sum(len(s.feed) - s.filled
+                        for s in self._slots
+                        if s is not None and s.prefilling
+                        and s.req.rank >= request.rank)
+        P = len(request.prompt)
+        if self.chunk_tokens is not None:
+            n_chunks = -(-P // self.chunk_tokens)
+            own = (n_chunks * self.chunk_tokens * tok_s
+                   + (n_chunks - 1) * self.decode_per_chunk * step_s)
+        else:
+            own = P * tok_s
+        return (own + ahead_pf * tok_s
+                + (ahead / self.max_slots) * step_s)
 
     def submit(self, request) -> int:
         """Queue a request (accepts a :class:`Request` or a 1-D prompt).
@@ -810,6 +911,248 @@ class ServingEngine:
         self._jit_cache[key] = fn
         return fn, False
 
+    def _chunk_fn(self, kind, start, gather):
+        """One prefill-chunk program: forward ``chunk_tokens`` prompt
+        tokens over the KV of the ``start`` tokens already processed,
+        and append the chunk's KV into the slot's pool blocks. Programs
+        are keyed by (kind, start, gather) — ``start`` only ever takes
+        values ``R + i*chunk_tokens``, so the compile set is one
+        program per chunk bucket (pinned in tests/test_analysis.py).
+
+        ``kind='mid'``: carries the running bf16 KV forward (the lm
+        head is traced but unused, so XLA dead-codes it away); bf16
+        pools additionally scatter the chunk's blocks. ``kind='last'``:
+        samples the first token at the feed's last valid position; int8
+        pools compute the per-slot calibration scales over the ORIGINAL
+        prompt positions of the carried bf16 KV and quantize+scatter
+        every prompt block in one go — deferring quantization to the
+        last chunk is what keeps the scales (and therefore the tokens)
+        identical to a monolithic prefill. ``gather`` > 0 = bf16
+        chunk 0 over a CoW prefix: the program gathers the shared
+        blocks from the pool itself, so the prefix gather costs one
+        dispatch on chunk 0 only.
+
+        Returns ``(fn, cached)`` — ``cached=False`` means this call
+        pays the trace+compile, which the EWMA estimators must not
+        ingest."""
+        from paddle_tpu.inference import (_fold_rows, _row_keys,
+                                          _sample_logits)
+        from paddle_tpu.nn.layer import functional_call
+
+        key = ("chunk", kind, self.kv_int8, start, gather)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn, True
+        nkv, hd = self.meta["num_kv_heads"], self.meta["head_dim"]
+        dkv = self._dkv
+        BT = self.block_tokens
+        CT = self.chunk_tokens
+        cache_len = start + CT
+        model = self.model
+        int8 = self.kv_int8
+        last = kind == "last"
+        has_pool = not int8 or last     # int8 mid chunks never touch it
+
+        def impl(*args):
+            args = list(args)
+            state = args.pop(0)
+            pool = args.pop(0) if has_pool else None
+            prev = args.pop(0) if start else None
+            ids = args.pop(0)
+            new_bids = args.pop(0) if has_pool else None
+            if last:
+                last_idx = args.pop(0)
+                seeds = args.pop(0)
+                valid = args.pop(0) if int8 else None
+            cache = model.init_cache(1, cache_len, dtype=jnp.bfloat16)
+            if start:
+                pk = (pool[:, prev].reshape(len(cache), 1, start, 2 * dkv)
+                      if gather else prev)
+                for l in range(len(cache)):
+                    kl = pk[l, :, :, :dkv].reshape(1, start, nkv, hd)
+                    vl = pk[l, :, :, dkv:].reshape(1, start, nkv, hd)
+                    cache[l] = {
+                        "k": cache[l]["k"].at[:, :start].set(
+                            kl.astype(cache[l]["k"].dtype)),
+                        "v": cache[l]["v"].at[:, :start].set(
+                            vl.astype(cache[l]["v"].dtype))}
+            with jax.named_scope("decode.prefill"):
+                out, cache = functional_call(model, state, ids,
+                                             cache=cache, start_pos=start)
+            kv_flat = jnp.stack([jnp.concatenate(
+                [c["k"].reshape(1, cache_len, dkv),
+                 c["v"].reshape(1, cache_len, dkv)], axis=-1)
+                for c in cache])             # (L, 1, cache_len, 2dkv)
+            if last:
+                logits = jnp.take_along_axis(
+                    out, last_idx[:, None, None], axis=1)[:, 0]
+                with jax.named_scope("decode.sample"):
+                    tok = _sample_logits(logits,
+                                         _fold_rows(_row_keys(seeds), 0),
+                                         self.temperature, self.top_k,
+                                         self.top_p)
+            if int8:
+                if not last:
+                    return kv_flat
+                # calibration over the original prompt positions only
+                # (resume appends beyond the prompt were quantized with
+                # prompt-only scales in the uninterrupted run too);
+                # padded-tail kv must not leak into the scales either
+                mask = (jnp.arange(cache_len)[None]
+                        < valid[:, None])[None, :, :, None]
+                a = jnp.where(mask, jnp.abs(kv_flat.astype(jnp.float32)),
+                              0.0).max(axis=2)          # (L, 1, 2dkv)
+                a = a.reshape(-1, 1, 2 * nkv, hd).max(axis=-1)
+                lanes = jnp.repeat(jnp.maximum(a / 127.0, 1e-8), hd,
+                                   axis=-1)
+                q = jnp.clip(jnp.round(
+                    kv_flat.astype(jnp.float32) / lanes[:, :, None, :]),
+                    -127, 127).astype(jnp.int8)
+                # new_bids covers every cache_len//BT block; entries
+                # past the feed's last allocated block are SCRATCH, so
+                # padded-tail garbage lands in the masked scratch block
+                pool = pool.at[:, new_bids].set(
+                    q.reshape(-1, 1, cache_len // BT, BT, 2 * dkv))
+                return tok, pool, lanes, kv_flat
+            blk = kv_flat[:, :, start:].reshape(-1, 1, CT // BT, BT,
+                                                2 * dkv)
+            pool = pool.at[:, new_bids].set(blk.astype(pool.dtype))
+            return (tok, pool) if last else (kv_flat, pool)
+
+        donate = (1,) if has_pool else ()
+        jitted = jax.jit(impl, donate_argnums=donate)
+        fn = lambda *a: jitted(self._state, *a)   # noqa: E731
+        self._jit_cache[key] = fn
+        return fn, False
+
+    def _front_prefill(self):
+        """The (slot_idx, slot) at the head of the prefill FIFO, or
+        None. Entries whose slot retired/preempted/unwound mid-prefill
+        are dropped lazily (identity check — the index may since hold a
+        different slot)."""
+        while self._prefill_fifo:
+            slot_idx, slot = self._prefill_fifo[0]
+            if self._slots[slot_idx] is slot and slot.prefilling:
+                return slot_idx, slot
+            self._prefill_fifo.pop(0)
+        return None
+
+    def _run_prefill_chunk(self, slot_idx: int, s: "_Slot"):
+        """Run ONE chunk program for slot ``s``: a mid chunk advances
+        the cursor and carry; the last chunk samples the first token
+        and adopts the slot into the decode batch (:meth:`_adopt_slot`).
+        Timed into the step's prefill segment; each chunk counts
+        ``serving.prefill_chunks`` and observes the chunk-size
+        histogram, and a chunk overrunning 4x the EWMA chunk time
+        queues a flight-recorder dump (``chunk_stall``)."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import registry
+
+        t0 = time.perf_counter()
+        CT, BT = self.chunk_tokens, self.block_tokens
+        start = s.filled
+        P = len(s.feed)
+        ntok = min(CT, P - start)
+        last = start + CT >= P
+        hb = s.R // BT
+        gather = hb if (not self.kv_int8 and start == s.R and hb) else 0
+        ids = np.zeros((1, CT), np.int32)
+        ids[0, :ntok] = s.feed[start:start + ntok]
+        fn, warm = self._chunk_fn("last" if last else "mid", start, gather)
+        args = [self.kv_pool] if (not self.kv_int8 or last) else []
+        if start:
+            if gather:
+                args.append(jnp.asarray(
+                    np.asarray([s.blocks[:hb]], np.int32)))
+            elif start == s.R and self.kv_int8 and hb:
+                # int8 chunk 0 over a prefix hit: the carry IS the
+                # cache's exact bf16 host copies (quantized blocks are
+                # per-slot-scaled, never shareable)
+                args.append(jnp.asarray(np.concatenate(
+                    [e.kv_host for e in s.hits], axis=1)[:, None]))
+            else:
+                args.append(s.carry)
+        args.append(jnp.asarray(ids))
+        n0 = s.ntab                     # blocks covering the whole feed
+        if not self.kv_int8:
+            lo = start // BT
+            bids = [s.blocks[c] if c < n0 else SCRATCH_BLOCK
+                    for c in range(lo, (start + CT) // BT)]
+            args.append(jnp.asarray(np.asarray([bids], np.int32)))
+        elif last:
+            bids = [s.blocks[c] if c < n0 else SCRATCH_BLOCK
+                    for c in range((start + CT) // BT)]
+            args.append(jnp.asarray(np.asarray([bids], np.int32)))
+        if last:
+            args.append(jnp.asarray(np.asarray([P - 1 - start], np.int32)))
+            args.append(jnp.asarray(np.asarray([s.req.seed], np.uint32)))
+            if self.kv_int8:
+                args.append(jnp.asarray(
+                    np.asarray([len(s.req.prompt)], np.int32)))
+        if not last:
+            if self.kv_int8:
+                s.carry = fn(*args)
+            else:
+                s.carry, self.kv_pool = fn(*args)
+            s.filled = start + CT
+            # a mid chunk has no D2H pull to fence it: without this the
+            # wall time below measures dispatch only (~µs on async
+            # backends) and the chunk's real compute is silently
+            # absorbed into the NEXT decode step's sync segment — the
+            # per-token prefill EWMA would under-price long prompts and
+            # the chunk-stall trigger could never fire on a stalled mid
+            # chunk. One sync per chunk tick matches the engine's
+            # one-sync-per-tick design.
+            # tpu-lint: allow(host-sync): the mid-chunk completion fence
+            s.carry.block_until_ready()
+        elif self.kv_int8:
+            tok, self.kv_pool, lanes, kv_flat = fn(*args)
+            # tpu-lint: allow(host-sync): once-per-prefill D2H — scales
+            lanes_np = np.asarray(lanes)
+            # tpu-lint: allow(host-sync): once-per-prefill D2H — the
+            # prefix cache keeps exact bf16 host copies of int8 blocks
+            kv_np = (np.asarray(kv_flat)
+                     if self.prefix_cache is not None else None)
+            # tpu-lint: allow(host-sync): once-per-prefill D2H — token
+            self._adopt_slot(slot_idx, s, int(np.asarray(tok)[0]),
+                             lanes_np[:, 0],
+                             None if kv_np is None else kv_np[:, 0])
+        else:
+            tok, self.kv_pool = fn(*args)
+            # tpu-lint: allow(host-sync): once-per-prefill D2H — token
+            self._adopt_slot(slot_idx, s, int(np.asarray(tok)[0]),
+                             None, None)
+        t = time.perf_counter() - t0
+        self._tick_prefill_s += t
+        self._tick_chunks.append((s.req.request_id, start, ntok))
+        self.stats["prefill_chunks"] += 1
+        r = registry()
+        r.counter("serving.prefill_chunks").inc()
+        r.histogram("serving.chunk_tokens",
+                    buckets=_CHUNK_SIZE_BUCKETS).observe(ntok)
+        tr = obs.active_tracer()
+        if tr is not None:
+            tr.record("serving.prefill_chunk", ts=time.time() - t,
+                      dur_s=t, request_id=s.req.request_id,
+                      start=int(start), tokens=int(ntok),
+                      last=bool(last))
+        if warm:    # compile spikes must not poison estimator/stall EWMAs
+            ew = self._ewma_chunk.value
+            if ew is not None and t > 4.0 * ew \
+                    and self._dump_pending is None:
+                # a warm chunk overrunning 4x its EWMA is the
+                # chunked-prefill analog of a step_prefill_s outlier —
+                # snapshot the ring for the postmortem
+                self._dump_pending = "chunk_stall"
+            self._ewma_chunk.update(t)
+            # per COMPUTED token, not per valid token: the program
+            # always forwards the full CT-wide chunk (the tail is
+            # padded), and estimated_ttft_s prices a prompt as
+            # ceil(P/CT) * CT * tok_s — dividing a short last chunk's
+            # wall time by its few valid tokens would inflate the EWMA
+            # up to CT-fold and over-shed feasible deadlines
+            self._ewma_prefill_tok.update(t / CT)
+
     def _release_slot(self, slot_idx: int):
         """Free a slot's blocks and reservation and zero its block
         table + host mirrors — the ONE teardown behind retire, preempt
@@ -818,6 +1161,8 @@ class ServingEngine:
         s = self._slots[slot_idx]
         for bid in s.blocks:
             self.pool.free(bid)
+        s.carry = None          # slot objects linger on the prefill
+        s.hits = None           # FIFO; drop the device buffer now
         self._reserved -= s.worst_blocks - s.ntab
         self._slots[slot_idx] = None
         self._tables[slot_idx][:] = SCRATCH_BLOCK
@@ -854,9 +1199,17 @@ class ServingEngine:
 
         s = self._slots[slot_idx]
         req = s.req
-        req._resume_tokens = list(s.tokens)
-        req._t_first = s.t_first
-        if self.prefix_cache is not None and not self.kv_int8:
+        if s.prefilling:
+            # mid-chunk victim: no tokens sampled yet — requeue with
+            # whatever resume state it was admitted with (None for a
+            # fresh request); its partial KV (and carry) are dropped
+            # with the slot, and the chunked re-prefill recomputes them
+            req._resume_tokens = s.resume
+        else:
+            req._resume_tokens = list(s.tokens)
+            req._t_first = s.t_first
+        if self.prefix_cache is not None and not self.kv_int8 \
+                and not s.prefilling:
             # feed = prompt + generated[:-1]: exactly the s.pos written
             # positions; its full blocks are append-proof and already
             # physically populated — cache them (the cache takes its own
@@ -885,7 +1238,22 @@ class ServingEngine:
         ordered (priority, submit order) and stays head-of-line WITHIN
         that order; when the head cannot be placed, strictly
         lower-priority slots are preempted (requeued resumable, never
-        dropped) to make room — first for a slot, then for blocks."""
+        dropped) to make room — first for a slot, then for blocks.
+
+        Chunked mode (``chunk_tokens``): admission only places slots —
+        blocks reserved/allocated, cursor at the prefix depth — and
+        queues them on the prefill FIFO; the chunk programs run one per
+        tick from :meth:`_step_inner`, so admission cost stays bounded
+        and no prefill program blocks the tick that admitted it."""
+        if self.chunk_tokens is not None:
+            wave = []
+            wave_idx = set()
+            try:
+                self._collect_wave(wave, wave_idx)
+            except BaseException:
+                self._unwind_wave(wave)
+                raise
+            return
         while self._queue:
             wave = []           # (slot_idx, slot, hits, R, s_pad)
             wave_idx = set()    # slots admitted this wave: not preemptable
@@ -1036,6 +1404,7 @@ class ServingEngine:
             n0 = -(-P // BT)        # blocks covering the feed
             s_pad = -(-(P - R) // BT) * BT
             slot = _Slot(req, worst, len(hits), feed, resume)
+            slot.R = R
             row = self._tables[slot_idx]
             row[:] = SCRATCH_BLOCK
             if self.kv_int8:
@@ -1045,8 +1414,23 @@ class ServingEngine:
                     self.pool.ref(e.block_id)
                 slot.blocks = ([e.block_id for e in hits]
                                + self.pool.alloc(n0 - len(hits)))
-            row[:n0] = slot.blocks
             slot.ntab = n0
+            if self.chunk_tokens is not None:
+                # chunked: the mirror table row STAYS at scratch until
+                # the last chunk lands — a decode append into a
+                # half-written prompt block would corrupt it. Blocks
+                # are handed to the chunk programs directly; _adopt_slot
+                # publishes the row when the slot joins decode.
+                slot.prefilling = True
+                slot.filled = R
+                slot.hits = hits
+                if req.deadline_s is not None:
+                    # mid-prefill expiry must sweep chunked slots (a
+                    # monolithic slot prefills the tick it is admitted)
+                    slot.deadline_at = req._t_submit + req.deadline_s
+                self._prefill_fifo.append((slot_idx, slot))
+            else:
+                row[:n0] = slot.blocks
             self._reserved += worst - n0
             self._slots[slot_idx] = slot
             self._tick_admitted.append(req.request_id)
@@ -1116,76 +1500,99 @@ class ServingEngine:
             lanes_np = kv_np = None
         # tpu-lint: allow(host-sync): once-per-wave D2H — first tokens
         tok_np = np.asarray(tok)
-        # the prefill sample is each FRESH request's first GENERATED
-        # token (stats["decode_tokens"] counts only decode-step tokens);
-        # a resumed row's sample is discarded — its next token comes
-        # from the next decode step at fold_in(seed, count), exactly
-        # where the uninterrupted run's stream stood
-        fresh = sum(1 for _, s, _, _, _ in grp if not s.resume)
-        if fresh:
-            registry().counter("serving.tokens_generated").inc(fresh)
-        if fresh != n:
-            registry().counter("serving.resumed").inc(n - fresh)
-        eos = self.eos_token_id
         for r, (slot_idx, slot, hits, _, _) in enumerate(grp):
-            req = slot.req
-            P = len(slot.feed)
-            if lanes_np is not None:
-                self._kv_scales[:, slot_idx, :] = lanes_np[:, r]
-            slot.pos = P
-            if slot.resume:
-                slot.count = len(slot.resume)
-                slot.tok = int(slot.resume[-1])
-                slot.tokens = list(slot.resume)
-                # TTFT is measured once, at the ORIGINAL first token —
-                # a preemption must not reset it (crash restore has no
-                # surviving monotonic base; it restarts the clock)
-                slot.t_first = (req._t_first if req._t_first is not None
-                                else time.perf_counter())
-            else:
-                slot.count = 1
-                slot.tok = int(tok_np[r])
-                slot.tokens = [slot.tok]
-                slot.t_first = time.perf_counter()
-            if req.deadline_s is not None:
-                slot.deadline_at = req._t_submit + req.deadline_s
-            self._positions[slot_idx] = P
-            self._toks[slot_idx] = slot.tok
-            self._seeds[slot_idx] = np.uint32(req.seed)
-            self._counts[slot_idx] = slot.count
-            self.stats["prefill_tokens"] += P - R
-            self.stats["prefill_tokens_reused"] += R
-            if self.prefix_cache is not None:
-                # full feed blocks are append-proof (appends land at
-                # pos >= P) — bf16 shares them as-is, copy-on-write by
-                # construction; int8 keeps exact bf16 copies host-side.
-                # Inserts land AFTER the wave program so a same-wave
-                # sibling can never hit blocks not yet written (it just
-                # misses; the next wave sees the entries).
-                nh = len(hits)
-                if self.kv_int8:
-                    # copy the slices: a view would pin the whole wave's
-                    # (L, n, cache_len, 2dkv) buffer per cached block
-                    # tpu-lint: allow(host-sync): host slice copy (kv_np)
-                    self.prefix_cache.insert(
-                        slot.feed, nh,
-                        kv_host=[np.ascontiguousarray(
-                            kv_np[:, r, c * BT:(c + 1) * BT])
-                                 for c in range(nh, P // BT)])
-                else:
-                    self.prefix_cache.insert(
-                        slot.feed, nh,
-                        block_ids=slot.blocks[nh:P // BT])
-            if (eos is not None and slot.tok == int(eos)) \
-                    or slot.count >= req.max_new_tokens:
-                self._retire(slot_idx,
-                             "eos" if eos is not None
-                             and slot.tok == int(eos) else "length")
+            self._adopt_slot(
+                slot_idx, slot, int(tok_np[r]),
+                None if lanes_np is None else lanes_np[:, r],
+                None if kv_np is None else kv_np[:, r])
         self._tick_prefills.append((R, s_pad, n))
         t_grp = time.perf_counter() - t_pf0
         self._tick_prefill_s += t_grp
         if warm:        # compile spikes must not poison the estimator
-            self._ewma_prefill.update(t_grp)
+            new_toks = sum(len(s.feed) - s.R for _, s, _, _, _ in grp)
+            self._ewma_prefill_tok.update(t_grp / max(new_toks, 1))
+
+    def _adopt_slot(self, slot_idx: int, s: "_Slot", tok: int,
+                    lanes_row, kv_row):
+        """Join a fully-prefilled slot to the running decode batch: the
+        mirror table row and per-slot device-mirror state, resume/TTFT
+        bookkeeping, int8 scales, the prefix-cache insert and instant
+        finishes. The ONE adoption path behind both the monolithic wave
+        (one call per wave row) and the chunked path (after a slot's
+        last chunk) — parity between the two modes lives here.
+
+        The prefill sample ``tok`` is a FRESH request's first GENERATED
+        token (``stats["decode_tokens"]`` counts only decode-step
+        tokens); a resumed slot's sample is discarded — its next token
+        comes from the next decode step at ``fold_in(seed, count)``,
+        exactly where the uninterrupted run's stream stood."""
+        from paddle_tpu.observability import registry
+
+        req = s.req
+        P = len(s.feed)
+        BT = self.block_tokens
+        s.prefilling = False
+        s.carry = None          # free the chunk carry buffer promptly
+        s.hits = None
+        # publish the block-table row (the chunked path deferred it so
+        # decode appends could not touch half-written prompt blocks)
+        self._tables[slot_idx][:s.ntab] = s.blocks
+        self._dirty = True
+        if lanes_row is not None:
+            self._kv_scales[:, slot_idx, :] = lanes_row
+        s.pos = P
+        r = registry()
+        if s.resume:
+            s.count = len(s.resume)
+            s.tok = int(s.resume[-1])
+            s.tokens = list(s.resume)
+            # TTFT is measured once, at the ORIGINAL first token —
+            # a preemption must not reset it (crash restore has no
+            # surviving monotonic base; it restarts the clock)
+            s.t_first = (req._t_first if req._t_first is not None
+                         else time.perf_counter())
+            r.counter("serving.resumed").inc()
+        else:
+            s.count = 1
+            s.tok = int(tok)
+            s.tokens = [s.tok]
+            s.t_first = time.perf_counter()
+            r.counter("serving.tokens_generated").inc()
+        if req.deadline_s is not None and s.deadline_at is None:
+            s.deadline_at = req._t_submit + req.deadline_s
+        self._positions[slot_idx] = P
+        self._toks[slot_idx] = s.tok
+        self._seeds[slot_idx] = np.uint32(req.seed)
+        self._counts[slot_idx] = s.count
+        self.stats["prefill_tokens"] += P - s.R
+        self.stats["prefill_tokens_reused"] += s.R
+        if self.prefix_cache is not None:
+            # full feed blocks are append-proof (appends land at
+            # pos >= P) — bf16 shares them as-is, copy-on-write by
+            # construction; int8 keeps exact bf16 copies host-side.
+            # Inserts land AFTER the prefill program so a same-wave
+            # sibling can never hit blocks not yet written (it just
+            # misses; the next wave sees the entries).
+            nh = s.prefix_hit_blocks
+            if self.kv_int8:
+                if kv_row is not None:
+                    # copy the slices: a view would pin the whole
+                    # (L, cache_len, 2dkv) buffer per cached block
+                    # tpu-lint: allow(host-sync): host slice copy
+                    self.prefix_cache.insert(
+                        s.feed, nh,
+                        kv_host=[np.ascontiguousarray(
+                            kv_row[:, c * BT:(c + 1) * BT])
+                                 for c in range(nh, P // BT)])
+            else:
+                self.prefix_cache.insert(
+                    s.feed, nh, block_ids=s.blocks[nh:P // BT])
+        eos = self.eos_token_id
+        if (eos is not None and s.tok == int(eos)) \
+                or s.count >= req.max_new_tokens:
+            self._retire(slot_idx,
+                         "eos" if eos is not None
+                         and s.tok == int(eos) else "length")
 
     # -------------------------------------------------------------- decode
     def _build_step_fn(self):
@@ -1260,14 +1667,26 @@ class ServingEngine:
         now = time.perf_counter()
         self._release_slot(slot_idx)
 
+        # a slot swept mid-prefill (chunked engines: deadline expiry
+        # before its last chunk) has no sampled tokens yet — it retires
+        # with what a preemption would have preserved (the resume
+        # tokens, for a request cut while re-prefilling)
+        raw = s.tokens if not s.prefilling else (s.resume or [])
         # tpu-lint: allow(host-sync): generated tokens are a host list
-        toks = np.asarray(s.tokens, np.int32)
+        toks = np.asarray(raw, np.int32)
         eos = self.eos_token_id
         if eos is not None and (toks == int(eos)).any():
             gen_len = int((toks == int(eos)).argmax())
         else:
             gen_len = len(toks)
-        ttft = s.t_first - s.req._t_submit
+        if s.t_first is not None:
+            ttft = s.t_first - s.req._t_submit
+        elif s.req._t_first is not None and s.req._t_submit is not None:
+            # preempted-then-resumed, cut mid-re-prefill: TTFT is still
+            # the ORIGINAL first token (same rule as _shed_queued)
+            ttft = s.req._t_first - s.req._t_submit
+        else:
+            ttft = None
         tpot = ((now - s.t_first) / (s.count - 1) if s.count > 1 else None)
         res = RequestResult(s.req.request_id, s.req.prompt, toks, gen_len,
                             finish, ttft, tpot, s.prefix_hit_blocks)
@@ -1279,7 +1698,8 @@ class ServingEngine:
         r.counter("serving.requests", finish=finish).inc()
         # the SLO percentile layer: per-request TTFT/TPOT land in
         # bounded-relative-error sketches (docs/OBSERVABILITY.md)
-        r.sketch("serving.ttft_s").observe(ttft)
+        if ttft is not None:
+            r.sketch("serving.ttft_s").observe(ttft)
         if tpot is not None:
             r.sketch("serving.tpot_s").observe(tpot)
         if finish == "deadline":
@@ -1326,6 +1746,7 @@ class ServingEngine:
         self._tick_admitted = []
         self._tick_retired = []
         self._tick_prefills = []
+        self._tick_chunks = []
         self._tick_prefill_s = 0.0
         self._tick_preempted = []
         self._tick_resumed = []
@@ -1363,8 +1784,27 @@ class ServingEngine:
                     and now > s.deadline_at:
                 record_event("deadline_exceeded")
                 self._retire(i, "deadline")
+        # chunked-prefill interleave: at most ONE chunk program per
+        # tick, and only once every `decode_per_chunk` decode
+        # dispatches while decode-ready slots exist — the decode TPOT
+        # bound is one chunk, whatever the prompt length. With nothing
+        # decode-ready the chunk runs unconditionally (nothing to
+        # starve; prefill should not idle either).
+        if self.chunk_tokens is not None:
+            front = self._front_prefill()
+            if front is not None:
+                decode_ready = any(s is not None and not s.prefilling
+                                   for s in self._slots)
+                if (not decode_ready
+                        or self._decode_since_chunk
+                        >= self.decode_per_chunk):
+                    self._run_prefill_chunk(*front)
+                    self._decode_since_chunk = 0
         dispatch_s = sync_s = None
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        # prefilling slots stay OUT of the decode batch: their mirror
+        # rows idle against scratch until the last chunk adopts them
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and not s.prefilling]
         if active:
             if self._step_fn is None:
                 self._step_fn = self._build_step_fn()
@@ -1408,6 +1848,7 @@ class ServingEngine:
             # sampled-token pull is the step's completion fence
             nxt = np.asarray(d_nxt)
             sync_s = time.perf_counter() - t_s0
+            self._decode_since_chunk += 1
             self.stats["steps"] += 1
             self.stats["decode_tokens"] += len(active)
             self.stats["idle_slot_steps"] += self.max_slots - len(active)
@@ -1483,6 +1924,10 @@ class ServingEngine:
                "shed": [[rid, reason] for rid, reason in self._tick_shed],
                "prefills": [[R, s_pad, n]
                             for R, s_pad, n in self._tick_prefills],
+               "chunk_tokens": self.chunk_tokens,
+               "prefill_chunks": len(self._tick_chunks),
+               "chunks": [[rid, st, nt]
+                          for rid, st, nt in self._tick_chunks],
                "t_admit_s": round(admit_s, 6),
                "t_prefill_s": round(self._tick_prefill_s, 6),
                "t_dispatch_s": (None if dispatch_s is None
@@ -1569,6 +2014,7 @@ class ServingEngine:
             self.prefix_cache.clear()
         self._slots = [None] * self.max_slots
         self._queue = _PriorityQueue()
+        self._prefill_fifo = []
         self._tables = self._positions = self._toks = None
         self._seeds = self._counts = self._kv_scales = None
 
@@ -1616,8 +2062,22 @@ class ServingEngine:
                     "deadline_remaining_s": rem,
                     "tokens": [int(t) for t in tokens]}
 
-        slots = [_req(s.req, s.tokens, s.deadline_at)
-                 for s in self._slots if s is not None]
+        # a slot still mid-prefill (chunked engines) has sampled no
+        # tokens: serialize the resume state it was ADMITTED with (a
+        # preempted request's generated-so-far tokens must survive a
+        # crash that lands mid-re-prefill), plus the chunk cursor so a
+        # postmortem can see how far its prefill got — restore
+        # re-prefills from the tokens, so the cursor itself is
+        # informational (KV never survives a crash by design)
+        slots = []
+        for s in self._slots:
+            if s is None:
+                continue
+            d = _req(s.req,
+                     (s.resume or []) if s.prefilling else s.tokens,
+                     s.deadline_at)
+            d["chunk_filled"] = int(s.filled) if s.prefilling else None
+            slots.append(d)
         queue = [_req(r, r._resume_tokens or []) for r in self._queue]
         results = [{"request_id": res.request_id,
                     "prompt": [int(t) for t in res.prompt],
@@ -1642,6 +2102,8 @@ class ServingEngine:
                   "flight_dump_path": self.flight.auto_dump_path,
                   "max_queue": self.max_queue,
                   "shed_infeasible": self.shed_infeasible,
+                  "chunk_tokens": self.chunk_tokens,
+                  "decode_per_chunk": self.decode_per_chunk,
                   "sanitize": self._sanitize}
         fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
                        "dkv": self._dkv}

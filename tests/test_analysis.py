@@ -487,6 +487,60 @@ def test_join_leave_compile_set_is_exactly_prefill_shapes():
         assert c.count == 1, c.events
 
 
+def test_chunked_compile_set_is_exactly_chunk_buckets():
+    """Chunked prefill keeps the compile set small and EXACTLY pinned:
+    chunk programs are keyed by (kind, KV-cursor) buckets — the first
+    admission pays one program per chunk bucket plus the step program;
+    any prompt whose buckets are covered pays ZERO compiles; a longer
+    prompt pays exactly its NEW buckets. Steady-state chunked ticks
+    stay 0 H2D + 0 compiles under the same guards as the monolithic
+    engine (the sanitize=True invariant)."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(2)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=256, chunk_tokens=32,
+                               prefix_caching=False,
+                               sanitize=True) as eng:
+        # 70 tokens @ chunk 32 -> mid(0) + mid(32) + last(64), + step fn
+        eng.submit(serving.Request(rng.randint(3, 500, (70,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=60)
+        assert c.count == 4, c.events
+        # 80 and 90 tokens land in the SAME buckets: zero compiles
+        for n in (80, 90):
+            eng.submit(serving.Request(rng.randint(3, 500, (n,)),
+                                       max_new_tokens=4))
+            with rt.count_compiles() as c:
+                eng.drain(max_steps=60)
+            assert c.count == 0, (n, c.events)
+        # 100 tokens -> exactly the two new buckets: mid(64) + last(96)
+        eng.submit(serving.Request(rng.randint(3, 500, (100,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=60)
+        assert c.count == 2, c.events
+        # steady-state chunked decode ticks: 0 H2D + 0 compiles
+        eng.submit(serving.Request(rng.randint(3, 500, (40,)),
+                                   max_new_tokens=12))
+        eng.step()                  # admit + chunk 0
+        eng.step()                  # last chunk + adopt (dirty upload)
+        eng.step()                  # first steady re-dispatch
+        guarded = 0
+        while eng.active_slots and guarded < 6:
+            with rt.no_transfer(what="steady chunked tick"), \
+                    rt.count_compiles() as c:
+                eng.step()
+            assert c.count == 0
+            guarded += 1
+        assert guarded == 6
+        assert eng.stats["sanitized_steps"] >= guarded
+        eng.drain()
+
+
 @pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
 def test_warm_generate_zero_transfers_zero_recompiles(cache_dtype):
     """A warm ``generate`` with device-resident inputs re-dispatches

@@ -527,16 +527,19 @@ def test_serving_bench_smoke_emits_valid_schema():
     """`not slow` CI smoke: serving_bench in tiny-CPU mode must emit TWO
     schema-valid BENCH records — static first, then continuous carrying
     the A/B fields (speedup, occupancy, pad-waste, prefix-hit). The
-    >=1.5x speedup itself is a full-size claim (the default b=8 mixed-
-    length run documented in docs/SERVING.md), not asserted at this toy
-    scale where per-step dispatch overhead dominates."""
+    engine side runs CHUNKED (--chunk_tokens 16) so the not-slow lane
+    exercises the chunked-prefill scheduler end to end; the >=1.5x
+    speedup itself is a full-size claim (the default b=8 mixed-length
+    run documented in docs/SERVING.md), not asserted at this toy scale
+    where per-step dispatch overhead dominates."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", "serving_bench.py"),
          "--model", "llama-tiny", "--block_tokens", "16",
          "--requests", "6", "--slots", "2", "--min_prompt", "4",
          "--max_prompt", "12", "--min_new", "2", "--max_new", "8",
-         "--sys_prompt_len", "16", "--reps", "1"],
+         "--sys_prompt_len", "16", "--reps", "1",
+         "--chunk_tokens", "16"],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -557,3 +560,6 @@ def test_serving_bench_smoke_emits_valid_schema():
     assert cont["prefix_hit_rate"] > 0.5
     assert cont["prefill_tokens_reused"] > 0
     assert cont["ttft_p50_s"] > 0
+    # chunked engine side: every prefill ran through chunk programs
+    assert cont["chunk_tokens"] == 16
+    assert cont["prefill_chunks"] >= 1

@@ -1,0 +1,530 @@
+"""Chunked prefill (ServingEngine(chunk_tokens=...)).
+
+The contract under test: chunked prefill is a SCHEDULING change, not a
+numerics change — a request's tokens through the chunked engine are
+identical to an isolated ``generate`` call (greedy and sampled, bf16
+and int8 KV pools, prefix CoW hits, preempt-then-resume through
+chunks), while a long prompt's prefill never stalls active decode
+slots for more than the chunk budget. Plus the satellites: the
+per-token TTFT estimator split (no long-prompt flat-pricing bias),
+mid-prefill snapshot/restore losslessness (the chunk cursor rides the
+snapshot), and the chunk observability surface (flight fields,
+``serving.prefill_chunks``, chunk-stall auto-dump). The chunk-bucket
+compile-set pin lives in tests/test_analysis.py next to the other
+compile pins.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.inference import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def tiny_llama(L=2):
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=L,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return cfg, m
+
+
+def _isolated(m, prompts, max_new, **kw):
+    return [np.asarray(generate(m, p[None], max_new_tokens=mn, **kw))
+            [0, len(p):] for p, mn in zip(prompts, max_new)]
+
+
+# ------------------------------------------- chunked-vs-isolated parity
+
+def _run_parity(m, cache_dtype, temperature, chunk_tokens=32):
+    """Mixed-length prompts (several spanning multiple chunks) through
+    a chunked engine: every token matches isolated generate."""
+    kw = (dict(temperature=temperature, top_k=40, top_p=0.9)
+          if temperature else dict(temperature=0.0))
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(3, 512, (n,)) for n in (70, 19, 45)]
+    max_new = [6, 8, 5]
+    seeds = [101, 202, 303]
+    iso = [np.asarray(generate(m, p[None], max_new_tokens=mn,
+                               cache_dtype=cache_dtype,
+                               request_seeds=[s], **kw))[0, len(p):]
+           for p, mn, s in zip(prompts, max_new, seeds)]
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, cache_dtype=cache_dtype,
+                                chunk_tokens=chunk_tokens, **kw)
+    rids = [eng.submit(serving.Request(p, max_new_tokens=mn, seed=s))
+            for p, mn, s in zip(prompts, max_new, seeds)]
+    eng.drain(max_steps=400)
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    # the 70-token prompt really chunked (ceil(70/32) = 3 programs)
+    assert eng.stats["prefill_chunks"] >= 3 + 1 + 2
+    # retirement freed every slot-held block (prefix cache refs remain)
+    cache_held = (sum(1 for e in eng.prefix_cache._entries.values()
+                      if e.block_id is not None)
+                  if eng.prefix_cache is not None else 0)
+    assert eng.pool.used_blocks == cache_held
+    eng.close()
+
+
+def test_chunked_parity_bf16_greedy():
+    cfg, m = tiny_llama()
+    _run_parity(m, jnp.bfloat16, 0.0)
+
+
+def test_chunked_parity_int8_sampled():
+    cfg, m = tiny_llama()
+    _run_parity(m, jnp.int8, 0.8)
+
+
+@pytest.mark.slow
+def test_chunked_parity_bf16_sampled():
+    cfg, m = tiny_llama()
+    _run_parity(m, jnp.bfloat16, 0.8)
+
+
+@pytest.mark.slow
+def test_chunked_parity_int8_greedy():
+    cfg, m = tiny_llama()
+    _run_parity(m, jnp.int8, 0.0)
+
+
+@pytest.mark.slow
+def test_chunked_parity_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                    num_heads=2, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle_tpu.seed(0)
+    g = GPTPretrainModel(cfg)
+    g.eval()
+    rng = np.random.RandomState(22)
+    p = rng.randint(3, 256, (45,))
+    iso = _isolated(g, [p], [6], temperature=0.0)
+    eng = serving.ServingEngine(g, max_slots=2, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16)
+    rid = eng.submit(serving.Request(p, max_new_tokens=6))
+    eng.drain(max_steps=200)
+    assert eng.results[rid].tokens.tolist() == iso[0].tolist()
+    eng.close()
+
+
+def test_chunked_prefix_cow_parity():
+    """Prefix CoW through chunks: the CoW gather happens on chunk 0
+    only, the second request reuses the cached full blocks, tokens
+    match isolated generate and shared blocks are never written."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(23)
+    sys_p = rng.randint(3, 512, (40,))
+    pr_a = np.concatenate([sys_p, rng.randint(3, 512, (5,))])
+    pr_b = np.concatenate([sys_p, rng.randint(3, 512, (9,))])
+    iso = _isolated(m, [pr_a, pr_b], [8, 8], temperature=0.0)
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16)
+    ra = eng.submit(serving.Request(pr_a, max_new_tokens=8))
+    eng.drain()
+    hits = eng.prefix_cache.lookup(pr_b, len(pr_b) // 16, record=False)
+    assert len(hits) == 2
+    bids = [e.block_id for e in hits]
+    before = np.asarray(eng.kv_pool[:, bids].astype(jnp.float32))
+    rb = eng.submit(serving.Request(pr_b, max_new_tokens=8))
+    eng.drain()
+    after = np.asarray(eng.kv_pool[:, bids].astype(jnp.float32))
+    np.testing.assert_array_equal(before, after)    # copy-on-write held
+    assert eng.results[ra].tokens.tolist() == iso[0].tolist()
+    assert eng.results[rb].tokens.tolist() == iso[1].tolist()
+    assert eng.results[rb].prefix_hit_blocks == 2
+    assert eng.stats["prefill_tokens_reused"] == 32
+    eng.close()
+
+
+@pytest.mark.slow
+def test_chunked_prefix_int8_requantize_parity():
+    """int8 pool: a chunk-0 prefix hit rides the cache's host bf16
+    copies as the initial carry and is re-quantized with the adopting
+    request's own (deferred, last-chunk) scales — tokens still match
+    the isolated int8 generate."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(24)
+    sys_p = rng.randint(3, 512, (32,))
+    pr_a = np.concatenate([sys_p, rng.randint(3, 512, (7,))])
+    pr_b = np.concatenate([sys_p, rng.randint(3, 512, (11,))])
+    # long tail: the hit carry feeds a MID chunk before the last one
+    pr_c = np.concatenate([sys_p, rng.randint(3, 512, (20,))])
+    iso = _isolated(m, [pr_a, pr_b, pr_c], [6, 6, 6], temperature=0.0,
+                    cache_dtype=jnp.int8)
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, cache_dtype=jnp.int8,
+                                chunk_tokens=16)
+    rids = []
+    for p in (pr_a, pr_b, pr_c):
+        rids.append(eng.submit(serving.Request(p, max_new_tokens=6)))
+        eng.drain()
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    assert eng.results[rids[1]].prefix_hit_blocks == 2
+    assert eng.results[rids[2]].prefix_hit_blocks == 2
+    eng.close()
+
+
+# ----------------------------------------- preemption through the chunks
+
+def test_preempt_resume_through_chunks():
+    """A mid-DECODE victim's token-exact resume rides the chunk path:
+    re-prefill of prompt+generated runs chunk-by-chunk interleaved with
+    the preemptor's decode — the preemption blast radius the monolithic
+    wave could not bound."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(25)
+    lp = rng.randint(3, 512, (21,))
+    hp = rng.randint(3, 512, (9,))
+    iso_l = _isolated(m, [lp], [10], temperature=0.0)[0]
+    iso_h = _isolated(m, [hp], [4], temperature=0.0)[0]
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=64, chunk_tokens=16)
+    rl = eng.submit(serving.Request(lp, max_new_tokens=10, seed=101,
+                                    priority="low"))
+    for _ in range(5):
+        eng.step()
+    rh = eng.submit(serving.Request(hp, max_new_tokens=4, seed=202,
+                                    priority="high"))
+    eng.drain(max_steps=300)
+    assert eng.stats["preemptions"] == 1
+    assert eng.stats["requests_resumed"] == 1
+    assert eng.results[rl].tokens.tolist() == iso_l.tolist()
+    assert eng.results[rh].tokens.tolist() == iso_h.tolist()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_preempt_mid_prefill_parity():
+    """A victim preempted while still MID-CHUNK (no tokens sampled yet)
+    requeues with its admission-time resume state and re-prefills from
+    scratch — token-exact."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(26)
+    lp = rng.randint(3, 512, (60,))
+    hp = rng.randint(3, 512, (9,))
+    iso_l = _isolated(m, [lp], [4], temperature=0.0)[0]
+    iso_h = _isolated(m, [hp], [4], temperature=0.0)[0]
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16,
+                                prefix_caching=False)
+    rl = eng.submit(serving.Request(lp, max_new_tokens=4, priority="low"))
+    eng.step()          # one chunk in, still prefilling
+    assert eng._slots[0] is not None and eng._slots[0].prefilling
+    rh = eng.submit(serving.Request(hp, max_new_tokens=4,
+                                    priority="high"))
+    eng.drain(max_steps=300)
+    assert eng.stats["preemptions"] == 1
+    assert eng.results[rl].tokens.tolist() == iso_l.tolist()
+    assert eng.results[rh].tokens.tolist() == iso_h.tolist()
+    eng.close()
+
+
+# --------------------------------------------- decode-interleave liveness
+
+def test_decode_interleave_liveness():
+    """While a long prompt prefills chunk-by-chunk, an active decode
+    slot gains a token EVERY tick — prefill never starves decode for
+    more than the chunk budget (decode_per_chunk=1). The monolithic
+    engine would block every one of those ticks inside a single prefill
+    program. (A 10k-token prompt behaves identically — ticks scale as
+    ceil(prompt/chunk); the prompt here is sized for the CPU suite.)"""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(27)
+    short = rng.randint(3, 512, (9,))
+    long_p = rng.randint(3, 512, (400,))
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=448, chunk_tokens=16,
+                                prefix_caching=False)
+    rs = eng.submit(serving.Request(short, max_new_tokens=60))
+    eng.step()          # short occupies slot 0 and starts decoding
+    assert eng.active_slots == 1
+    rl = eng.submit(serving.Request(long_p, max_new_tokens=2))
+    eng.step()          # long admitted, chunk 0 runs
+    li = next(i for i, s in enumerate(eng._slots)
+              if s is not None and s.req.request_id == rl)
+    si = next(i for i, s in enumerate(eng._slots)
+              if s is not None and s.req.request_id == rs)
+    assert eng._slots[li].prefilling
+    prefill_ticks = 0
+    while eng._slots[li] is not None and eng._slots[li].prefilling:
+        c0 = eng._slots[si].count
+        eng.step()
+        prefill_ticks += 1
+        # the liveness bound: the decode slot advanced THIS tick too
+        assert eng._slots[si].count == c0 + 1, \
+            f"decode starved at prefill tick {prefill_ticks}"
+    # the long prompt genuinely took many interleaved chunk ticks
+    assert prefill_ticks >= 20
+    eng.drain(max_steps=400)
+    assert eng.results[rs].gen_len == 60
+    eng.close()
+
+
+def test_decode_per_chunk_budget_paces_chunks():
+    """decode_per_chunk=2: while decode-ready slots exist, chunks run
+    at most every other tick (each decode slot gets >= 2 tokens per
+    chunk)."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(28)
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=256, chunk_tokens=16,
+                                decode_per_chunk=2, prefix_caching=False)
+    rs = eng.submit(serving.Request(rng.randint(3, 512, (9,)),
+                                    max_new_tokens=40))
+    eng.step()
+    eng.submit(serving.Request(rng.randint(3, 512, (150,)),
+                               max_new_tokens=2))
+    chunk_ticks = []
+    for t in range(24):
+        eng.step()
+        chunk_ticks.append(len(eng._tick_chunks))
+        if eng.queued == 0 and all(
+                s is None or not s.prefilling for s in eng._slots):
+            break
+    ran = [n for n in chunk_ticks if n]
+    assert ran, "no chunks ran"
+    # no two consecutive chunk ticks while decode was active
+    for a, b in zip(chunk_ticks, chunk_ticks[1:]):
+        assert not (a and b), "chunks ran on consecutive ticks"
+    eng.drain(max_steps=400)
+    eng.close()
+
+
+# ------------------------------------------------- estimator token split
+
+def test_estimator_prices_prompt_tokens_not_flat_waves():
+    """The PR 8 estimator priced EVERY prompt one flat EWMA wave —
+    a 512-token prompt estimated the same TTFT as an 8-token one, so
+    deadline shedding over-shed short prompts queued behind long ones.
+    Split by tokens: the estimate must scale with the prompt length,
+    and queued-ahead long prompts must surface in a short prompt's
+    estimate (bimodal mix)."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(29)
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=1024, shed_infeasible=True)
+    # deterministic warm state (the unit under test is the formula)
+    eng._ewma_step.value = 0.01
+    eng._ewma_prefill_tok.value = 1e-3
+    short = serving.Request(rng.randint(3, 512, (8,)), max_new_tokens=4)
+    long_r = serving.Request(rng.randint(3, 512, (512,)),
+                             max_new_tokens=4)
+    est_short = eng.estimated_ttft_s(short)
+    est_long = eng.estimated_ttft_s(long_r)
+    assert est_short is not None and est_long is not None
+    # 512 prompt tokens vs 8: the estimate scales, not flat-priced
+    assert est_long > 10 * est_short
+    assert abs(est_long - est_short
+               - (512 - 8) * 1e-3) < 1e-6
+    # bimodal queue: a long prompt AHEAD of a short submit must push
+    # the short prompt's estimate up by the long prefill's token cost
+    eng.submit(serving.Request(rng.randint(3, 512, (512,)),
+                               max_new_tokens=4))
+    est_behind = eng.estimated_ttft_s(short)
+    assert est_behind >= est_short + 512 * 1e-3
+    eng.close()
+
+
+def test_short_last_chunk_does_not_inflate_token_ewma():
+    """The last chunk pads to the full chunk_tokens width — its wall
+    time must be sampled per COMPUTED token (t/CT), not per valid
+    token: a prompt of CT+1 tokens has a 1-valid-token last chunk, and
+    dividing by 1 would feed the per-token EWMA a ~CT-fold-inflated
+    sample, over-shedding feasible deadlines (the units must match
+    estimated_ttft_s's ceil(P/CT)*CT*tok_s pricing)."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(34)
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=32,
+                                prefix_caching=False)
+    p = rng.randint(3, 512, (33,))      # chunks: mid(0) + last ntok=1
+    eng.submit(serving.Request(p, max_new_tokens=2))
+    eng.drain(max_steps=60)             # cold: compiles, EWMAs skip
+    eng.submit(serving.Request(rng.randint(3, 512, (33,)),
+                               max_new_tokens=2))
+    eng.drain(max_steps=60)             # warm: EWMAs sample
+    tok, chunk = eng._ewma_prefill_tok.value, eng._ewma_chunk.value
+    assert tok is not None and chunk is not None
+    # a full chunk's worth of per-token cost stays commensurate with
+    # the chunk EWMA (t/1 sampling would blow this up ~32x)
+    assert tok * eng.chunk_tokens <= chunk * 4
+    eng.close()
+
+
+def test_estimator_chunked_prices_interleave():
+    """On a chunked engine the request's own prefill is priced as
+    ceil(prompt/chunk) full chunks plus the decode_per_chunk dispatches
+    interleaved between them."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(30)
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=1024, chunk_tokens=64,
+                                decode_per_chunk=2, shed_infeasible=True)
+    eng._ewma_step.value = 0.01
+    eng._ewma_prefill_tok.value = 1e-3
+    req = serving.Request(rng.randint(3, 512, (200,)), max_new_tokens=4)
+    est = eng.estimated_ttft_s(req)
+    n_chunks = -(-200 // 64)            # 4
+    expect = n_chunks * 64 * 1e-3 + (n_chunks - 1) * 2 * 0.01
+    assert est is not None and abs(est - expect) < 1e-6
+    eng.close()
+
+
+# ------------------------------------- snapshot: the chunk cursor rides
+
+def test_mid_prefill_snapshot_restore_lossless(tmp_path):
+    """An engine snapshotted while a slot is MID-CHUNK restores with
+    zero loss: the slot rides the snapshot as a resumable request (the
+    chunk cursor recorded), re-prefills chunked, and finishes with
+    tokens identical to an uninterrupted run — including a
+    preempted-then-resuming victim whose generated tokens must survive
+    the mid-re-prefill crash."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(31)
+    lp = rng.randint(3, 512, (60,))
+    hp = rng.randint(3, 512, (9,))
+    iso_l = _isolated(m, [lp], [6], temperature=0.0)[0]
+    iso_h = _isolated(m, [hp], [4], temperature=0.0)[0]
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16,
+                                prefix_caching=False)
+    rl = eng.submit(serving.Request(lp, max_new_tokens=6))
+    eng.step()
+    assert eng._slots[0].prefilling
+    snap = eng.snapshot()
+    assert snap["config"]["chunk_tokens"] == 16
+    assert snap["slots"][0]["chunk_filled"] == 16    # cursor recorded
+    root = str(tmp_path / "snap")
+    eng.save_snapshot(root)
+    eng.close()
+    eng2 = serving.ServingEngine.restore(m, root)
+    assert eng2.chunk_tokens == 16
+    eng2.drain(max_steps=200)
+    assert eng2.results[rl].tokens.tolist() == iso_l.tolist()
+    eng2.close()
+
+    # preempted victim, crash mid-RE-prefill: generated tokens survive
+    eng3 = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                 max_seq_len=128, chunk_tokens=16,
+                                 prefix_caching=False)
+    rv = eng3.submit(serving.Request(lp, max_new_tokens=6, seed=11,
+                                     priority="low"))
+    for _ in range(6):
+        eng3.step()             # victim decodes a few tokens
+    assert eng3._slots[0] is not None and not eng3._slots[0].prefilling
+    rh = eng3.submit(serving.Request(hp, max_new_tokens=4, seed=12,
+                                     priority="high"))
+    # step until the VICTIM is mid-re-prefill (prefilling with resume
+    # tokens) — the state whose loss the snapshot must prevent
+    for _ in range(60):
+        eng3.step()
+        s0 = eng3._slots[0]
+        if s0 is not None and s0.prefilling and s0.resume:
+            break
+    else:
+        raise AssertionError("victim never re-prefilled chunked")
+    assert eng3.stats["preemptions"] == 1
+    root3 = str(tmp_path / "snap3")
+    eng3.save_snapshot(root3)
+    eng3.close()
+    eng4 = serving.ServingEngine.restore(m, root3)
+    eng4.drain(max_steps=300)
+    iso_v = np.asarray(generate(m, lp[None], max_new_tokens=6,
+                                request_seeds=[11],
+                                temperature=0.0))[0, len(lp):]
+    iso_h2 = np.asarray(generate(m, hp[None], max_new_tokens=4,
+                                 request_seeds=[12],
+                                 temperature=0.0))[0, len(hp):]
+    assert eng4.results[rv].tokens.tolist() == iso_v.tolist()
+    assert eng4.results[rh].tokens.tolist() == iso_h2.tolist()
+    eng4.close()
+
+
+# --------------------------------------------- observability satellites
+
+def test_chunk_flight_fields_and_metrics(tmp_path):
+    """Flight events carry chunk_tokens/prefill_chunks/chunks, the
+    serving.prefill_chunks counter and chunk-size histogram observe
+    every chunk, and a chunk overrunning 4x the EWMA chunk time
+    auto-dumps the ring with reason chunk_stall."""
+    from paddle_tpu.observability import registry
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(32)
+    dump = str(tmp_path / "flight.jsonl")
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16,
+                                prefix_caching=False,
+                                flight_dump_path=dump)
+    before = registry().counter_total("serving.prefill_chunks")
+    rid = eng.submit(serving.Request(rng.randint(3, 512, (40,)),
+                                     max_new_tokens=3))
+    eng.step()
+    evt = eng.flight.events()[-1]
+    assert evt["chunk_tokens"] == 16
+    assert evt["prefill_chunks"] == 1
+    assert evt["chunks"] == [[rid, 0, 16]]
+    eng.drain(max_steps=100)        # 40 tokens -> 3 chunk programs
+    assert eng.stats["prefill_chunks"] == 3
+    # a SECOND same-shape request runs warm chunk programs (cold
+    # compiles are excluded from the EWMAs) — warm the chunk EWMA,
+    # then shrink it so the next chunk reads as a 4x overrun
+    eng.submit(serving.Request(rng.randint(3, 512, (40,)),
+                               max_new_tokens=3))
+    eng.step()
+    assert eng._ewma_chunk.value is not None
+    eng._ewma_chunk.value = 1e-9
+    eng.step()                      # this chunk overruns 4x the EWMA
+    eng.drain(max_steps=100)
+    assert eng.stats["prefill_chunks"] == 6
+    assert registry().counter_total("serving.prefill_chunks") \
+        == before + 6
+    assert eng._ewma_prefill_tok.value is not None
+    assert os.path.isfile(dump)
+    with open(dump) as f:
+        headers = [json.loads(ln) for ln in f
+                   if '"flight_dump"' in ln]
+    assert any(h["reason"] == "chunk_stall" for h in headers)
+    eng.close()
+
+
+def test_chunk_tokens_validation():
+    cfg, m = tiny_llama()
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        serving.ServingEngine(m, block_tokens=32, chunk_tokens=48)
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        serving.ServingEngine(m, block_tokens=32, chunk_tokens=16)
+    with pytest.raises(ValueError, match="decode_per_chunk"):
+        serving.ServingEngine(m, block_tokens=16, chunk_tokens=16,
+                              decode_per_chunk=0)
+
+
+def test_deadline_sweeps_mid_prefill_slot():
+    """A chunked slot whose deadline expires before its last chunk
+    retires cleanly mid-prefill: empty tokens, finish='deadline',
+    blocks freed, no crash on the unset first-token timestamp."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(33)
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16,
+                                prefix_caching=False)
+    rid = eng.submit(serving.Request(rng.randint(3, 512, (60,)),
+                                     max_new_tokens=4, deadline_s=1e-9))
+    eng.step()                  # admitted; deadline already expired
+    eng.drain(max_steps=50)
+    res = eng.results[rid]
+    assert res.finish == "deadline"
+    assert res.tokens.tolist() == [] and res.ttft_s is None
+    assert eng.pool.used_blocks == 0
+    eng.close()

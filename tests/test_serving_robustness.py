@@ -457,6 +457,9 @@ def test_chaos_bench_smoke_zero_loss(tmp_path):
         [sys.executable, os.path.join(root, "examples", "chaos_bench.py"),
          "--requests", "20", "--fault_every", "12", "--max_faults", "2",
          "--min_new", "3", "--max_new", "8",
+         # chunked engine: the zero-loss exit contract also covers
+         # crashes landing mid-prefill (chunk cursor in the snapshot)
+         "--chunk_tokens", "16",
          "--snapshot_dir", str(tmp_path / "snap"),
          "--flight_dump", str(tmp_path / "flight.jsonl")],
         capture_output=True, text=True, timeout=480, env=env, cwd=root)

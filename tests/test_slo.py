@@ -155,6 +155,19 @@ def test_slo_report_goodput_token_weighted():
     rep2.add(0.2, 0.05)
     f2 = rep2.bench_fields()
     assert "goodput" not in f2 and f2["ttft_p50_s"] > 0
+    # ttft_s=None (a request that died before its first token, e.g. a
+    # chunked-engine deadline sweep mid-prefill): no crash, excluded
+    # from the TTFT percentiles, but a TTFT-SLO miss — it must drag
+    # goodput down, not vanish from it
+    rep3 = obs.SLOReport(ttft_slo_s=0.5)
+    assert rep3.add(0.1, None, tokens=1) is True
+    assert rep3.add(None, None, tokens=1) is False
+    assert rep3.goodput == pytest.approx(0.5)
+    assert rep3.bench_fields()["ttft_p99_s"] == pytest.approx(0.1,
+                                                              rel=0.02)
+    # without a TTFT target a None ttft cannot miss anything
+    rep4 = obs.SLOReport(tpot_slo_s=0.1)
+    assert rep4.add(None, 0.01) is True
 
 
 def test_bench_schema_percentile_fields():
@@ -383,6 +396,10 @@ def test_load_bench_smoke_emits_slo_percentiles():
          "12", "--min_new", "2", "--max_new", "6", "--loads", "0.5,2.0",
          "--slo_ttft_s", "30", "--slo_tpot_s", "30",
          "--shed", "--max_queue", "8",
+         # chunked engine + bimodal prompt mix: the chunked-prefill
+         # A/B surface (chunk_tokens/prefill_chunks record fields)
+         "--chunk_tokens", "16", "--prompt_mix", "long",
+         "--long_prompt", "40", "--long_frac", "0.4",
          "--priority_mix", "low:1,normal:2,high:1"],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
@@ -406,6 +423,11 @@ def test_load_bench_smoke_emits_slo_percentiles():
         # the contract, schema-validated above)
         assert 0.0 <= rec["shed_rate"] <= 1.0
         assert rec["preemptions"] >= 0
+        # chunked-prefill fields: the engine ran chunked and the
+        # 40-token long prompts took >= 3 chunk programs each
+        assert rec["chunk_tokens"] == 16
+        assert rec["prefill_chunks"] >= 1
+        assert rec["prompt_mix"] == "long"
     assert recs[0]["offered_rps"] < recs[1]["offered_rps"]
     knee = recs[2]
     assert knee["unit"] == "req/s" and len(knee["curve"]) == 2

@@ -963,3 +963,33 @@ def test_serving_gpt_paged_on_tpu():
     eng.drain(max_steps=50)
     for rid, ref in zip(rids, iso):
         assert eng.results[rid].tokens.tolist() == ref.tolist()
+
+
+@pytest.mark.parametrize("cache_dtype", [jnp.bfloat16, jnp.int8])
+def test_serving_chunked_prefill_on_tpu(cache_dtype):
+    """On-chip twin of tests/test_serving_chunked.py: chunked prefill
+    (chunk programs appending block-aligned KV into the pool the real
+    paged kernel then walks) must be token-identical to isolated
+    generate — bf16 appends per chunk, int8 defers calibration+
+    quantization to the last chunk. On TPU the chunk programs alias
+    the donated pool (no CPU copy-per-chunk caveat — BENCH_r06)."""
+    from paddle_tpu import serving
+    from paddle_tpu.inference import generate
+
+    m = _serving_llama()
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(3, 512, (n,)) for n in (40, 21, 9)]
+    max_new = [6, 6, 8]
+    iso = [np.asarray(generate(m, p[None], max_new_tokens=mn,
+                               temperature=0.0, cache_dtype=cache_dtype))
+           [0, len(p):] for p, mn in zip(prompts, max_new)]
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=64, cache_dtype=cache_dtype,
+                                chunk_tokens=16)
+    rids = [eng.submit(serving.Request(p, max_new_tokens=mn))
+            for p, mn in zip(prompts, max_new)]
+    eng.drain(max_steps=200)
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    assert eng.stats["prefill_chunks"] >= 3 + 2 + 1
+    eng.close()
