@@ -48,10 +48,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from load_bench import calibrate, gen_arrivals, make_requests
-from serving_bench import build_model
+from serving_bench import build_model, build_speculate
 
 
-def build_engine(model, ns, flight_dump):
+def build_engine(model, ns, flight_dump, speculate=None):
     from paddle_tpu import serving
 
     return serving.ServingEngine(
@@ -60,10 +60,12 @@ def build_engine(model, ns, flight_dump):
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         flight_dump_path=flight_dump,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
+        speculate=speculate,
         max_queue=ns.max_queue, shed_infeasible=True)
 
 
-def drive_chaos(model, eng, ns, reqs, arrivals, snap_root):
+def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
+                speculate=None):
     """Open-loop drive with crash/restore: any exception out of
     ``step()`` (an injected fault, a simulated device OOM) snapshots
     the engine through the integrity-manifest path, closes it, and
@@ -98,7 +100,11 @@ def drive_chaos(model, eng, ns, reqs, arrivals, snap_root):
                   file=sys.stderr)
             eng.save_snapshot(snap_root)
             eng.close()
-            eng = type(eng).restore(model, snap_root)
+            # the draft proposer's model doesn't serialize — hand the
+            # SAME SpecConfig back as a restore override (a no-op for
+            # ngram/None, which restore rebuilds from the snapshot)
+            ovr = {"speculate": speculate} if speculate is not None else {}
+            eng = type(eng).restore(model, snap_root, **ovr)
             restores += 1
     return eng, accepted, rejected, restores, time.perf_counter() - t0
 
@@ -134,6 +140,15 @@ def main():
                     "also covers crashes landing MID-PREFILL — a "
                     "chunked slot snapshots as a resumable request "
                     "with its chunk cursor and re-prefills losslessly")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="arm speculative decoding (k proposals per "
+                    "slot per tick): the zero-loss + token-parity exit "
+                    "contract then also covers crashes landing on a "
+                    "speculative tick (accepted tokens survive, "
+                    "in-flight speculation is recomputed)")
+    ap.add_argument("--proposer", choices=("ngram", "draft"),
+                    default="ngram")
+    ap.add_argument("--draft_model", default="llama-tiny")
     ap.add_argument("--verify", type=int, default=3,
                     help="completed requests spot-checked token-exact "
                     "against isolated generate (greedy only)")
@@ -163,7 +178,8 @@ def main():
         if rng.rand() >= ns.deadline_frac:
             r["deadline"] = None
 
-    eng = build_engine(model, ns, flight_dump)
+    speculate = build_speculate(ns)
+    eng = build_engine(model, ns, flight_dump, speculate)
     # calibration runs unshedded (the saturated closed-loop warmup
     # would shed itself against the bounded queue)
     eng.shed_infeasible = False
@@ -191,7 +207,7 @@ def main():
                             rng)
     try:
         eng, accepted, rejected, restores, wall = drive_chaos(
-            model, eng, ns, reqs, arrivals, snap_root)
+            model, eng, ns, reqs, arrivals, snap_root, speculate)
     finally:
         faults.disarm()
 

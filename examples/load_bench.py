@@ -47,6 +47,15 @@ engine bounds the stall at one chunk — run both arms on the same box
 with the same seed and compare ``tpot_p99_s``/goodput per point
 (records carry ``chunk_tokens``/``prefill_chunks``).
 
+``--speculate k`` + ``--prompt_mix repeat`` is the speculative A/B
+(docs/SERVING.md §Speculative decoding; BENCH_r07): motif-tiled
+prompts make the n-gram proposer fire, and each record carries
+``acceptance_rate``/``accepted_len_hist``/``dispatches_per_token`` —
+the CPU gate is fused dispatches per committed token (CPU wall time
+is compute-bound and pays the verify tail's extra matmuls; the TPU
+kernel streams weights once per tail, so dispatches/token is the
+proxy for the on-chip speedup).
+
 Prefix caching is off here (random prompts never share blocks) and
 prompt lengths quantize to few pad shapes, keeping prefill compile
 churn out of the measured tails; the first sweep point still pays any
@@ -66,7 +75,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serving_bench import build_model
+from serving_bench import (build_model, build_speculate, spec_fields,
+                           spec_hist_base)
 
 
 def parse_priority_mix(spec):
@@ -95,7 +105,14 @@ def make_requests(ns, rng):
     active decode slot (the chunked-prefill A/B; docs/SERVING.md
     §Chunked prefill)."""
     mix = parse_priority_mix(getattr(ns, "priority_mix", None))
-    long_mix = getattr(ns, "prompt_mix", "uniform") == "long"
+    pmix = getattr(ns, "prompt_mix", "uniform")
+    long_mix = pmix == "long"
+    # 'repeat': each prompt tiles a short per-request motif — the
+    # extraction/quoting-style repetitive regime where the n-gram
+    # proposer's suffix match actually fires (the speculative A/B mix;
+    # greedy decode of a repetitive prompt also cycles, which
+    # self-speculation exploits)
+    repeat_mix = pmix == "repeat"
     reqs = []
     for _ in range(ns.requests):
         if long_mix and rng.random_sample() < ns.long_frac:
@@ -105,7 +122,12 @@ def make_requests(ns, rng):
         budget = int(rng.randint(ns.min_new, ns.max_new + 1))
         prio = (mix[0][int(rng.choice(len(mix[0]), p=mix[1]))]
                 if mix else "normal")
-        reqs.append(dict(prompt=rng.randint(3, ns.vocab, (plen,)),
+        if repeat_mix:
+            motif = rng.randint(3, ns.vocab, (max(2, plen // 4),))
+            prompt = np.tile(motif, -(-plen // len(motif)))[:plen]
+        else:
+            prompt = rng.randint(3, ns.vocab, (plen,))
+        reqs.append(dict(prompt=prompt,
                          budget=budget, priority=prio,
                          deadline=getattr(ns, "deadline_s", None)))
     return reqs
@@ -208,12 +230,15 @@ def main():
     ap.add_argument("--max_prompt", type=int, default=24)
     ap.add_argument("--min_new", type=int, default=8)
     ap.add_argument("--max_new", type=int, default=32)
-    ap.add_argument("--prompt_mix", choices=("uniform", "long"),
+    ap.add_argument("--prompt_mix", choices=("uniform", "long", "repeat"),
                     default="uniform",
                     help="'long' = bimodal prompt lengths: --long_frac "
                     "of requests carry a --long_prompt-token prompt "
                     "(the prefill head-of-line-blocking regime the "
-                    "chunked-prefill A/B measures)")
+                    "chunked-prefill A/B measures); 'repeat' = "
+                    "motif-tiled repetitive prompts (the regime the "
+                    "speculative n-gram proposer accelerates — the "
+                    "--speculate A/B mix)")
     ap.add_argument("--long_prompt", type=int, default=256,
                     help="long-prompt length for --prompt_mix long")
     ap.add_argument("--long_frac", type=float, default=0.25,
@@ -261,6 +286,17 @@ def main():
                          "engine steps must perform 0 H2D transfers "
                          "and 0 recompiles or the bench dies "
                          "(paddle_tpu.analysis.runtime)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="arm speculative decoding with k proposals "
+                    "per slot per tick (0 = off) — pair with "
+                    "--prompt_mix repeat for the goodput A/B; records "
+                    "grow acceptance_rate/accepted_len_hist/"
+                    "dispatches_per_token")
+    ap.add_argument("--proposer", choices=("ngram", "draft"),
+                    default="ngram",
+                    help="speculative proposer (see serving_bench)")
+    ap.add_argument("--draft_model", default="llama-tiny",
+                    help="draft model name for --proposer draft")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -287,6 +323,7 @@ def main():
         prefix_caching=False, flight_dump_path=ns.flight_dump,
         chunk_tokens=ns.chunk_tokens,
         decode_per_chunk=ns.decode_per_chunk,
+        speculate=build_speculate(ns),
         sanitize=ns.sanitize)
 
     rng = np.random.RandomState(ns.seed)
@@ -311,6 +348,10 @@ def main():
                                 ns.burst_on_s, ns.burst_off_s)
         eng.reset_stats()
         eng.results.clear()
+        # accepted-length histogram base: the registry histogram is
+        # process-global, so each point's record diffs against this
+        # snapshot (calibration + earlier points must not leak in)
+        hist_base = spec_hist_base(ns)
         wall, rejected = drive_open_loop(eng, reqs, arrivals)
         rep = obs.SLOReport(ns.slo_ttft_s, ns.slo_tpot_s)
         served = 0
@@ -337,7 +378,12 @@ def main():
             prompt_mix=ns.prompt_mix,
             chunk_tokens=ns.chunk_tokens,
             prefill_chunks=st["prefill_chunks"],
-            **rep.bench_fields())
+            # the speculative perf gate's metric: fused dispatches a
+            # slot pays per committed token (1.0 without speculation)
+            dispatches_per_token=round(
+                st["decode_slot_dispatches"]
+                / max(st["decode_tokens"], 1), 4),
+            **spec_fields(eng, ns, hist_base), **rep.bench_fields())
         print(json.dumps(rec))
         curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),
                           tokens_per_s=round(tok_s, 1),

@@ -154,6 +154,57 @@ def run_static(model, state, reqs, slots, cache_dtype=jnp.bfloat16):
 
 # ------------------------------------------------------------ continuous A/B
 
+def build_speculate(ns):
+    """SpecConfig from the bench flags (None when --speculate 0). The
+    draft proposer drafts with --draft_model (llama-tiny by default —
+    the tiny-drafts-for-medium pairing the ROADMAP names)."""
+    from paddle_tpu import serving
+
+    k = getattr(ns, "speculate", 0)
+    if not k:
+        return None
+    proposer = getattr(ns, "proposer", "ngram")
+    draft = None
+    if proposer == "draft":
+        _, draft = build_model(getattr(ns, "draft_model", "llama-tiny"))
+    return serving.SpecConfig(k=k, proposer=proposer, draft_model=draft)
+
+
+def spec_hist_base(ns):
+    """Snapshot of the serving.spec_accepted_len bucket counts, taken
+    BEFORE a measured pass so ``spec_fields(hist_base=...)`` can report
+    the pass's own distribution — the registry histogram is
+    process-global and would otherwise accumulate calibration passes
+    and earlier sweep points into every record."""
+    if not getattr(ns, "speculate", 0):
+        return None
+    from paddle_tpu.observability import registry
+    return list(registry().histogram("serving.spec_accepted_len").counts)
+
+
+def spec_fields(eng, ns, hist_base=None):
+    """Typed-optional speculative BENCH fields (schema.py): cumulative
+    acceptance over the measured pass + the accepted-length histogram
+    (diffed against a ``spec_hist_base`` pre-pass snapshot when
+    given)."""
+    if not getattr(ns, "speculate", 0):
+        return {}
+    from paddle_tpu.observability import registry
+    st = eng.stats
+    h = registry().histogram("serving.spec_accepted_len")
+    counts = list(h.counts)
+    if hist_base is not None:
+        counts = [c - b for c, b in zip(counts, hist_base)]
+    hist = {str(int(b)): c for b, c in zip(h.bounds, counts)}
+    hist["+Inf"] = counts[-1]
+    rate = (st["spec_accepted"] / st["spec_proposed"]
+            if st["spec_proposed"] else 0.0)
+    return dict(speculate_k=ns.speculate,
+                proposer=getattr(ns, "proposer", "ngram"),
+                acceptance_rate=round(rate, 4),
+                accepted_len_hist=hist)
+
+
 def run_continuous(model, reqs, ns):
     """Drive a ServingEngine: virtual clock in decode steps — request i
     joins the queue once ``arrival_step`` steps have run. Returns
@@ -165,6 +216,7 @@ def run_continuous(model, reqs, ns):
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
+        speculate=build_speculate(ns),
         sanitize=getattr(ns, "sanitize", False))
     return drive(eng, reqs), eng
 
@@ -236,6 +288,16 @@ def main():
                          "engine steps must perform 0 H2D transfers "
                          "and 0 recompiles or the bench dies "
                          "(paddle_tpu.analysis.runtime)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="arm speculative decoding with k proposals "
+                    "per slot per tick (0 = off); the continuous "
+                    "record grows acceptance_rate/accepted_len_hist")
+    ap.add_argument("--proposer", choices=("ngram", "draft"),
+                    default="ngram",
+                    help="speculative proposer: device n-gram suffix "
+                    "match (no extra model) or a draft model")
+    ap.add_argument("--draft_model", default="llama-tiny",
+                    help="draft model name for --proposer draft")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -336,7 +398,8 @@ def main():
         chunk_tokens=ns.chunk_tokens,
         prefill_chunks=st["prefill_chunks"],
         pool_blocks=eng.pool.num_blocks - 1,
-        block_tokens=ns.block_tokens, **slo.bench_fields(), **common)))
+        block_tokens=ns.block_tokens, **spec_fields(eng, ns),
+        **slo.bench_fields(), **common)))
     eng.close()         # free the KV pool (back-to-back bench runs)
 
 

@@ -71,6 +71,15 @@ _BENCH_OPTIONAL = {
     # pass
     "chunk_tokens": numbers.Integral,
     "prefill_chunks": numbers.Integral,
+    # speculative-decoding fields (--speculate k / --proposer):
+    # speculate_k = proposals verified per slot per tick (null = off),
+    # acceptance_rate = accepted / proposed over the measured pass,
+    # accepted_len_hist = {accepted-length: slot-tick count} from the
+    # serving.spec_accepted_len histogram buckets
+    "speculate_k": numbers.Integral,
+    "proposer": str,
+    "acceptance_rate": numbers.Real,
+    "accepted_len_hist": dict,
 }
 
 
@@ -96,7 +105,7 @@ def validate_bench(rec: Dict) -> Dict:
             problems.append(
                 f"field {field!r} must be {getattr(typ, '__name__', typ)} "
                 f"or null, got {type(v).__name__}")
-    for frac in ("goodput", "shed_rate"):
+    for frac in ("goodput", "shed_rate", "acceptance_rate"):
         g = rec.get(frac)
         if isinstance(g, numbers.Real) and not isinstance(g, bool) \
                 and not 0.0 <= g <= 1.0:

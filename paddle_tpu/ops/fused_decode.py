@@ -2238,3 +2238,678 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
             x, params, kv_pool, block_tables, positions, cos, sin,
             num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
             arch=arch, kv_scales=kv_scales)
+
+
+# ---------------------------------------------------------------------------
+# Paged verify (speculative decoding): score a k-token tail per slot
+# ---------------------------------------------------------------------------
+#
+# Speculative decoding turns k proposed tokens per slot into ONE scoring
+# dispatch instead of k serial decode dispatches: the verify pass runs
+# the whole stack over the tail [t0, p1..pk] (t0 = the slot's last
+# sampled token, p* the proposals), appends every tail token's KV
+# through the PR 10 multi-token append path, and returns the k+1 hidden
+# states the engine samples the target tokens from. Decode is
+# bandwidth-bound, so weights streamed once per k+1 tokens instead of
+# once per token is the whole win (ROADMAP "Speculative decoding on the
+# paged engine").
+#
+# Rejected-token KV is handled by POSITION, not by rollback: a slot's
+# attention always masks to its own append position, and future appends
+# overwrite stale entries in place — accepting a tokens is just
+# "advance the position by a+1".
+
+
+def fused_paged_verify_reference(x, params, kv_pool, block_tables,
+                                 positions, cos, sin, *, num_heads: int,
+                                 num_kv_heads: int, eps: float = 1e-5,
+                                 arch: str = "llama", kv_scales=None):
+    """Score a K1-token tail per slot against the paged pool; pure jnp.
+
+    x (b, K1, h): the embedded tail tokens — x[:, j] is token j embedded
+    at position ``positions + j``; cos/sin (b, K1, hd) are the matching
+    rope rows. kv_pool/block_tables/positions as in
+    `fused_paged_decode_reference` (``positions`` is each slot's append
+    position for tail token 0). Returns (x_out (b, K1, h), kv_pool) with
+    every tail token's KV appended at positions [pos, pos+K1).
+
+    Bit-identity contract (the speculative-vs-sequential parity pin,
+    tests/test_serving_spec.py): tail token j's computation is the SAME
+    per-token math as `fused_paged_decode_reference` — one (b, h) row
+    per step, same einsums, same masks, same cast points — run K1 times
+    over per-layer gathered views that carry each token's append
+    forward (injection produces the exact values a scatter-then-regather
+    would). A verify pass over an all-accepted tail therefore produces
+    bitwise the logits K1 sequential decode steps would.
+
+    Appends whose position falls outside the slot's table range (the
+    over-speculation tail of a slot near its cap) are redirected to the
+    scratch block (block 0) — garbage by contract, never attended (a
+    query's mask never reaches past its own position).
+    """
+    L, NB, BT, dkv2 = kv_pool.shape
+    b, MB = block_tables.shape
+    K1 = x.shape[1]
+    S = MB * BT
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = dkv // nkv
+    rep = nh // nkv
+    dq = nh * hd
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+    int8 = "wqkv_s" in params
+    gpt = arch == "gpt"
+    if arch not in ("llama", "gpt"):
+        raise NotImplementedError(
+            f"paged verify supports arch llama/gpt, got {arch!r}")
+    rows = jnp.arange(b)
+
+    def wdot(act, key, l):
+        w = params[key][l]
+        if int8:
+            y = jnp.dot(act, w.astype(act.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * params[f"{key}_s"][l]
+        return jnp.dot(act, w, preferred_element_type=jnp.float32)
+
+    # per-layer gathered views, carried across the tail tokens so token
+    # j+1 sees token j's append without a per-token pool scatter (the
+    # jax-0.4 CPU donation caveat: each pool scatter is a full copy —
+    # one combined scatter at the end, like the decode reference)
+    views = [kv_pool[l][block_tables].reshape(b, S, dkv2)
+             for l in range(L)]
+    app_news = []                   # per-token (L, b, dkv2) appends
+    outs = []
+    for j in range(K1):
+        posj = positions + j
+        cos_b = cos[:, j].reshape(b, 1, hd).astype(jnp.float32)
+        sin_b = sin[:, j].reshape(b, 1, hd).astype(jnp.float32)
+        xf = x[:, j].astype(jnp.float32)
+        kv_news = []
+        for l in range(L):
+            if gpt:
+                xn = _layernorm(xf, params["ln1"][l], params["ln1_b"][l],
+                                eps)
+            else:
+                xn = _rms(xf, params["ln1"][l], eps)
+            qkv = wdot(xn, "wqkv", l)
+            if gpt:
+                qkv = qkv + params["bqkv"][l]
+            q = qkv[:, :dq].reshape(b, nh, hd)
+            k = qkv[:, dq:dq + nkv * hd].reshape(b, nkv, hd)
+            v = qkv[:, dq + nkv * hd:].reshape(b, nkv, hd)
+            if not gpt:
+                q = _rope1(q, cos_b, sin_b)
+                k = _rope1(k, cos_b, sin_b)
+            kv_new = jnp.concatenate(
+                [k.reshape(b, dkv), v.reshape(b, dkv)], axis=-1)
+            if kv_scales is not None:   # int8 pool: per-slot scales
+                kv_new = jnp.clip(
+                    jnp.round(kv_new.astype(jnp.float32) / kv_scales[l]),
+                    -127, 127)
+            kv_new = kv_new.astype(kv_pool.dtype)
+            kv_news.append(kv_new)
+            # inject this token's append into the carried view; an
+            # out-of-range position (over-speculation past the cap) is
+            # dropped — its pool write goes to scratch below
+            kvl = views[l].at[rows, posj].set(kv_new, mode="drop")
+            views[l] = kvl
+            kl = kvl[:, :, :dkv].astype(jnp.float32)
+            vl = kvl[:, :, dkv:].astype(jnp.float32)
+            if kv_scales is not None:
+                kl = kl * kv_scales[l][:, None, :dkv]
+                vl = vl * kv_scales[l][:, None, dkv:]
+            kl = kl.reshape(b, S, nkv, hd)
+            vl = vl.reshape(b, S, nkv, hd)
+            qg = q.reshape(b, nkv, rep, hd) * scale
+            scores = jnp.einsum("bgrd,bsgd->bgrs", qg, kl)
+            valid = (jnp.arange(S)[None, None, None]
+                     <= posj[:, None, None, None])
+            scores = jnp.where(valid, scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
+            attn = attn.reshape(b, dq).astype(dtype)
+            o = wdot(attn, "wo", l)
+            if gpt:
+                o = o + params["bo"][l]
+            xf = xf + o
+            if gpt:
+                xn2 = _layernorm(xf, params["ln2"][l], params["ln2_b"][l],
+                                 eps)
+                g = wdot(xn2, "wg", l) + params["bg"][l]
+                act = jax.nn.gelu(g, approximate=True).astype(dtype)
+                xf = xf + wdot(act, "wd", l) + params["bd"][l]
+            else:
+                xn2 = _rms(xf, params["ln2"][l], eps)
+                g = wdot(xn2, "wg", l)
+                u = wdot(xn2, "wu", l)
+                act = (jax.nn.silu(g) * u).astype(dtype)
+                xf = xf + wdot(act, "wd", l)
+        outs.append(xf.astype(dtype))
+        app_news.append(jnp.stack(kv_news))         # (L, b, dkv2)
+    # ONE combined scatter of every (layer, token) append; positions
+    # past the table range land in the scratch block
+    posm = positions[:, None] + jnp.arange(K1)[None]        # (b, K1)
+    cm = posm // BT
+    bid = jnp.take_along_axis(block_tables,
+                              jnp.minimum(cm, MB - 1), axis=1)
+    bid = jnp.where(cm < MB, bid, 0)                # 0 = scratch block
+    off = posm % BT
+    vals = jnp.stack(app_news, axis=2)              # (L, b, K1, dkv2)
+    kv_pool = kv_pool.at[:, bid, off].set(vals)
+    return jnp.stack(outs, axis=1), kv_pool
+
+
+def _fused_paged_verify_pallas(x, params, kv_pool, block_tables,
+                               positions, *, num_heads: int,
+                               num_kv_heads: int, head_dim: int,
+                               rope_base: float = 10000.0,
+                               eps: float = 1e-5, arch: str = "llama",
+                               blocks: Optional[Dict] = None,
+                               kv_scales=None, interpret: bool = False):
+    """Paged verify kernel: `_fused_paged_decode_pallas` with the
+    single-token RMW append widened to a K1-token causal tail.
+
+    x arrives TOKEN-MAJOR flat (K1*b, h) — token j's rows are the
+    contiguous slice [j*b, (j+1)*b) so every per-token stage is a
+    static slice (Mosaic cannot stride sublanes). Per layer:
+
+    * the qkv pass runs ONE matmul over all K1*b rows; tail token j's
+      heads are staged block-diagonally into q rows [j*nh, (j+1)*nh)
+      of a (b, K1*nh, dkv) staging, so the prefix chunk walk scores
+      ALL tail queries with one dot_general per KV block (every tail
+      query attends the whole committed prefix — one shared walk);
+    * the append window [pos//8*8, pos+K1) replaces the 8-token RMW
+      block: NW 8-aligned segments per row, each resolved through the
+      block table independently (BT % 8 == 0 means an 8-aligned
+      segment never straddles a physical block; segments past the
+      table range redirect to the scratch block). Tail k/v merge at
+      offsets off+j, the window is attended with PER-QUERY causal
+      limits (query j masks to pos+j), and the segments write back —
+      the multi-token append path;
+    * the o-proj/FFN run per tail token over the same static slices.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, NB, BT, dkv2 = kv_pool.shape
+    b, MB = block_tables.shape
+    K1b = x.shape[0]
+    K1 = K1b // b
+    assert K1 * b == K1b, (x.shape, b)
+    dkv = dkv2 // 2
+    nh = num_heads
+    nkv = num_kv_heads
+    hd = head_dim
+    assert hd == dkv // nkv
+    rep = nh // nkv
+    h = x.shape[1]
+    dq = nh * hd
+    dqkv = dq + 2 * dkv
+    ffn = params["wg"].shape[2]
+    int8 = "wqkv_s" in params
+    kvq = kv_scales is not None
+    assert kvq == (jnp.dtype(kv_pool.dtype) == jnp.int8), \
+        "int8 KV pool needs kv_scales (and vice versa)"
+    gpt = arch == "gpt"
+    wbytes = 1 if int8 else 2
+    cb = jnp.dtype(kv_pool.dtype).itemsize
+    ck = BT
+    assert BT % 8 == 0, f"block_tokens {BT} must be a multiple of 8"
+    assert dkv % 128 == 0, f"nkv*hd={dkv} must be a lane multiple of 128"
+    # append-window segments: off <= 7 plus K1 tail tokens, 8-aligned
+    NW = (7 + K1 + 7) // 8
+    if blocks is not None:
+        assert blocks.get("cache_wbytes", cb) == cb, \
+            (f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+             f"cache but the pool dtype is {kv_pool.dtype} ({cb} B)")
+        if blocks.get("q_split", 1) != 1:
+            raise ValueError(
+                "paged verify does not support the q-split (big-model) "
+                "regime yet; build the plan with q_split=1")
+        J, fblk = blocks["ffn_blocks"], blocks["fblk"]
+        assert ffn == J * fblk, (ffn, blocks)
+    else:
+        J, fblk = _pick_ffn_blocks(
+            ffn, h, fixed_bytes=(dqkv + dq) * h * wbytes, wbytes=wbytes)
+    dtype = x.dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    def kernel(*refs):
+        if gpt:
+            (pos_ref, bt_ref, posv_ref, x_in_ref, ln1_ref, wqkv_ref,
+             wo_ref, ln2_ref, wg_ref, wd_ref) = refs[:10]
+            wu_ref = None
+            i = 10
+            (ln1b_ref, ln2b_ref, bqkv_ref, bo_ref, bg_ref,
+             bd_ref) = refs[i:i + 6]
+            i += 6
+        else:
+            (pos_ref, bt_ref, posv_ref, x_in_ref, ln1_ref, wqkv_ref,
+             wo_ref, ln2_ref, wg_ref, wu_ref, wd_ref) = refs[:11]
+            i = 11
+        if int8:
+            sqkv_ref, so_ref, sg_ref, su_ref, sd_ref = refs[i:i + 5]
+            i += 5
+        if kvq:
+            kvs_ref = refs[i]          # (b, 2*dkv) per-SLOT pool scales
+            i += 1
+        kv_in = refs[i]
+        x_out_ref, kv_ref = refs[i + 1], refs[i + 2]
+        (x_s, xn_s, acc_s, q_s, kv32_s, kvtl_s, kvch_s,
+         wsem, rsem) = refs[i + 3:]
+        del kv_in
+
+        def wdot(act, wref, sref):
+            w = wref[...]
+            if int8:
+                y = jnp.dot(act, w.astype(act.dtype),
+                            preferred_element_type=jnp.float32)
+                return y if sref is None else y * sref[...]
+            return jnp.dot(act, w, preferred_element_type=jnp.float32)
+
+        li = pl.program_id(0)
+        j = pl.program_id(1)
+
+        # ---- per-row paged DMA descriptors (block table in SMEM) ----
+        def seg_src(l, r, m):
+            """The m-th 8-token segment of row r's append window,
+            resolved through its block table; past-the-table segments
+            (over-speculation near the cap) redirect to scratch."""
+            q0 = pos_ref[r] // 8 * 8 + m * 8
+            c = q0 // BT
+            bid = jnp.where(c < MB, bt_ref[r, jnp.minimum(c, MB - 1)], 0)
+            return kv_ref.at[l, bid, pl.ds(q0 % BT, 8)]
+
+        def seg_read(l, r, m):
+            return pltpu.make_async_copy(
+                seg_src(l, r, m), kvtl_s.at[r, pl.ds(m * 8, 8)],
+                wsem.at[m, r])
+
+        def seg_write(l, r, m):
+            return pltpu.make_async_copy(
+                kvtl_s.at[r, pl.ds(m * 8, 8)], seg_src(l, r, m),
+                wsem.at[m, r])
+
+        def chunk_copy(l, c, slot, r):
+            return pltpu.make_async_copy(
+                kv_ref.at[l, bt_ref[r, c]], kvch_s.at[slot, r],
+                rsem.at[slot, r])
+
+        # chunk walk bound: the LONGEST row's committed full-8 prefix
+        nc = (pos_ref[0] // 8 * 8 + ck - 1) // ck
+        for r in range(1, b):
+            nc = jnp.maximum(nc, (pos_ref[r] // 8 * 8 + ck - 1) // ck)
+
+        @pl.when(j == 0)
+        def attention_phase():
+            posv = posv_ref[...]                        # (b, 1) int32
+            blk_v = posv // 8 * 8
+            blk3 = blk_v.reshape(b, 1, 1)
+
+            @pl.when(li == 0)
+            def _():
+                x_s[...] = x_in_ref[...].astype(jnp.float32)
+                q_s[...] = jnp.zeros_like(q_s)
+                for r in range(b):
+                    for m in range(NW):
+                        seg_read(li, r, m).start()
+
+                @pl.when(nc > 0)
+                def _():
+                    for r in range(b):
+                        chunk_copy(li, 0, 0, r).start()
+
+            if gpt:
+                xn = _layernorm(x_s[...], ln1_ref[...].reshape(h),
+                                ln1b_ref[...].reshape(h), eps)
+            else:
+                xn = _rms(x_s[...], ln1_ref[...].reshape(h), eps)
+            qkv = wdot(xn, wqkv_ref, sqkv_ref if int8 else None)
+            if gpt:
+                qkv = qkv + bqkv_ref[...]
+            half = (lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+                    % (hd // 2)).astype(jnp.float32)
+            inv_freq = jnp.exp(half * (-2.0 * math.log(rope_base) / hd))
+            # per-(token, row) staging: token t's heads land in q rows
+            # [t*nh, (t+1)*nh) block-diagonally; its k/v in kv32_s[:, t]
+            for t in range(K1):
+                seg = qkv[t * b:(t + 1) * b]            # (b, dqkv)
+                if gpt:
+                    rope2 = lambda v: v                 # noqa: E731
+                else:
+                    ang = (posv + t).astype(jnp.float32) * inv_freq
+                    cos_b = jnp.cos(ang)
+                    sin_b = jnp.sin(ang)
+                    rope2 = lambda v: (v * cos_b + jnp.concatenate(
+                        [-v[:, hd // 2:], v[:, :hd // 2]],
+                        axis=-1) * sin_b)               # noqa: E731
+                for n in range(nh):
+                    g = n // rep
+                    q_s[:, t * nh + n, g * hd:(g + 1) * hd] = rope2(
+                        seg[:, n * hd:(n + 1) * hd]) * scale
+                for g in range(nkv):
+                    kv32_s[:, t, g * hd:(g + 1) * hd] = rope2(
+                        seg[:, dq + g * hd:dq + (g + 1) * hd])
+                    kv32_s[:, t, dkv + g * hd:dkv + (g + 1) * hd] = \
+                        seg[:, dq + dkv + g * hd:dq + dkv + (g + 1) * hd]
+
+            if kvq:     # per-slot k-half dequant scales fold into q rows
+                qbd = q_s[...] * kvs_ref[...][:, None, :dkv]
+            else:
+                qbd = q_s[...]
+
+            def merge(carry, kvblk, idx, limit):
+                """Online-softmax block update over all K1*nh queries;
+                `limit` is per-(row, query) — the causal tail masks
+                query j to its own position."""
+                m, l, acc = carry
+                kf = kvblk[:, :, :dkv].astype(jnp.float32)
+                vf = kvblk[:, :, dkv:].astype(jnp.float32)
+                sc = lax.dot_general(
+                    qbd, kf, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)  # (b, K1*nh, w)
+                sc = jnp.where(idx < limit, sc, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+                alpha = jnp.exp(m - m_new)
+                pp = jnp.exp(sc - m_new[..., None])
+                acc = acc * alpha[..., None] + lax.dot_general(
+                    pp, vf, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                return m_new, l * alpha + jnp.sum(pp, axis=-1), acc
+
+            def body(c, carry):
+                slot = lax.rem(c, 2)
+
+                @pl.when(c + 1 < nc)
+                def _():
+                    for r in range(b):
+                        chunk_copy(li, c + 1, lax.rem(c + 1, 2), r).start()
+
+                for r in range(b):
+                    chunk_copy(li, c, slot, r).wait()
+                idx = c * ck + lax.broadcasted_iota(
+                    jnp.int32, (1, 1, ck), 2)
+                # every tail query attends the whole committed prefix
+                return merge(carry, kvch_s[slot], idx, blk3)
+
+            carry = lax.fori_loop(0, nc, body, (
+                jnp.full((b, K1 * nh), NEG_INF, jnp.float32),
+                jnp.zeros((b, K1 * nh), jnp.float32),
+                jnp.zeros((b, K1 * nh, dkv), jnp.float32)))
+
+            # merge the K1 tail tokens into the append window at
+            # offsets off+t, attend it with per-query causal limits,
+            # write the segments back (waited in FFN j==1)
+            for r in range(b):
+                for m in range(NW):
+                    seg_read(li, r, m).wait()
+            off3 = (posv - blk_v).reshape(b, 1, 1)
+            wi = lax.broadcasted_iota(jnp.int32, (1, NW * 8, 1), 1)
+            win = kvtl_s[...].astype(jnp.float32)
+            newtok = kv32_s[...]                        # (b, K1, 2dkv)
+            if kvq:     # quantize the appends with the per-slot scales
+                newtok = jnp.clip(
+                    jnp.round(newtok / kvs_ref[...][:, None]),
+                    -127.0, 127.0)
+            for t in range(K1):
+                win = jnp.where(wi == off3 + t, newtok[:, t][:, None],
+                                win)
+            kvtl_s[...] = win.astype(kv_pool.dtype)
+            for r in range(b):
+                for m in range(NW):
+                    seg_write(li, r, m).start()
+            widx = blk3 + lax.broadcasted_iota(
+                jnp.int32, (1, 1, NW * 8), 2)
+            # query t of each row masks to its own position pos+t
+            jq = (lax.broadcasted_iota(jnp.int32, (1, K1 * nh, 1), 1)
+                  // nh)
+            ms_, ls, accs = merge(carry, kvtl_s[...], widx,
+                                  posv.reshape(b, 1, 1) + jq + 1)
+
+            norm = accs / ls[..., None]             # (b, K1*nh, dkv)
+            if kvq:     # per-slot v-half dequant scales, applied once
+                norm = norm * kvs_ref[...][:, None, dkv:]
+            # o-proj per tail token over its static head-row slice
+            for t in range(K1):
+                nt = norm[:, t * nh:(t + 1) * nh, :]    # (b, nh, dkv)
+                if rep == 1:
+                    bd = (lax.broadcasted_iota(
+                        jnp.int32, (1, nh, dkv), 2) // hd
+                        == lax.broadcasted_iota(
+                            jnp.int32, (1, nh, dkv), 1))
+                    attn = jnp.sum(jnp.where(bd, nt, 0.0), axis=1)
+                    oacc = wdot(attn.astype(dtype), wo_ref,
+                                so_ref if int8 else None)
+                else:
+                    oacc = jnp.zeros((b, h), jnp.float32)
+                    for g in range(nkv):
+                        ng = nt[:, g * rep:(g + 1) * rep,
+                                g * hd:(g + 1) * hd]
+                        w3 = wo_ref[g * rep * hd:(g + 1) * rep * hd,
+                                    :].reshape(rep, hd, h)
+                        part = lax.dot_general(
+                            ng.astype(dtype),
+                            w3.astype(dtype) if int8 else w3,
+                            (((2,), (1,)), ((1,), (0,))),
+                            preferred_element_type=jnp.float32)
+                        oacc = oacc + jnp.sum(part, axis=0)
+                    if int8:
+                        oacc = oacc * so_ref[...]
+                if gpt:
+                    oacc = oacc + bo_ref[...]
+                x_s[t * b:(t + 1) * b, :] = \
+                    x_s[t * b:(t + 1) * b, :] + oacc
+            xr = x_s[...]
+            if gpt:
+                xn_s[...] = _layernorm(xr, ln2_ref[...].reshape(h),
+                                       ln2b_ref[...].reshape(h),
+                                       eps).astype(dtype)
+            else:
+                xn_s[...] = _rms(xr, ln2_ref[...].reshape(h),
+                                 eps).astype(dtype)
+            acc_s[...] = jnp.zeros_like(acc_s)
+
+        @pl.when(j >= 1)
+        def ffn_phase():
+            @pl.when(j == 1)
+            def prefetch_next_layer():
+                for r in range(b):
+                    for m in range(NW):
+                        seg_write(li, r, m).wait()
+
+                @pl.when(li + 1 < L)
+                def _():
+                    for r in range(b):
+                        for m in range(NW):
+                            seg_read(li + 1, r, m).start()
+
+                    @pl.when(nc > 0)
+                    def _():
+                        for r in range(b):
+                            chunk_copy(li + 1, 0, 0, r).start()
+
+            xn = xn_s[...]
+            g = wdot(xn, wg_ref, sg_ref if int8 else None)
+            if gpt:
+                g = g + bg_ref[...]
+                act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            else:
+                u = wdot(xn, wu_ref, su_ref if int8 else None)
+                act = (jax.nn.silu(g) * u).astype(dtype)
+            acc_s[...] += wdot(act, wd_ref, sd_ref if int8 else None)
+
+            if gpt:
+                @pl.when(j == J)
+                def _():
+                    acc_s[...] += jnp.broadcast_to(bd_ref[...],
+                                                   acc_s.shape)
+
+            @pl.when(j == J)
+            def _():
+                xr = x_s[...] + acc_s[...]
+                x_s[...] = xr
+                x_out_ref[...] = xr.astype(dtype)
+
+    def jm(ll, jj):
+        return jnp.where(jj < 1, J - 1, jj - 1)
+
+    def fl(ll, jj):
+        return lax.max(ll - (jj < 1), 0)
+
+    grid = (L, 1 + J)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                 # positions
+        pl.BlockSpec(memory_space=pltpu.SMEM),                 # block table
+        pl.BlockSpec((b, 1), lambda l, j: (0, 0)),             # posv
+        pl.BlockSpec((K1b, h), lambda l, j: (0, 0)),           # x
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln1
+        pl.BlockSpec((None, h, dqkv), lambda l, j: (l, 0, 0)),  # wqkv
+        pl.BlockSpec((None, dq, h), lambda l, j: (l, 0, 0)),   # wo
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),    # ln2
+        pl.BlockSpec((None, h, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # wg
+    ] + ([] if gpt else [
+        pl.BlockSpec((None, h, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # wu
+    ]) + [
+        pl.BlockSpec((None, fblk, h),
+                     lambda l, j: (fl(l, j), jm(l, j), 0)),     # wd
+    ] + ([
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln1_b
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # ln2_b
+        pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # bqkv
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bo
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # bg
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # bd
+    ] if gpt else []) + ([
+        pl.BlockSpec((None, 1, dqkv), lambda l, j: (l, 0, 0)),  # sqkv
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # so
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # sg
+        pl.BlockSpec((None, 1, fblk),
+                     lambda l, j: (fl(l, j), 0, jm(l, j))),     # su
+        pl.BlockSpec((None, 1, h), lambda l, j: (l, 0, 0)),     # sd
+    ] if int8 else []) + ([
+        pl.BlockSpec((None, b, 2 * dkv), lambda l, j: (l, 0, 0)),  # kvs
+    ] if kvq else []) + [
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),      # kv pool
+    ]
+    operands = [
+        jnp.asarray(positions, jnp.int32).reshape(b),
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(positions, jnp.int32).reshape(b, 1),
+        x,
+        params["ln1"][:, None], params["wqkv"], params["wo"],
+        params["ln2"][:, None], params["wg"],
+        *(() if gpt else (params["wu"],)),
+        params["wd"],
+        *((params["ln1_b"][:, None], params["ln2_b"][:, None],
+           params["bqkv"][:, None], params["bo"][:, None],
+           params["bg"][:, None], params["bd"][:, None]) if gpt else ()),
+        *((params["wqkv_s"], params["wo_s"], params["wg_s"],
+           params["wu_s"], params["wd_s"]) if int8 else ()),
+        *((jnp.asarray(kv_scales, jnp.float32),) if kvq else ()),
+        kv_pool,
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((K1b, h), lambda l, j: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K1b, h), dtype),
+            jax.ShapeDtypeStruct(kv_pool.shape, kv_pool.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K1b, h), jnp.float32),        # x_s
+            pltpu.VMEM((K1b, h), dtype),              # xn_s
+            pltpu.VMEM((K1b, h), jnp.float32),        # acc_s
+            pltpu.VMEM((b, K1 * nh, dkv), jnp.float32),   # q_s
+            pltpu.VMEM((b, K1, 2 * dkv), jnp.float32),    # kv32_s
+            pltpu.VMEM((b, NW * 8, 2 * dkv), kv_pool.dtype),  # kvtl_s
+            pltpu.VMEM((2, b, ck, 2 * dkv), kv_pool.dtype),   # kvch_s
+            pltpu.SemaphoreType.DMA((NW, b)),         # wsem (seg, row)
+            pltpu.SemaphoreType.DMA((2, b)),          # rsem (slot, row)
+        ],
+        input_output_aliases={len(in_specs) - 1: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=_vmem_limit_bytes()),
+        name="fused_paged_verify_step",
+        interpret=interpret,
+    )(*operands)
+    return out[0], out[1]
+
+
+def fused_paged_verify_step(x, params, kv_pool, block_tables, positions,
+                            cos, sin, *, num_heads: int, num_kv_heads: int,
+                            eps: float = 1e-5, rope_base: float = 10000.0,
+                            arch: str = "llama",
+                            blocks: Optional[Dict] = None, kv_scales=None):
+    """Dispatch one PAGED verify step (speculative decoding's scoring
+    pass): Pallas kernel on TPU (or under FLAGS_pallas_interpret), jnp
+    verify reference elsewhere.
+
+    x (b, K1, h) — the K1 tail tokens (the slot's last sampled token
+    followed by its K proposals) embedded at positions ``positions + j``;
+    cos/sin (b, K1, hd) the matching rope rows (reference path only —
+    the kernel computes rope in-kernel from `positions`). Returns
+    (x_out (b, K1, h), kv_pool) with every tail token's KV appended.
+    The engine samples the target tokens from x_out and commits the
+    longest proposal prefix that matches its own stream's samples —
+    docs/SERVING.md §Speculative decoding.
+    """
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.ops import use_pallas
+    if arch not in ("llama", "gpt"):
+        raise NotImplementedError(
+            f"paged verify supports arch llama/gpt, got {arch!r}")
+    b, K1, h = x.shape
+    dkv = kv_pool.shape[-1] // 2
+    BT = kv_pool.shape[2]
+    # tpu-lint: allow(host-sync): flag() is a host-side config read
+    interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
+    if (use_pallas() or interp) and dkv % 128 == 0 and BT % 8 == 0:
+        cb = jnp.dtype(kv_pool.dtype).itemsize
+        if blocks is not None and blocks.get("cache_wbytes", cb) != cb:
+            raise ValueError(
+                f"decode plan assumed a {blocks['cache_wbytes']}-byte KV "
+                f"cache but the pool dtype is {kv_pool.dtype} ({cb} B); "
+                f"rebuild the plan with decode_block_plan(cache_wbytes="
+                f"{cb})")
+        try:
+            with jax.named_scope("fused_decode.kernel_paged_verify"):
+                # token-major flat: token j's rows contiguous at [j*b,
+                # (j+1)*b) so the kernel's per-token stages are static
+                # slices
+                xf = x.transpose(1, 0, 2).reshape(K1 * b, h)
+                y, pool = _fused_paged_verify_pallas(
+                    xf, params, kv_pool, block_tables, positions,
+                    num_heads=num_heads, num_kv_heads=num_kv_heads,
+                    head_dim=dkv // num_kv_heads, rope_base=rope_base,
+                    eps=eps, arch=arch, blocks=blocks,
+                    kv_scales=kv_scales, interpret=interp)
+                return y.reshape(K1, b, h).transpose(1, 0, 2), pool
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            if flag("FLAGS_pallas_strict"):
+                raise
+            global _fallback_logged
+            if not _fallback_logged:
+                _fallback_logged = True
+                import logging
+                logging.getLogger("paddle_tpu.ops.fused_decode").warning(
+                    "Pallas paged verify failed (%s: %s); using the jnp "
+                    "reference path. FLAGS_pallas_strict=1 to raise.",
+                    type(e).__name__, e)
+    with jax.named_scope("fused_decode.reference_paged_verify"):
+        return fused_paged_verify_reference(
+            x, params, kv_pool, block_tables, positions, cos, sin,
+            num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
+            arch=arch, kv_scales=kv_scales)

@@ -20,9 +20,12 @@ from paddle_tpu.serving.engine import (  # noqa: F401
     ServingEngine)
 from paddle_tpu.serving.pool import (  # noqa: F401
     SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
+from paddle_tpu.serving.spec import (  # noqa: F401
+    PROPOSERS, SpecConfig)
 
 __all__ = [
-    "Request", "RequestResult", "ServingEngine",
-    "BlockPool", "PoolExhausted", "PrefixCache", "PrefixEntry",
-    "SCRATCH_BLOCK", "Rejected", "PRIORITIES", "ENGINE_SNAPSHOT_SCHEMA",
+    "Request", "RequestResult", "ServingEngine", "SpecConfig",
+    "PROPOSERS", "BlockPool", "PoolExhausted", "PrefixCache",
+    "PrefixEntry", "SCRATCH_BLOCK", "Rejected", "PRIORITIES",
+    "ENGINE_SNAPSHOT_SCHEMA",
 ]

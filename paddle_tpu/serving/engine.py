@@ -64,6 +64,22 @@ slot is guaranteed at least that many tokens between consecutive
 chunks. Chunked prefill is a *scheduling* change only: tokens are
 pinned identical to the monolithic wave (greedy+sampled × bf16+int8,
 prefix-hit and preempt-resume cases — tests/test_serving_chunked.py).
+
+Speculative decoding (``speculate=SpecConfig(...)``; docs/SERVING.md
+§Speculative decoding): after batched heads, int8 KV, paging and
+chunked prefill, decode's remaining cost is its *serial step count* —
+every token pays one full weight stream. With speculation armed, each
+tick verifies k proposed tokens per active slot in ONE
+``fused_paged_verify_step`` dispatch (the kernel's KV chunk walk plus a
+k-token causal tail) and commits the longest proposal prefix that
+matches the engine's OWN samples — token-exact acceptance off each
+slot's ``fold_in(seed, count)`` stream, so committed tokens are
+bit-identical to the non-speculative engine (and to isolated
+``generate``; tests/test_serving_spec.py pins greedy+sampled ×
+bf16+int8, through preempt/resume and snapshot/restore). Proposals come
+from a device-side per-slot n-gram matcher (no extra model, zero
+steady-state H2D) or a draft model riding its own block tables over
+the same paged machinery.
 """
 
 import heapq
@@ -81,17 +97,22 @@ import numpy as np
 
 from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool, PoolExhausted,
                                      PrefixCache)
+from paddle_tpu.serving.spec import SpecConfig
 
 logger = logging.getLogger("paddle_tpu.serving")
 
 __all__ = ["PRIORITIES", "Rejected", "Request", "RequestResult",
-           "ServingEngine", "ENGINE_SNAPSHOT_SCHEMA"]
+           "ServingEngine", "SpecConfig", "ENGINE_SNAPSHOT_SCHEMA"]
 
 ENGINE_SNAPSHOT_SCHEMA = "paddle_tpu.engine_snapshot/v1"
 
 # token-count buckets for the serving.chunk_tokens histogram (chunk
 # sizes are powers-of-two-ish token counts, not latencies)
 _CHUNK_SIZE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# accepted-proposal-length buckets for serving.spec_accepted_len (small
+# integer counts, not latencies — k rarely exceeds 8)
+_SPEC_LEN_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
 
 #: admission classes, lowest to highest. The queue orders by (priority,
 #: submit order); preemption only ever evicts a STRICTLY lower class, so
@@ -252,7 +273,7 @@ class _Slot:
     __slots__ = ("req", "tok", "pos", "count", "tokens", "blocks", "ntab",
                  "worst_blocks", "t_first", "deadline_at",
                  "prefix_hit_blocks", "feed", "resume",
-                 "prefilling", "filled", "R", "carry", "hits")
+                 "prefilling", "filled", "R", "carry", "hits", "dblocks")
 
     def __init__(self, req: Request, worst_blocks: int,
                  prefix_hit_blocks: int, feed: np.ndarray,
@@ -288,6 +309,9 @@ class _Slot:
         self.R = 0                      # prefix-hit depth in tokens
         self.carry = None
         self.hits = None
+        # draft-proposer block table rows (speculative engines with a
+        # draft model: the draft's KV pages for this slot)
+        self.dblocks: List[int] = []
 
 
 class _PriorityQueue:
@@ -419,6 +443,17 @@ class ServingEngine:
     request at a time (no same-tick wave batching) — bounded per-tick
     prefill work is the point.
 
+    ``speculate=SpecConfig(...)`` (None = plain per-token decode) arms
+    speculative decoding: every decode tick verifies k proposed tokens
+    per active slot in ONE ``fused_paged_verify_step`` dispatch and
+    commits the longest proposal prefix matching the engine's own
+    samples — 1..k+1 tokens per dispatch, bit-identical to the
+    non-speculative engine (docs/SERVING.md §Speculative decoding).
+    Proposals come from a device-side n-gram matcher
+    (``proposer="ngram"``, no extra model) or a draft model
+    (``proposer="draft"``) riding its own block tables over the same
+    paged machinery.
+
     ``sanitize=True`` (debug; docs/ANALYSIS.md) arms the dispatch
     sanitizer: every steady-state decode dispatch runs under
     ``analysis.runtime.sanitize()`` — zero H2D transfers, zero
@@ -440,6 +475,7 @@ class ServingEngine:
                  shed_infeasible: bool = False,
                  chunk_tokens: Optional[int] = None,
                  decode_per_chunk: int = 1,
+                 speculate: Optional[SpecConfig] = None,
                  sanitize: bool = False,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
@@ -534,6 +570,94 @@ class ServingEngine:
         self._seeds = np.zeros(ms, np.uint32)
         self._counts = np.zeros(ms, np.int32)
         self._kv_scales = np.ones((L, ms, 2 * self._dkv), np.float32)
+
+        # ---- speculative decoding (docs/SERVING.md §Speculative) ----
+        self.speculate = speculate
+        self._spec_k = 0
+        self._verify_fn = None
+        self._draft_fn = None
+        self._history = None            # ngram: host mirror (ms, S)
+        self._dev_hist = None           # ngram: device history twin
+        self._dev_prop = None           # ngram: carried device proposals
+        self._draft_dev = None          # draft: device block-table twin
+        self._draft_tables = None
+        self._draft_pool_blocks = None
+        self.draft_kv_pool = None
+        self._tick_spec = None          # (proposed, accepted) this tick
+        # committed tokens per active slot per decode dispatch — what
+        # the TTFT estimator divides decode work by so shed_infeasible
+        # doesn't over-shed when speculation multiplies tokens/tick
+        self._ewma_spec_tokens = _Ewma()
+        if speculate is not None:
+            if not isinstance(speculate, SpecConfig):
+                raise ValueError(
+                    f"speculate must be a serving.SpecConfig, got "
+                    f"{type(speculate).__name__}")
+            if speculate.k >= max_seq_len:
+                raise ValueError(
+                    f"speculate k {speculate.k} must be < max_seq_len "
+                    f"{max_seq_len}")
+            self._spec_k = speculate.k
+            if speculate.proposer == "ngram":
+                # the device-side suffix matcher runs over this carried
+                # committed-token buffer — uploaded only on dirty ticks
+                self._history = np.zeros((ms, max_seq_len), np.int32)
+                # the dirty-tick proposal reset, built ONCE: immutable
+                # device constants, so a join/leave tick re-arms the
+                # proposer without compiling a zeros program mid-drain
+                # (the compile-set pin in tests/test_analysis.py)
+                self._spec_prop_zero = (
+                    jnp.zeros((ms, speculate.k), jnp.int32),
+                    jnp.zeros((ms,), jnp.int32))
+            else:
+                from paddle_tpu.inference import _inference_state as _ist
+                dm = speculate.draft_model
+                self._draft_state = (speculate.draft_state
+                                     if speculate.draft_state is not None
+                                     else _ist(dm))
+                dmeta = (dm.fused_decode_plan(self._draft_state,
+                                              probe=True)
+                         if hasattr(dm, "fused_decode_plan") else None)
+                if dmeta is None:
+                    raise ValueError(
+                        "draft_model needs a fused_decode_plan-eligible "
+                        "config (llama/gpt) to ride the paged kernel")
+                darch = dmeta.get("arch", "llama")
+                if darch not in ("llama", "gpt"):
+                    raise ValueError(
+                        f"draft proposer supports arch llama/gpt, got "
+                        f"{darch!r}")
+                dbp = dmeta.get("blocks")
+                if dbp is not None and dbp.get("q_split", 1) != 1:
+                    raise ValueError(
+                        "draft proposer does not support the q-split "
+                        "(big-model) draft regime")
+                self._draft_meta = dmeta
+                self._draft_arch = darch
+                self._draft_layers = int(getattr(dm.cfg, "num_layers"))
+                self._draft_dkv = (dmeta["num_kv_heads"]
+                                   * dmeta["head_dim"])
+                # the draft shares the paged-pool DESIGN with its own
+                # block tables; its pool is sized worst-case (a tiny
+                # model's pages are cheap) so a prefix-cache-assisted
+                # target admission can never strand the draft mid-flight
+                dnb = ms * self.max_blocks_per_slot + 1
+                self._draft_pool_blocks = BlockPool(dnb, block_tokens)
+                self.draft_kv_pool = jnp.zeros(
+                    (self._draft_layers, dnb, block_tokens,
+                     2 * self._draft_dkv), jnp.bfloat16)
+                self._draft_stacked = jax.jit(
+                    lambda st: dm.fused_decode_plan(st)["params"])(
+                        self._draft_state)
+                self._draft_cos, self._draft_sin = rope_ops.rope_cos_sin(
+                    max_seq_len, dmeta["head_dim"],
+                    base=dmeta["rope_base"])
+                self._draft_tables = np.full(
+                    (ms, self.max_blocks_per_slot), SCRATCH_BLOCK,
+                    np.int32)
+                # draft proposals always fill all k slots
+                self._dev_nprop_full = jnp.full((ms,), speculate.k,
+                                                jnp.int32)
 
         self._slots: List[Optional[_Slot]] = [None] * ms
         self._queue = _PriorityQueue()
@@ -635,7 +759,8 @@ class ServingEngine:
                     requests_finished=0, requests_admitted=0,
                     preemptions=0, requests_resumed=0,
                     requests_shed=0, requests_rejected=0,
-                    sanitized_steps=0,
+                    sanitized_steps=0, decode_slot_dispatches=0,
+                    spec_ticks=0, spec_proposed=0, spec_accepted=0,
                     step_admit_s=0.0, step_prefill_s=0.0,
                     step_dispatch_s=0.0, step_sync_s=0.0)
 
@@ -744,8 +869,14 @@ class ServingEngine:
                    + (n_chunks - 1) * self.decode_per_chunk * step_s)
         else:
             own = P * tok_s
+        # with speculation on, one dispatch commits an accepted-length
+        # EWMA of tokens per slot (>= 1), so the decode work ahead
+        # drains that much faster — pricing it at one token per step
+        # would over-shed feasible deadlines exactly when speculation
+        # is winning (tests/test_serving_spec.py pins the regression)
+        tpt = max(self._ewma_spec_tokens.value or 1.0, 1.0)
         return (own + ahead_pf * tok_s
-                + (ahead / self.max_slots) * step_s)
+                + (ahead / (self.max_slots * tpt)) * step_s)
 
     def submit(self, request) -> int:
         """Queue a request (accepts a :class:`Request` or a 1-D prompt).
@@ -1163,6 +1294,14 @@ class ServingEngine:
             self.pool.free(bid)
         s.carry = None          # slot objects linger on the prefill
         s.hits = None           # FIFO; drop the device buffer now
+        if s.dblocks:           # draft proposer pages
+            for bid in s.dblocks:
+                self._draft_pool_blocks.free(bid)
+            s.dblocks = []
+        if self._draft_tables is not None:
+            self._draft_tables[slot_idx][:] = SCRATCH_BLOCK
+        if self._history is not None:
+            self._history[slot_idx][:] = 0
         self._reserved -= s.worst_blocks - s.ntab
         self._slots[slot_idx] = None
         self._tables[slot_idx][:] = SCRATCH_BLOCK
@@ -1564,6 +1703,15 @@ class ServingEngine:
         self._toks[slot_idx] = s.tok
         self._seeds[slot_idx] = np.uint32(req.seed)
         self._counts[slot_idx] = s.count
+        if self._history is not None:
+            # ngram proposer: the committed tokens are the feed plus
+            # the slot's current last token (index P) — the suffix the
+            # device matcher extends
+            self._history[slot_idx][:] = 0
+            self._history[slot_idx, :P] = s.feed
+            self._history[slot_idx, min(P, self.max_seq_len - 1)] = s.tok
+        if self._draft_tables is not None:
+            self._run_draft_prefill(slot_idx, s)
         self.stats["prefill_tokens"] += P - s.R
         self.stats["prefill_tokens_reused"] += s.R
         if self.prefix_cache is not None:
@@ -1644,19 +1792,236 @@ class ServingEngine:
         jitted = jax.jit(impl, donate_argnums=(2,))
         return lambda *a: jitted(self._state, self._stacked, *a)
 
-    def _ensure_blocks(self, slot_idx: int):
-        """The next append position must resolve to an allocated block;
-        allocate lazily as a slot's sequence crosses block boundaries
-        (admission already reserved the worst case, so this cannot
-        exhaust the pool)."""
+    # ------------------------------------------------- speculative decode
+    def _build_verify_fn(self):
+        """ONE program per speculative tick: embed the K+1-token tail
+        (last sampled token + K proposals) per slot, score it through
+        ``fused_paged_verify_step`` (KV appended through the multi-token
+        path), sample each position's TARGET token off the slot's own
+        ``fold_in(seed, count + j)`` stream, and accept the longest
+        proposal prefix that matches — token-exact, so committed tokens
+        are bitwise the non-speculative engine's. Per-slot state
+        (positions/counts/last token) advances on device, and for the
+        n-gram proposer the committed-token history and the NEXT tick's
+        proposals are produced in the same program — a steady
+        speculative tick re-dispatches with zero H2D uploads."""
+        from paddle_tpu.inference import _row_keys, _sample_logits
+        from paddle_tpu.ops.fused_decode import fused_paged_verify_step
+        from paddle_tpu.serving.spec import ngram_propose
+
+        meta, arch, int8 = self.meta, self.arch, self.kv_int8
+        model, cos_tab, sin_tab = self.model, self._cos_tab, self._sin_tab
+        temperature, top_k, top_p = (self.temperature, self.top_k,
+                                     self.top_p)
+        pos_cap = self.max_seq_len - 1
+        K = self._spec_k
+        K1 = K + 1
+        ngram = self.speculate.proposer == "ngram"
+        nmax = self.speculate.ngram_max
+        nmin = self.speculate.ngram_min
+
+        def impl(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales, proposals, nprop, *hist):
+            history = hist[0] if ngram else None
+            plan_t = model.fused_decode_plan(state)
+            blocks = plan_t.get("blocks")
+            if int8 and blocks is not None:
+                blocks = dict(blocks, cache_wbytes=1)
+            tail = jnp.concatenate([toks[:, None], proposals], axis=1)
+            xs, coss, sins = [], [], []
+            for j in range(K1):
+                # per-token embed/rope rows, shaped exactly like the
+                # plain step's (the clamp binds only on over-speculation
+                # past a retiring slot's cap — garbage rows)
+                pj = jnp.minimum(positions + j, pos_cap)
+                xs.append(plan_t["embed"](tail[:, j], pj))
+                coss.append(jnp.take(cos_tab, pj, axis=0))
+                sins.append(jnp.take(sin_tab, pj, axis=0))
+            x = jnp.stack(xs, axis=1)                     # (b, K1, h)
+            x, pool = fused_paged_verify_step(
+                x, stacked, pool, tables, positions,
+                jnp.stack(coss, axis=1), jnp.stack(sins, axis=1),
+                num_heads=meta["num_heads"],
+                num_kv_heads=meta["num_kv_heads"], eps=meta["eps"],
+                rope_base=meta["rope_base"], arch=arch, blocks=blocks,
+                kv_scales=kv_scales if int8 else None)
+            keys = _row_keys(seeds)
+            gs = []
+            for j in range(K1):
+                with jax.named_scope("decode.sample"):
+                    # the exact key the non-speculative engine folds for
+                    # token count+j — sample-and-match acceptance is
+                    # what makes speculation bit-invisible
+                    ki = jax.vmap(jax.random.fold_in)(keys, counts + j)
+                    gs.append(_sample_logits(plan_t["head"](x[:, j]), ki,
+                                             temperature, top_k, top_p))
+            g = jnp.stack(gs, axis=1)                     # (b, K1)
+            match = (proposals == g[:, :K]) \
+                & (jnp.arange(K)[None] < nprop[:, None])
+            acc = jnp.cumprod(match.astype(jnp.int32),
+                              axis=1).sum(axis=1)         # (b,)
+            tok2 = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
+            pos2 = jnp.minimum(positions + acc + 1, pos_cap)
+            counts2 = counts + acc + 1
+            if not ngram:
+                return g, acc, pool, pos2, tok2, counts2
+            # committed-token history: the tail lands at its absolute
+            # indices, then the corrected/bonus token at pos2 — writes
+            # past the accepted prefix are stale and sit beyond the
+            # committed length, exactly like rejected KV
+            rows = jnp.arange(tail.shape[0])
+            idxm = jnp.minimum(
+                positions[:, None] + jnp.arange(K1)[None], pos_cap)
+            hist2 = history.at[rows[:, None], idxm].set(tail)
+            hist2 = hist2.at[rows, pos2].set(tok2)
+            prop2, nprop2 = ngram_propose(hist2, pos2 + 1, K, nmax, nmin)
+            return (g, acc, pool, pos2, tok2, counts2, hist2, prop2,
+                    nprop2)
+
+        jitted = jax.jit(impl, donate_argnums=(2,))
+        return lambda *a: jitted(self._state, self._stacked, *a)
+
+    def _build_draft_fn(self):
+        """Draft-proposer round: ONE scanned program runs k+1 greedy
+        draft decode steps over the draft's own paged pool (positions
+        shared with the target — draft and target appends advance in
+        lockstep). k+1 appends, not k: the step that appends the k-th
+        proposal's KV is what keeps the draft gap-free when the whole
+        proposal is accepted (the bonus token's predecessor must be in
+        the draft cache before the next round). Returns the k proposals
+        and the updated draft pool; proposals stay on device — the
+        verify program reads them directly, the host pulls them with
+        the accepted counts after verify."""
+        from paddle_tpu.inference import _sample_logits
+        from paddle_tpu.ops.fused_decode import fused_paged_decode_step
+
+        dm = self.speculate.draft_model
+        dmeta = self._draft_meta
+        darch = self._draft_arch
+        K = self._spec_k
+        pos_cap = self.max_seq_len - 1
+        cos_tab, sin_tab = self._draft_cos, self._draft_sin
+
+        def impl(dstate, dstacked, dpool, dtables, positions, toks):
+            plan_t = dm.fused_decode_plan(dstate)
+            blocks = plan_t.get("blocks")
+
+            # NOT named `step`: the tpu-lint callgraph resolves bare
+            # names module-wide, and a lax.scan body called `step`
+            # would mark ServingEngine.step as jit-reachable
+            def draft_step(carry, _):
+                tok, pool, pos = carry
+                x = plan_t["embed"](tok, pos)
+                cos = jnp.take(cos_tab, pos, axis=0)
+                sin = jnp.take(sin_tab, pos, axis=0)
+                x, pool = fused_paged_decode_step(
+                    x, dstacked, pool, dtables, pos, cos, sin,
+                    num_heads=dmeta["num_heads"],
+                    num_kv_heads=dmeta["num_kv_heads"],
+                    eps=dmeta["eps"], rope_base=dmeta["rope_base"],
+                    arch=darch, blocks=blocks, kv_scales=None)
+                with jax.named_scope("decode.draft_sample"):
+                    # greedy proposals: acceptance is exact-match
+                    # against the target's sample, so the draft's best
+                    # guess is its own argmax — no draft RNG stream
+                    nxt = _sample_logits(plan_t["head"](x), None,
+                                         0.0, 0, 1.0)
+                return (nxt, pool, jnp.minimum(pos + 1, pos_cap)), nxt
+
+            (_, pool, _), props = jax.lax.scan(
+                draft_step, (toks, dpool, positions), None, length=K + 1)
+            return props[:K].T.astype(jnp.int32), pool
+
+        jitted = jax.jit(impl, donate_argnums=(2,))
+        return lambda *a: jitted(self._draft_state, self._draft_stacked,
+                                 *a)
+
+    def _draft_prefill_fn(self, s_pad):
+        """Draft prefill program (keyed by padded feed length, like the
+        target's prefill buckets): forward the feed through the draft
+        model and scatter its KV into the slot's draft pages. No
+        sampling, no calibration (the draft pool is always bf16) — the
+        draft is a proposer, its logits only matter during rounds.
+        Returns ``(fn, cached)``."""
+        from paddle_tpu.nn.layer import functional_call
+
+        key = ("draft_prefill", s_pad)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn, True
+        dm = self.speculate.draft_model
+        BT = self.block_tokens
+        nb = s_pad // BT
+        Ld = self._draft_layers
+        dkv = self._draft_dkv
+
+        def impl(dstate, pool, ids, new_bids):
+            cache = dm.init_cache(1, s_pad, dtype=jnp.bfloat16)
+            with jax.named_scope("decode.draft_prefill"):
+                _, cache = functional_call(dm, dstate, ids, cache=cache,
+                                           start_pos=0)
+            kv_flat = jnp.stack([jnp.concatenate(
+                [c["k"].reshape(1, s_pad, dkv),
+                 c["v"].reshape(1, s_pad, dkv)], axis=-1)
+                for c in cache])             # (Ld, 1, s_pad, 2dkv)
+            blk = kv_flat.reshape(Ld, 1, nb, BT, 2 * dkv)
+            return pool.at[:, new_bids].set(blk.astype(pool.dtype))
+
+        jitted = jax.jit(impl, donate_argnums=(1,))
+        fn = lambda *a: jitted(self._draft_state, *a)   # noqa: E731
+        self._jit_cache[key] = fn
+        return fn, False
+
+    def _run_draft_prefill(self, slot_idx: int, s: "_Slot"):
+        """Fill the draft's KV pages for a freshly adopted slot (called
+        from :meth:`_adopt_slot` — the one join path, so chunked and
+        monolithic admissions both land here). The draft prefill is
+        monolithic even on chunked engines: the draft is small by
+        contract, so one program over the whole feed doesn't move the
+        chunked TPOT bound the way a target prefill would."""
+        P = len(s.feed)
+        BT = self.block_tokens
+        dn0 = -(-P // BT)
+        fresh = self._draft_pool_blocks.alloc(dn0 - len(s.dblocks))
+        self._draft_tables[slot_idx, len(s.dblocks):dn0] = fresh
+        s.dblocks.extend(fresh)
+        ids = np.zeros((1, dn0 * BT), np.int32)
+        ids[0, :P] = s.feed
+        fn, _cached = self._draft_prefill_fn(dn0 * BT)
+        self.draft_kv_pool = fn(
+            self.draft_kv_pool, jnp.asarray(ids),
+            jnp.asarray(np.asarray([s.dblocks[:dn0]], np.int32)))
+
+    def _ensure_blocks(self, slot_idx: int, horizon: int = 0):
+        """Append positions [pos, pos+horizon] must resolve to allocated
+        blocks; allocate lazily as a slot's sequence crosses block
+        boundaries (admission already reserved the worst case, so this
+        cannot exhaust the pool). ``horizon`` is the speculative append
+        depth (k tail tokens beyond the base append); allocation never
+        exceeds the slot's reservation — over-speculation past it lands
+        in the scratch block by table construction."""
         s = self._slots[slot_idx]
-        c = s.pos // self.block_tokens
+        c = min((s.pos + horizon) // self.block_tokens,
+                s.worst_blocks - 1)
         while s.ntab <= c:
             bid = self.pool.alloc(1)[0]
             s.blocks.append(bid)
             self._tables[slot_idx][s.ntab] = bid
             s.ntab += 1
             self._reserved -= 1
+            self._dirty = True
+
+    def _ensure_draft_blocks(self, slot_idx: int):
+        """Draft-proposer twin of :meth:`_ensure_blocks`: the draft
+        appends k+1 tokens per tick at the target's positions, against
+        its own worst-case-sized pool (allocation cannot fail)."""
+        s = self._slots[slot_idx]
+        c = min((s.pos + self._spec_k) // self.block_tokens,
+                self.max_blocks_per_slot - 1)
+        while len(s.dblocks) <= c:
+            bid = self._draft_pool_blocks.alloc(1)[0]
+            self._draft_tables[slot_idx][len(s.dblocks)] = bid
+            s.dblocks.append(bid)
             self._dirty = True
 
     def _retire(self, slot_idx: int, finish: str):
@@ -1750,6 +2115,7 @@ class ServingEngine:
         self._tick_prefill_s = 0.0
         self._tick_preempted = []
         self._tick_resumed = []
+        self._tick_spec = None
         # _tick_shed keeps accumulating across submit() calls between
         # ticks; _record_flight drains it into this tick's event
         t0 = time.perf_counter()
@@ -1801,15 +2167,23 @@ class ServingEngine:
                     self._run_prefill_chunk(*front)
                     self._decode_since_chunk = 0
         dispatch_s = sync_s = None
+        spec = self.speculate is not None
         # prefilling slots stay OUT of the decode batch: their mirror
         # rows idle against scratch until the last chunk adopts them
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and not s.prefilling]
         if active:
-            if self._step_fn is None:
+            if spec:
+                if self._verify_fn is None:
+                    self._verify_fn = self._build_verify_fn()
+                    if self.speculate.proposer == "draft":
+                        self._draft_fn = self._build_draft_fn()
+            elif self._step_fn is None:
                 self._step_fn = self._build_step_fn()
             for i in active:
-                self._ensure_blocks(i)
+                self._ensure_blocks(i, self._spec_k if spec else 0)
+                if self._draft_tables is not None:
+                    self._ensure_draft_blocks(i)
             _faults.maybe_fire("decode.dispatch")
             # steady state = the warm program re-dispatches with NO
             # host->device upload: no join/leave/lazy-block event made
@@ -1823,11 +2197,22 @@ class ServingEngine:
                              jnp.asarray(self._seeds),
                              jnp.asarray(self._counts),
                              jnp.asarray(self._kv_scales))
+                if self._history is not None:
+                    self._dev_hist = jnp.asarray(self._history)
+                    # a join/leave tick drops the carried proposals —
+                    # the device matcher re-primes them at the end of
+                    # this tick's verify (one plain-decode tick per
+                    # event, never a wrong speculation)
+                    self._dev_prop = self._spec_prop_zero
+                if self._draft_tables is not None:
+                    self._draft_dev = jnp.asarray(self._draft_tables)
                 self._dirty = False
         # everything up to the dispatch call is the admit segment
         # (minus the prefill programs, which _run_prefill_group timed)
         admit_s = max(0.0, time.perf_counter() - t0 - self._tick_prefill_s)
-        if active:
+        if active and spec:
+            dispatch_s, sync_s = self._spec_decode(active, steady)
+        elif active:
             t_d0 = time.perf_counter()
             if self._sanitize and steady:
                 from paddle_tpu.analysis import runtime as _sanitizer
@@ -1851,6 +2236,10 @@ class ServingEngine:
             self._decode_since_chunk += 1
             self.stats["steps"] += 1
             self.stats["decode_tokens"] += len(active)
+            # per-slot dispatch accounting: dispatches_per_token =
+            # decode_slot_dispatches / decode_tokens, 1.0 without
+            # speculation — the speculative perf gate's denominator
+            self.stats["decode_slot_dispatches"] += len(active)
             self.stats["idle_slot_steps"] += self.max_slots - len(active)
             r = registry()
             r.counter("serving.steps").inc()
@@ -1874,12 +2263,134 @@ class ServingEngine:
                     self._retire(i, "length")
         self._record_segments(admit_s, dispatch_s, sync_s)
         self._record_flight(admit_s, dispatch_s, sync_s)
+        self._after_flight()
+        return dict(active=self.active_slots, queued=len(self._queue),
+                    finished=self._finished_tick)
+
+    def _spec_decode(self, active, steady):
+        """One speculative tick's decode: the (optional) draft round
+        plus ONE batched verify dispatch, then the host commit of each
+        slot's accepted prefix + corrected/bonus token. Returns
+        (dispatch_s, sync_s) for the step-segment telemetry. Mirrors
+        stay in lockstep with the device state for surviving slots; a
+        retirement inside the commit loop marks the mirrors dirty like
+        any other leave event."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import registry
+
+        ngram = self._history is not None
+        t_d0 = time.perf_counter()
+
+        def dispatch():
+            if self._draft_fn is not None:
+                props, self.draft_kv_pool = self._draft_fn(
+                    self.draft_kv_pool, self._draft_dev, self._dev[1],
+                    self._dev[2])
+                nprop = self._dev_nprop_full
+            else:
+                props, nprop = self._dev_prop
+            args = (self.kv_pool, *self._dev, props, nprop)
+            if ngram:
+                args += (self._dev_hist,)
+            return props, nprop, self._verify_fn(*args)
+
+        if self._sanitize and steady:
+            from paddle_tpu.analysis import runtime as _sanitizer
+            with _sanitizer.sanitize(
+                    what="steady-state speculative ServingEngine.step "
+                         "dispatch"):
+                props_dev, nprop_dev, out = dispatch()
+            self.stats["sanitized_steps"] += 1
+        else:
+            props_dev, nprop_dev, out = dispatch()
+        if ngram:
+            (g, acc, self.kv_pool, d_pos, d_tok, d_cnt, hist2, prop2,
+             nprop2) = out
+            self._dev_hist = hist2
+            self._dev_prop = (prop2, nprop2)
+        else:
+            g, acc, self.kv_pool, d_pos, d_tok, d_cnt = out
+        self._dev = (self._dev[0], d_pos, d_tok, self._dev[3], d_cnt,
+                     self._dev[5])
+        t_s0 = time.perf_counter()
+        dispatch_s = t_s0 - t_d0
+        # THE one per-step D2H: accepted counts + target tokens + the
+        # verified proposals together are the step's completion fence —
+        # ONE batched device_get, not four round trips on the sync
+        # segment the TPOT bound measures
+        # tpu-lint: allow(host-sync): the per-step D2H completion fence
+        g_np, acc_np, prop_np, nprop_np = jax.device_get(
+            (g, acc, props_dev, nprop_dev))
+        sync_s = time.perf_counter() - t_s0
+
+        self._decode_since_chunk += 1
+        self.stats["steps"] += 1
+        self.stats["spec_ticks"] += 1
+        self.stats["decode_slot_dispatches"] += len(active)
+        self.stats["idle_slot_steps"] += self.max_slots - len(active)
+        r = registry()
+        r.counter("serving.steps").inc()
+        r.counter("serving.idle_slot_steps").inc(
+            self.max_slots - len(active))
+        pos_cap = self.max_seq_len - 1
+        eos = self.eos_token_id
+        committed_total = proposed_total = accepted_total = 0
+        for i in active:
+            s = self._slots[i]
+            a = int(acc_np[i])
+            proposed_total += int(nprop_np[i])
+            accepted_total += a
+            r.histogram("serving.spec_accepted_len",
+                        buckets=_SPEC_LEN_BUCKETS).observe(a)
+            committed = ([int(t) for t in prop_np[i, :a]]
+                         + [int(g_np[i, a])])
+            for tok in committed:
+                s.tokens.append(tok)
+                s.tok = tok
+                s.pos += 1
+                s.count += 1
+                committed_total += 1
+                if ngram:
+                    self._history[i, min(s.pos, pos_cap)] = tok
+                self._positions[i] = s.pos
+                self._toks[i] = tok
+                self._counts[i] = s.count
+                if eos is not None and tok == int(eos):
+                    self._retire(i, "eos")
+                    break
+                if s.count >= s.req.max_new_tokens:
+                    self._retire(i, "length")
+                    break
+        self.stats["decode_tokens"] += committed_total
+        self.stats["spec_proposed"] += proposed_total
+        self.stats["spec_accepted"] += accepted_total
+        r.counter("serving.tokens_generated").inc(committed_total)
+        r.counter("serving.spec_proposed").inc(proposed_total)
+        r.counter("serving.spec_accepted").inc(accepted_total)
+        r.counter("serving.spec_rejected").inc(
+            proposed_total - accepted_total)
+        if self.stats["spec_proposed"]:
+            r.gauge("serving.spec_acceptance_rate").set(
+                self.stats["spec_accepted"]
+                / self.stats["spec_proposed"])
+        self._ewma_spec_tokens.update(committed_total / len(active))
+        self._tick_spec = (proposed_total, accepted_total)
+        tr = obs.active_tracer()
+        if tr is not None:
+            dur = dispatch_s + sync_s
+            tr.record("serving.spec_verify", ts=time.time() - dur,
+                      dur_s=dur, slots=len(active),
+                      proposed=proposed_total, accepted=accepted_total,
+                      committed=committed_total)
+        return dispatch_s, sync_s
+
+    def _after_flight(self):
+        """Post-event tail of a tick: flush any queued flight dump and
+        refresh the gauges."""
         if self._dump_pending is not None:
             self.flight.auto_dump(self._dump_pending)
             self._dump_pending = None
         self._update_gauges()
-        return dict(active=self.active_slots, queued=len(self._queue),
-                    finished=self._finished_tick)
 
     def _record_segments(self, admit_s, dispatch_s, sync_s):
         """Step-segment telemetry: cumulative stats + registry
@@ -1928,6 +2439,12 @@ class ServingEngine:
                "prefill_chunks": len(self._tick_chunks),
                "chunks": [[rid, st, nt]
                           for rid, st, nt in self._tick_chunks],
+               "spec_k": (self._spec_k if self.speculate is not None
+                          else None),
+               "spec_proposed": (None if self._tick_spec is None
+                                 else self._tick_spec[0]),
+               "spec_accepted": (None if self._tick_spec is None
+                                 else self._tick_spec[1]),
                "t_admit_s": round(admit_s, 6),
                "t_prefill_s": round(self._tick_prefill_s, 6),
                "t_dispatch_s": (None if dispatch_s is None
@@ -1997,7 +2514,8 @@ class ServingEngine:
         if self._closed:
             return
         self._closed = True
-        for a in (self.kv_pool, self._stacked):
+        for a in (self.kv_pool, self._stacked, self.draft_kv_pool,
+                  getattr(self, "_draft_stacked", None)):
             try:
                 if a is not None:
                     jax.tree_util.tree_map(
@@ -2009,6 +2527,13 @@ class ServingEngine:
         self._stacked = None
         self._dev = None
         self._step_fn = None
+        self.draft_kv_pool = None
+        self._draft_stacked = None
+        self._draft_dev = None
+        self._verify_fn = None
+        self._draft_fn = None
+        self._dev_hist = None
+        self._dev_prop = None
         self._jit_cache.clear()
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
@@ -2104,6 +2629,8 @@ class ServingEngine:
                   "shed_infeasible": self.shed_infeasible,
                   "chunk_tokens": self.chunk_tokens,
                   "decode_per_chunk": self.decode_per_chunk,
+                  "speculate": (self.speculate.to_config()
+                                if self.speculate is not None else None),
                   "sanitize": self._sanitize}
         fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
                        "dkv": self._dkv}
@@ -2189,6 +2716,15 @@ class ServingEngine:
                 f"{snap.get('schema')!r} != {ENGINE_SNAPSHOT_SCHEMA!r}")
         cfg = dict(snap["config"])
         cfg["cache_dtype"] = jnp.dtype(cfg["cache_dtype"])
+        spec_cfg = cfg.get("speculate")
+        if isinstance(spec_cfg, dict) and "speculate" not in overrides:
+            if spec_cfg.get("proposer") == "draft":
+                raise ValueError(
+                    "snapshot used the draft-model proposer; models "
+                    "don't serialize — pass speculate=SpecConfig(..., "
+                    "draft_model=...) as a restore override (or "
+                    "speculate=None to restore without speculation)")
+            cfg["speculate"] = SpecConfig(**spec_cfg)
         cfg.update(overrides)
         eng = cls(model, state=state, **cfg)
         fp = snap.get("model", {})

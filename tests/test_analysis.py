@@ -541,6 +541,69 @@ def test_chunked_compile_set_is_exactly_chunk_buckets():
         eng.drain()
 
 
+def test_speculative_compile_set_and_steady_tick():
+    """Speculative decoding's compile set is EXACTLY pinned: arming the
+    n-gram proposer costs ONE verify program on top of the prefill
+    shapes (the proposer runs inside it — no separate program); the
+    draft proposer adds exactly its prefill + round programs. A
+    covered-shape join still pays ZERO compiles, and steady speculative
+    ticks hold the sanitize invariant: 0 H2D + 0 compiles."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(3)
+    with serving.ServingEngine(
+            m, max_slots=2, block_tokens=32, max_seq_len=128,
+            prefix_caching=False, sanitize=True,
+            speculate=serving.SpecConfig(k=2)) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=30)
+        assert c.count == 2, c.events   # prefill(s_pad=32) + verify
+        # covered shape bucket: zero compiles, proposals re-prime on
+        # device without any new program
+        eng.submit(serving.Request(rng.randint(3, 500, (20,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=30)
+        assert c.count == 0, c.events
+        # steady speculative ticks: 0 H2D + 0 compiles
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=16))
+        eng.step()          # admission tick (dirty upload)
+        eng.step()          # first steady re-dispatch
+        guarded = 0
+        while eng.active_slots and guarded < 6:
+            with rt.no_transfer(what="steady speculative tick"), \
+                    rt.count_compiles() as c:
+                eng.step()
+            assert c.count == 0, c.events
+            guarded += 1
+        assert guarded == 6
+        assert eng.stats["sanitized_steps"] >= guarded
+        eng.drain()
+    # draft proposer: + draft prefill (per feed shape) + draft round
+    draft = _tiny_llama()
+    with serving.ServingEngine(
+            m, max_slots=2, block_tokens=32, max_seq_len=128,
+            prefix_caching=False,
+            speculate=serving.SpecConfig(
+                k=2, proposer="draft", draft_model=draft)) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=30)
+        # prefill + draft_prefill(s_pad=32) + draft round + verify
+        assert c.count == 4, c.events
+        eng.submit(serving.Request(rng.randint(3, 500, (20,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=30)
+        assert c.count == 0, c.events
+
+
 @pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
 def test_warm_generate_zero_transfers_zero_recompiles(cache_dtype):
     """A warm ``generate`` with device-resident inputs re-dispatches

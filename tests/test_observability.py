@@ -531,7 +531,10 @@ def test_serving_bench_smoke_emits_valid_schema():
     exercises the chunked-prefill scheduler end to end; the >=1.5x
     speedup itself is a full-size claim (the default b=8 mixed-length
     run documented in docs/SERVING.md), not asserted at this toy scale
-    where per-step dispatch overhead dominates."""
+    where per-step dispatch overhead dominates. The engine side also
+    runs SPECULATIVE (--speculate 2) so the not-slow lane exercises
+    the verify-dispatch scheduler and the spec schema fields end to
+    end."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", "serving_bench.py"),
@@ -539,7 +542,7 @@ def test_serving_bench_smoke_emits_valid_schema():
          "--requests", "6", "--slots", "2", "--min_prompt", "4",
          "--max_prompt", "12", "--min_new", "2", "--max_new", "8",
          "--sys_prompt_len", "16", "--reps", "1",
-         "--chunk_tokens", "16"],
+         "--chunk_tokens", "16", "--speculate", "2"],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -563,3 +566,11 @@ def test_serving_bench_smoke_emits_valid_schema():
     # chunked engine side: every prefill ran through chunk programs
     assert cont["chunk_tokens"] == 16
     assert cont["prefill_chunks"] >= 1
+    # speculative engine side: the typed-optional spec fields are
+    # present and valid (acceptance on this random toy mix is usually
+    # 0 — the value is not the claim, the schema is)
+    assert cont["speculate_k"] == 2
+    assert cont["proposer"] == "ngram"
+    assert 0.0 <= cont["acceptance_rate"] <= 1.0
+    assert isinstance(cont["accepted_len_hist"], dict)
+    assert sum(cont["accepted_len_hist"].values()) >= 1
