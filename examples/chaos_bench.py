@@ -51,17 +51,22 @@ from load_bench import calibrate, gen_arrivals, make_requests
 from serving_bench import build_model, build_speculate
 
 
-def build_engine(model, ns, flight_dump, speculate=None):
-    from paddle_tpu import serving
-
-    return serving.ServingEngine(
-        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+def engine_kwargs(ns, flight_dump, speculate=None):
+    return dict(
+        max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         flight_dump_path=flight_dump,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=speculate,
         max_queue=ns.max_queue, shed_infeasible=True)
+
+
+def build_engine(model, ns, flight_dump, speculate=None):
+    from paddle_tpu import serving
+
+    return serving.ServingEngine(
+        model, **engine_kwargs(ns, flight_dump, speculate))
 
 
 def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
@@ -109,6 +114,63 @@ def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
     return eng, accepted, rejected, restores, time.perf_counter() - t0
 
 
+def drive_chaos_router(rt, ns, reqs, arrivals):
+    """Open-loop drive of the replicated tier with whole-replica kills:
+    every ``--kill_replica_every`` router ticks a live replica is
+    killed abruptly (device state, queue, slots and uncollected results
+    dropped — the process-kill analog), alternating the restore path
+    (snapshots intact) with the redistribute path (the victim's
+    snapshot directory wiped first, so failover must re-place its
+    journaled requests onto the survivors). Engine-level faults
+    (``--fault_every``) still fire inside replica ticks — the router
+    absorbs those as replica step-crashes, never a driver crash.
+    Returns (accepted_ids, rejected, kills, wall_s)."""
+    from paddle_tpu import serving
+
+    n = len(reqs)
+    i = rejected = kills = 0
+    kill_cursor = 0
+    accepted = []
+    tick = 0
+    t0 = time.perf_counter()
+    while i < n or not rt.idle:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            r = reqs[i]
+            try:
+                rid = rt.submit(serving.Request(
+                    r["prompt"], max_new_tokens=r["budget"],
+                    priority=r.get("priority", "normal"),
+                    deadline_s=r.get("deadline")))
+                accepted.append(rid)
+            except serving.Rejected:
+                rejected += 1
+            i += 1
+        if rt.idle and i < n:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+            continue
+        rt.step()
+        tick += 1
+        if ns.kill_replica_every and tick % ns.kill_replica_every == 0 \
+                and kills < ns.max_kills:
+            live = rt.live_replicas
+            if len(live) > 1:
+                victim = live[kill_cursor % len(live)]
+                kill_cursor += 1
+                mode = "redistribute" if kills % 2 else "restore"
+                if mode == "redistribute":
+                    # wipe the victim's snapshots: failover MUST take
+                    # the journal re-placement path
+                    root = rt.replica_snapshot_root(victim)
+                    if root:
+                        shutil.rmtree(root, ignore_errors=True)
+                print(f"# kill #{kills + 1}: replica {victim} "
+                      f"(forcing {mode})", file=sys.stderr)
+                rt.kill_replica(victim)
+                kills += 1
+    return accepted, rejected, kills, time.perf_counter() - t0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama-tiny")
@@ -149,6 +211,22 @@ def main():
     ap.add_argument("--proposer", choices=("ngram", "draft"),
                     default="ngram")
     ap.add_argument("--draft_model", default="llama-tiny")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run the replicated tier: N engine replicas "
+                    "behind serving.Router (1 = single engine, the "
+                    "pre-router behavior). The zero-loss exit contract "
+                    "then covers WHOLE-REPLICA death: kills alternate "
+                    "the snapshot-restore and journal-redistribute "
+                    "failover paths")
+    ap.add_argument("--kill_replica_every", type=int, default=0,
+                    help="router mode: abruptly kill a live replica "
+                    "every N router ticks (0 = no kills), up to "
+                    "--max_kills")
+    ap.add_argument("--max_kills", type=int, default=3)
+    ap.add_argument("--snapshot_every", type=int, default=8,
+                    help="router mode: round-robin one replica "
+                    "snapshot through the integrity-manifest path "
+                    "every N router ticks")
     ap.add_argument("--verify", type=int, default=3,
                     help="completed requests spot-checked token-exact "
                     "against isolated generate (greedy only)")
@@ -179,19 +257,34 @@ def main():
             r["deadline"] = None
 
     speculate = build_speculate(ns)
-    eng = build_engine(model, ns, flight_dump, speculate)
+    if ns.replicas > 1:
+        ekw = engine_kwargs(ns, flight_dump, speculate)
+        ekw.pop("flight_dump_path")     # router forwards its own
+        eng = serving.Router(
+            model, replicas=ns.replicas, root=snap_root,
+            snapshot_every=ns.snapshot_every,
+            flight_dump_path=flight_dump, **ekw)
+    else:
+        eng = build_engine(model, ns, flight_dump, speculate)
     # calibration runs unshedded (the saturated closed-loop warmup
     # would shed itself against the bounded queue)
-    eng.shed_infeasible = False
-    eng.max_queue = None
+    if ns.replicas > 1:
+        eng.set_overload_controls(max_queue=None, shed_infeasible=False)
+    else:
+        eng.shed_infeasible = False
+        eng.max_queue = None
     calibrate(eng, reqs)
     eng.reset_stats()
     eng.results.clear()
     cap_tok_s, cap_rps = calibrate(eng, reqs)
     eng.reset_stats()
     eng.results.clear()
-    eng.shed_infeasible = True
-    eng.max_queue = ns.max_queue
+    if ns.replicas > 1:
+        eng.set_overload_controls(max_queue=ns.max_queue,
+                                  shed_infeasible=True)
+    else:
+        eng.shed_infeasible = True
+        eng.max_queue = ns.max_queue
     print(f"# calibrated capacity: {cap_tok_s:.1f} tokens/s "
           f"~ {cap_rps:.2f} req/s; offering {ns.load:g}x",
           file=sys.stderr)
@@ -205,9 +298,17 @@ def main():
     faults.arm(plan)
     arrivals = gen_arrivals(ns.requests, ns.load * cap_rps, "poisson",
                             rng)
+    kills = 0
+    failovers = None
     try:
-        eng, accepted, rejected, restores, wall = drive_chaos(
-            model, eng, ns, reqs, arrivals, snap_root, speculate)
+        if ns.replicas > 1:
+            accepted, rejected, kills, wall = drive_chaos_router(
+                eng, ns, reqs, arrivals)
+            failovers = eng.router_stats["failovers"]
+            restores = failovers
+        else:
+            eng, accepted, rejected, restores, wall = drive_chaos(
+                model, eng, ns, reqs, arrivals, snap_root, speculate)
     finally:
         faults.disarm()
 
@@ -264,7 +365,9 @@ def main():
                 cache_dtype=jnp.int8 if ns.cache_int8
                 else jnp.bfloat16))[0, len(res.prompt):]
             if res.tokens.tolist() != ref.tolist():
-                print(f"# PARITY FAILURE request {rid}", file=sys.stderr)
+                print(f"# PARITY FAILURE request {rid}: finish={res.finish} "
+                      f"got={res.tokens.tolist()} ref={ref.tolist()}",
+                      file=sys.stderr)
                 sys.exit(2)
             parity_checked += 1
 
@@ -276,6 +379,8 @@ def main():
         load_mult=ns.load, n_requests=ns.requests,
         offered_rps=round(ns.load * cap_rps, 4),
         faults_fired=fired, restores=restores,
+        replicas=ns.replicas, replica_kills=kills,
+        failovers=failovers,
         preemptions=reg.counter_total("serving.preemptions"),
         chunk_tokens=ns.chunk_tokens,
         # registry counter, not engine stats: each restore rebuilds the
@@ -299,8 +404,19 @@ def main():
         print("# faults fired but no restore happened — the chaos path "
               "was not exercised", file=sys.stderr)
         sys.exit(1)
-    print(f"# zero loss across {restores} restores / {fired} faults; "
-          f"shed {shed}/{ns.requests}, parity x{parity_checked} OK",
+    if ns.replicas > 1 and ns.kill_replica_every:
+        if kills == 0:
+            print("# kill schedule armed but no replica was killed — "
+                  "the replica-death path was not exercised",
+                  file=sys.stderr)
+            sys.exit(1)
+        if failovers < kills:
+            print(f"# {kills} kills but only {failovers} failovers — "
+                  f"a dead replica was never rebuilt", file=sys.stderr)
+            sys.exit(1)
+    print(f"# zero loss across {restores} restores / {fired} faults"
+          + (f" / {kills} replica kills" if kills else "")
+          + f"; shed {shed}/{ns.requests}, parity x{parity_checked} OK",
           file=sys.stderr)
 
 

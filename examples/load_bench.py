@@ -297,6 +297,11 @@ def main():
                     help="speculative proposer (see serving_bench)")
     ap.add_argument("--draft_model", default="llama-tiny",
                     help="draft model name for --proposer draft")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="drive the replicated tier (serving.Router "
+                    "over N engine replicas, prefix-affinity + least-"
+                    "loaded placement) instead of one engine — the "
+                    "tier's latency/throughput curve")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -316,8 +321,8 @@ def main():
 
     max_queue = (ns.max_queue if ns.max_queue is not None
                  else 4 * ns.slots) if ns.shed else None
-    eng = serving.ServingEngine(
-        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+    ekw = dict(
+        max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         prefix_caching=False, flight_dump_path=ns.flight_dump,
@@ -325,6 +330,11 @@ def main():
         decode_per_chunk=ns.decode_per_chunk,
         speculate=build_speculate(ns),
         sanitize=ns.sanitize)
+    if ns.replicas > 1:
+        eng = serving.Router(model, replicas=ns.replicas,
+                             snapshot_every=None, **ekw)
+    else:
+        eng = serving.ServingEngine(model, **ekw)
 
     rng = np.random.RandomState(ns.seed)
     reqs = make_requests(ns, rng)
@@ -337,8 +347,12 @@ def main():
     # shedding arms AFTER calibration (the saturated closed-loop pass
     # would otherwise shed its own warmup) — the measured points see the
     # bounded queue + infeasibility estimator
-    eng.max_queue = max_queue
-    eng.shed_infeasible = ns.shed
+    if ns.replicas > 1:
+        eng.set_overload_controls(max_queue=max_queue,
+                                  shed_infeasible=ns.shed)
+    else:
+        eng.max_queue = max_queue
+        eng.shed_infeasible = ns.shed
 
     curve = []
     loads = [float(x) for x in ns.loads.split(",") if x]
@@ -375,6 +389,7 @@ def main():
             step_breakdown_s=step_breakdown(st),
             shed_rate=round(shed / ns.requests, 4),
             preemptions=st["preemptions"],
+            replicas=ns.replicas,
             prompt_mix=ns.prompt_mix,
             chunk_tokens=ns.chunk_tokens,
             prefill_chunks=st["prefill_chunks"],

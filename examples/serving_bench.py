@@ -206,18 +206,24 @@ def spec_fields(eng, ns, hist_base=None):
 
 
 def run_continuous(model, reqs, ns):
-    """Drive a ServingEngine: virtual clock in decode steps — request i
-    joins the queue once ``arrival_step`` steps have run. Returns
-    (wall_s, engine)."""
+    """Drive a ServingEngine (or, with ``--replicas N``, the
+    replicated serving.Router tier — same submit/step surface): virtual
+    clock in decode steps — request i joins the queue once
+    ``arrival_step`` steps have run. Returns (wall_s, engine)."""
     from paddle_tpu import serving
 
-    eng = serving.ServingEngine(
-        model, max_slots=ns.slots, block_tokens=ns.block_tokens,
+    ekw = dict(
+        max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=build_speculate(ns),
         sanitize=getattr(ns, "sanitize", False))
+    if getattr(ns, "replicas", 1) > 1:
+        eng = serving.Router(model, replicas=ns.replicas,
+                             snapshot_every=None, **ekw)
+    else:
+        eng = serving.ServingEngine(model, **ekw)
     return drive(eng, reqs), eng
 
 
@@ -298,6 +304,10 @@ def main():
                     "match (no extra model) or a draft model")
     ap.add_argument("--draft_model", default="llama-tiny",
                     help="draft model name for --proposer draft")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="drive the continuous arm through the "
+                    "replicated tier (serving.Router over N engine "
+                    "replicas) instead of one engine")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -344,7 +354,9 @@ def main():
         w, useful_s, emitted_s = run_static(model, state, reqs,
                                             ns.slots, cdt)
         wall_s = min(wall_s, w)
-        if eng.prefix_cache is not None:
+        if ns.replicas > 1:
+            eng.clear_prefix_caches()
+        elif eng.prefix_cache is not None:
             eng.prefix_cache.clear()
         eng.reset_stats()
         # drop warmup/prior-rep results: ttft_p50 must cover ONE
@@ -361,8 +373,9 @@ def main():
     cont_tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall_c
     cont_occ = st["decode_tokens"] / max(
         st["decode_tokens"] + st["idle_slot_steps"], 1)
-    prefix_hit = (eng.prefix_cache.hit_rate
-                  if eng.prefix_cache is not None else 0.0)
+    prefix_hit = (eng.prefix_hit_rate if ns.replicas > 1
+                  else (eng.prefix_cache.hit_rate
+                        if eng.prefix_cache is not None else 0.0))
 
     from paddle_tpu import observability as obs
     # per-request tail latency over the measured pass (the sketch's 1%
@@ -397,7 +410,9 @@ def main():
         prefill_tokens_reused=st["prefill_tokens_reused"],
         chunk_tokens=ns.chunk_tokens,
         prefill_chunks=st["prefill_chunks"],
-        pool_blocks=eng.pool.num_blocks - 1,
+        replicas=ns.replicas,
+        pool_blocks=(eng.pool_blocks_total if ns.replicas > 1
+                     else eng.pool.num_blocks - 1),
         block_tokens=ns.block_tokens, **spec_fields(eng, ns),
         **slo.bench_fields(), **common)))
     eng.close()         # free the KV pool (back-to-back bench runs)

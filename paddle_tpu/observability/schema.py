@@ -80,6 +80,14 @@ _BENCH_OPTIONAL = {
     "proposer": str,
     "acceptance_rate": numbers.Real,
     "accepted_len_hist": dict,
+    # replicated-tier fields (chaos_bench/load_bench --replicas):
+    # replicas = engine replicas behind the serving router (null/1 =
+    # single engine), replica_kills = whole-replica kills injected over
+    # the run, failovers = dead replicas rebuilt (restore-or-
+    # redistribute, each zero-loss)
+    "replicas": numbers.Integral,
+    "replica_kills": numbers.Integral,
+    "failovers": numbers.Integral,
 }
 
 

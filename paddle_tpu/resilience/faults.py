@@ -30,6 +30,15 @@ site                        fired from / index
                             counter (a raising fault aborts the commit
                             BEFORE the manifest, so restore walks back
                             to the previous intact snapshot)
+``router.heartbeat``        ``serving.Router`` — call counter (one call
+                            per live replica per router tick, round
+                            robin). A raising fault IS a missed
+                            heartbeat: the router counts it against
+                            that replica's health state machine
+                            (healthy → suspect → dead) instead of
+                            propagating; enough consecutive misses
+                            declare the replica dead and trigger
+                            zero-loss failover
 ==========================  ================================================
 
 Zero-overhead contract: with no plan armed, ``maybe_fire`` is ONE global
@@ -67,7 +76,8 @@ COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat")
 #: cannot land without registering (and documenting) its site; `arm()`
 #: warns on plans naming unknown sites (tests may use ad-hoc ones).
 KNOWN_SITES = ("train.step", "checkpoint.save", "elastic.heartbeat",
-               "decode.dispatch", "kv.op", "serving.snapshot")
+               "decode.dispatch", "kv.op", "serving.snapshot",
+               "router.heartbeat")
 
 
 class SimulatedResourceExhausted(RuntimeError):

@@ -17,15 +17,18 @@ in the PAPERS lineage).
 
 from paddle_tpu.serving.engine import (  # noqa: F401
     ENGINE_SNAPSHOT_SCHEMA, PRIORITIES, Rejected, Request, RequestResult,
-    ServingEngine)
+    RestoreError, ServingEngine)
 from paddle_tpu.serving.pool import (  # noqa: F401
     SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
+from paddle_tpu.serving.router import (  # noqa: F401
+    REPLICA_STATES, ROUTER_JOURNAL_SCHEMA, Router, RouterJournal)
 from paddle_tpu.serving.spec import (  # noqa: F401
     PROPOSERS, SpecConfig)
 
 __all__ = [
     "Request", "RequestResult", "ServingEngine", "SpecConfig",
     "PROPOSERS", "BlockPool", "PoolExhausted", "PrefixCache",
-    "PrefixEntry", "SCRATCH_BLOCK", "Rejected", "PRIORITIES",
-    "ENGINE_SNAPSHOT_SCHEMA",
+    "PrefixEntry", "SCRATCH_BLOCK", "Rejected", "RestoreError",
+    "PRIORITIES", "ENGINE_SNAPSHOT_SCHEMA", "Router", "RouterJournal",
+    "ROUTER_JOURNAL_SCHEMA", "REPLICA_STATES",
 ]

@@ -39,10 +39,13 @@ tests/test_serving_robustness.py):
   ``priority`` classes order the queue; when a higher-priority request
   cannot be admitted, the scheduler retires the
   lowest-priority/loosest-deadline slot, frees its blocks and requeues
-  it with its generated-so-far tokens. Resume re-prefills
-  prompt+generated through the normal wave-prefill program and
-  continues sampling at ``fold_in(seed, count)`` — the same RNG stream
-  position an uninterrupted run would use, which is what keeps
+  it with its generated-so-far tokens. Resume re-prefills the PROMPT
+  through the normal wave-prefill program (bitwise the original
+  admission's program), REPLAYS the generated tokens through the real
+  decode step program (recomputing them via the prefill forward
+  rounds one bf16 ulp differently and can flip a near-tie argmax),
+  and continues sampling at ``fold_in(seed, count)`` — the same RNG
+  stream position an uninterrupted run would use. Together that keeps
   preempt/resume token-identical (greedy and sampled, bf16 and int8);
 * **crash-recoverable state** — :meth:`ServingEngine.snapshot` /
   :meth:`save_snapshot` serialize the queue, per-slot generated tokens
@@ -102,7 +105,8 @@ from paddle_tpu.serving.spec import SpecConfig
 logger = logging.getLogger("paddle_tpu.serving")
 
 __all__ = ["PRIORITIES", "Rejected", "Request", "RequestResult",
-           "ServingEngine", "SpecConfig", "ENGINE_SNAPSHOT_SCHEMA"]
+           "RestoreError", "ServingEngine", "SpecConfig",
+           "ENGINE_SNAPSHOT_SCHEMA"]
 
 ENGINE_SNAPSHOT_SCHEMA = "paddle_tpu.engine_snapshot/v1"
 
@@ -150,6 +154,25 @@ class Rejected(RuntimeError):
     ``deadline_infeasible`` (the EWMA capacity estimate says the
     request's deadline expires before its first token). Each rejection
     also increments ``serving.rejected{reason=...}``."""
+
+    def __init__(self, reason: str, msg: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class RestoreError(ValueError):
+    """Typed :meth:`ServingEngine.restore` failure.
+
+    ``reason`` is machine-readable: ``schema`` (the payload is not an
+    engine snapshot), ``model_fingerprint`` (the snapshot was taken on
+    a different architecture/layer-count/KV-width than the model being
+    restored onto — resuming would decode garbage KV), or
+    ``draft_model_missing`` (the snapshot armed the draft-model
+    proposer, whose model does not serialize — pass
+    ``speculate=SpecConfig(..., draft_model=...)`` as a restore
+    override). Subclasses ``ValueError`` so pre-existing callers that
+    caught that keep working; new callers (the serving router's
+    failover path) branch on ``reason`` instead of parsing messages."""
 
     def __init__(self, reason: str, msg: str):
         super().__init__(msg)
@@ -289,9 +312,10 @@ class _Slot:
         self.t_first: Optional[float] = None
         self.deadline_at: Optional[float] = None
         self.prefix_hit_blocks = prefix_hit_blocks
-        # what the prefill program runs over: the prompt for a fresh
-        # request, prompt+generated[:-1] for a preempt/restore resume
-        # (the final generated token is NOT appended — it becomes the
+        # what the prefill program runs over: the PROMPT (for fresh and
+        # resumed admissions alike — a resume's generated tokens replay
+        # through the decode step program afterwards, _replay_resume;
+        # the final generated token is never appended — it becomes the
         # next decode step's input, exactly where an uninterrupted run
         # left off)
         self.feed = feed
@@ -574,8 +598,21 @@ class ServingEngine:
         # ---- speculative decoding (docs/SERVING.md §Speculative) ----
         self.speculate = speculate
         self._spec_k = 0
-        self._verify_fn = None
-        self._draft_fn = None
+        self._verify_fns: Dict[int, object] = {}   # keyed by tail k
+        self._draft_fns: Dict[int, object] = {}
+        self._prop_zeros: Dict = {}     # ngram: per-k proposal reset
+        self._nprop_fulls: Dict = {}    # draft: per-k full-proposal consts
+        # per-slot adaptive k state (SpecConfig(adaptive=True)): the
+        # device-side proposal cap, its host mirror, the per-slot k and
+        # acceptance EWMAs, and the tick's effective tail width (max k
+        # over active slots — one batched program serves every slot)
+        self._spec_cap = None
+        self._dev_cap = None
+        self._spec_k_slot = None
+        self._spec_acc_ewma = None
+        self._spec_adapt_tick = 0
+        self._last_spec_k = None
+        self._spec_k_eff = 0
         self._history = None            # ngram: host mirror (ms, S)
         self._dev_hist = None           # ngram: device history twin
         self._dev_prop = None           # ngram: carried device proposals
@@ -598,17 +635,19 @@ class ServingEngine:
                     f"speculate k {speculate.k} must be < max_seq_len "
                     f"{max_seq_len}")
             self._spec_k = speculate.k
+            self._spec_cap = np.full(ms, speculate.k, np.int32)
+            self._spec_k_slot = np.full(ms, speculate.k, np.int32)
+            self._spec_acc_ewma = [_Ewma() for _ in range(ms)]
             if speculate.proposer == "ngram":
                 # the device-side suffix matcher runs over this carried
                 # committed-token buffer — uploaded only on dirty ticks
                 self._history = np.zeros((ms, max_seq_len), np.int32)
-                # the dirty-tick proposal reset, built ONCE: immutable
-                # device constants, so a join/leave tick re-arms the
-                # proposer without compiling a zeros program mid-drain
-                # (the compile-set pin in tests/test_analysis.py)
-                self._spec_prop_zero = (
-                    jnp.zeros((ms, speculate.k), jnp.int32),
-                    jnp.zeros((ms,), jnp.int32))
+                # the dirty-tick proposal reset, built ONCE per tail
+                # width: immutable device constants, so a join/leave
+                # tick re-arms the proposer without compiling a zeros
+                # program mid-drain (the compile-set pin in
+                # tests/test_analysis.py)
+                self._prop_zero(speculate.k)
             else:
                 from paddle_tpu.inference import _inference_state as _ist
                 dm = speculate.draft_model
@@ -655,9 +694,9 @@ class ServingEngine:
                 self._draft_tables = np.full(
                     (ms, self.max_blocks_per_slot), SCRATCH_BLOCK,
                     np.int32)
-                # draft proposals always fill all k slots
-                self._dev_nprop_full = jnp.full((ms,), speculate.k,
-                                                jnp.int32)
+                # draft proposals always fill all k slots (per-slot
+                # adaptive caps are applied inside the verify program)
+                self._nprop_full(speculate.k)
 
         self._slots: List[Optional[_Slot]] = [None] * ms
         self._queue = _PriorityQueue()
@@ -755,7 +794,7 @@ class ServingEngine:
         ``serving.step_*_s`` registry histograms."""
         return dict(steps=0, decode_tokens=0, idle_slot_steps=0,
                     prefill_tokens=0, prefill_tokens_reused=0,
-                    prefill_chunks=0,
+                    prefill_chunks=0, replay_tokens=0,
                     requests_finished=0, requests_admitted=0,
                     preemptions=0, requests_resumed=0,
                     requests_shed=0, requests_rejected=0,
@@ -821,7 +860,9 @@ class ServingEngine:
         if self._dump_pending is None:
             self._dump_pending = "shed"
 
-    def estimated_ttft_s(self, request: Request) -> Optional[float]:
+    def estimated_ttft_s(self, request: Request,
+                         default: Optional[float] = None
+                         ) -> Optional[float]:
         """EWMA-capacity estimate of ``request``'s queue-wait + prefill
         time (the earliest its first token could land): decode work
         ahead of it (active slots' remaining budgets + queued requests
@@ -834,11 +875,25 @@ class ServingEngine:
         prefill is priced as ceil(prompt/chunk_tokens) full chunks plus
         the ``decode_per_chunk`` decode dispatches interleaved between
         them. Fed by the same segment wall times the
-        ``serving.step_*_s`` histograms observe; None until the engine
-        has decoded at least one step (a cold engine must not shed on a
-        guess)."""
+        ``serving.step_*_s`` histograms observe.
+
+        **Cold convention** (the defined contract, not an accident): an
+        engine that has not completed one warm decode dispatch has NO
+        capacity estimate and returns ``default`` (``None`` unless
+        overridden) — never a guess. The two caller conventions:
+
+        * *admission* (``shed_infeasible``) treats cold as
+          never-shed — a request must not be rejected on zero
+          evidence (``default=None``, the engine's own use);
+        * *placement* (the serving :class:`~paddle_tpu.serving.Router`)
+          treats cold as maximally available — an idle just-added
+          replica should attract load so its estimate warms up
+          (``default=0.0``).
+
+        Callers that cannot special-case ``None`` pass the convention
+        they want as ``default`` instead of re-implementing it."""
         if self._ewma_step.value is None:
-            return None
+            return default
         step_s = self._ewma_step.value
         tok_s = self._ewma_prefill_tok.value or 0.0
         # only work at >= this request's priority counts as "ahead":
@@ -878,6 +933,50 @@ class ServingEngine:
         return (own + ahead_pf * tok_s
                 + (ahead / (self.max_slots * tpt)) * step_s)
 
+    def _check_fits(self, request: Request, count: bool):
+        """The structural admissibility checks shared by
+        :meth:`submit` and :meth:`admit_resumable` — ``count`` controls
+        whether a refusal lands on the ``serving.rejected`` telemetry
+        (submit's shed accounting; the force-admit path raises bare)."""
+        P = len(request.prompt)
+        worst = -(-(P + request.max_new_tokens - 1) // self.block_tokens)
+        if worst > self.max_blocks_per_slot:
+            if count:
+                self._count_rejected(request, "too_long")
+            raise ValueError(
+                f"request needs {worst} blocks "
+                f"({P}+{request.max_new_tokens} tokens) but max_seq_len "
+                f"{self.max_seq_len} caps a slot at "
+                f"{self.max_blocks_per_slot}")
+        # never-fits check: optimistic bound only — with prefix caching
+        # up to (P-1)//BT prompt blocks may be shared, so don't reject a
+        # request the cache could make admissible. The dtype-accurate
+        # reservation (int8 hits share NO physical blocks) lives in
+        # _admit, where an over-sized request queues instead of raising.
+        lookup = ((P - 1) // self.block_tokens
+                  if self.prefix_cache is not None else 0)
+        if worst - lookup > self.pool.num_blocks - 1:
+            if count:
+                self._count_rejected(request, "never_fits")
+                self.flight.auto_dump("pool_exhausted:submit")
+            raise PoolExhausted(
+                f"request needs at least {worst - lookup} blocks; the "
+                f"whole pool has {self.pool.num_blocks - 1}")
+
+    def _enqueue(self, request: Request) -> int:
+        """Seed assignment + submit stamping + queue push — the one
+        admission tail behind :meth:`submit` and
+        :meth:`admit_resumable`."""
+        if request.seed is None:
+            request.seed = self.seed + self._seeds_issued
+            self._seeds_issued += 1
+        request._t_submit = time.perf_counter()
+        request._seq = self._submit_seq
+        self._submit_seq += 1
+        self._queue.push(request)
+        self._update_gauges()
+        return request.request_id
+
     def submit(self, request) -> int:
         """Queue a request (accepts a :class:`Request` or a 1-D prompt).
         Returns the request id; the result lands in ``self.results``.
@@ -892,28 +991,7 @@ class ServingEngine:
             raise RuntimeError("ServingEngine is closed")
         if not isinstance(request, Request):
             request = Request(request)
-        P = len(request.prompt)
-        worst = -(-(P + request.max_new_tokens - 1) // self.block_tokens)
-        if worst > self.max_blocks_per_slot:
-            self._count_rejected(request, "too_long")
-            raise ValueError(
-                f"request needs {worst} blocks "
-                f"({P}+{request.max_new_tokens} tokens) but max_seq_len "
-                f"{self.max_seq_len} caps a slot at "
-                f"{self.max_blocks_per_slot}")
-        # never-fits check: optimistic bound only — with prefix caching
-        # up to (P-1)//BT prompt blocks may be shared, so don't reject a
-        # request the cache could make admissible. The dtype-accurate
-        # reservation (int8 hits share NO physical blocks) lives in
-        # _admit, where an over-sized request queues instead of raising.
-        lookup = ((P - 1) // self.block_tokens
-                  if self.prefix_cache is not None else 0)
-        if worst - lookup > self.pool.num_blocks - 1:
-            self._count_rejected(request, "never_fits")
-            self.flight.auto_dump("pool_exhausted:submit")
-            raise PoolExhausted(
-                f"request needs at least {worst - lookup} blocks; the "
-                f"whole pool has {self.pool.num_blocks - 1}")
+        self._check_fits(request, count=True)
         if self.shed_infeasible and request.deadline_s is not None:
             est = self.estimated_ttft_s(request)
             if est is not None and est > request.deadline_s:
@@ -933,15 +1011,52 @@ class ServingEngine:
                     f"queue at capacity ({self.max_queue}) with no "
                     f"lower-priority request to displace")
             self._shed_queued(victim, "displaced")
-        if request.seed is None:
-            request.seed = self.seed + self._seeds_issued
-            self._seeds_issued += 1
-        request._t_submit = time.perf_counter()
-        request._seq = self._submit_seq
-        self._submit_seq += 1
-        self._queue.push(request)
-        self._update_gauges()
-        return request.request_id
+        return self._enqueue(request)
+
+    def admit_resumable(self, request,
+                        tokens: Optional[Sequence[int]] = None) -> int:
+        """Force-admit a request BYPASSING the overload controls
+        (bounded queue, displacement, deadline-infeasibility shedding)
+        — the re-admission primitive behind :meth:`restore` and the
+        router's failover / drain migration. A request on this path was
+        already *accepted* once; shedding it now would turn a recovery
+        action into data loss, exactly what the zero-loss contract
+        forbids. ``tokens`` (generated so far) arms the token-exact
+        resume: the engine re-prefills the prompt, replays the tokens
+        through the decode step program and continues the request's
+        own ``fold_in(seed, count)`` stream, so the final tokens are
+        bit-identical to an uninterrupted run. The
+        *structural* checks still apply — a request that cannot fit a
+        slot (``ValueError``) or the whole pool (``PoolExhausted``)
+        raises exactly like :meth:`submit`; config-identical replicas
+        would have rejected it at the original accept too."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        if not isinstance(request, Request):
+            request = Request(request)
+        self._check_fits(request, count=False)
+        if tokens is not None:
+            request._resume_tokens = list(tokens) or None
+        return self._enqueue(request)
+
+    def inflight_tokens(self) -> Dict[int, List[int]]:
+        """``{request_id: generated-so-far tokens}`` for every
+        UNFINISHED request this engine holds — active decode slots, mid
+        prefill slots (which report the resume tokens they were
+        admitted with) and queued requests (their resume tokens, empty
+        for fresh ones). The router's per-tick progress mirror: by the
+        resume contract, re-placing a dead replica's request with any
+        *prefix* of its true token stream (whatever this method last
+        reported) stays token-exact."""
+        out: Dict[int, List[int]] = {}
+        for s in self._slots:
+            if s is None:
+                continue
+            out[s.req.request_id] = (list(s.resume or []) if s.prefilling
+                                     else list(s.tokens))
+        for r in self._queue.items():
+            out[r.request_id] = list(r._resume_tokens or [])
+        return out
 
     # ------------------------------------------------------------- prefill
     def _prefill_wave_fn(self, R, s_pad, n):
@@ -1302,6 +1417,11 @@ class ServingEngine:
             self._draft_tables[slot_idx][:] = SCRATCH_BLOCK
         if self._history is not None:
             self._history[slot_idx][:] = 0
+        if self._spec_cap is not None:
+            # a fresh occupant starts at the configured k, optimistic
+            self._spec_cap[slot_idx] = self._spec_k
+            self._spec_k_slot[slot_idx] = self._spec_k
+            self._spec_acc_ewma[slot_idx] = _Ewma()
         self._reserved -= s.worst_blocks - s.ntab
         self._slots[slot_idx] = None
         self._tables[slot_idx][:] = SCRATCH_BLOCK
@@ -1451,9 +1571,16 @@ class ServingEngine:
             req = self._queue.peek()
             rank = req.rank
             resume = req._resume_tokens
-            # tpu-lint: allow(host-sync): host token-list concat
-            feed = (req.prompt if not resume else np.concatenate(
-                [req.prompt, np.asarray(resume[:-1], np.int32)]))
+            # a resume prefills the PROMPT only — the same program and
+            # inputs as its original admission, so the prompt KV is
+            # bitwise the original's. Its generated tokens REPLAY
+            # through the real decode step program afterwards
+            # (_replay_resume): recomputing them through the batched
+            # prefill forward rounds differently in the last bf16 ulp
+            # than the per-token decode path that first produced them,
+            # and one ulp is enough to flip a near-tie argmax — the
+            # token-exact contract must not hinge on ties being rare
+            feed = req.prompt
             P = len(feed)
             n_lookup = (P - 1) // BT
             hits = (self.prefix_cache.lookup(feed, n_lookup,
@@ -1651,6 +1778,50 @@ class ServingEngine:
             new_toks = sum(len(s.feed) - s.R for _, s, _, _, _ in grp)
             self._ewma_prefill_tok.update(t_grp / max(new_toks, 1))
 
+    def _replay_resume(self, slot_idx: int, s: "_Slot"):
+        """Replay a resumed request's generated-so-far tokens through
+        the REAL decode step program, one forced token per dispatch,
+        every other batch row masked against scratch. Recomputing those
+        positions through the prefill forward would be cheaper (one
+        program) but rounds differently in the last bf16 ulp than the
+        per-token decode path that first produced them — and one ulp
+        flips a near-tie argmax, a token-parity break the zero-loss
+        contract cannot afford. Replaying the same program at the same
+        positions with the same inputs reproduces the uninterrupted
+        engine's KV bitwise (decode rows are batch-composition-
+        invariant — the PR 5 join/leave parity property). Cost:
+        ``len(resume) - 1`` dispatches per resume; resumes are
+        preemption/failover events, not the hot path."""
+        from paddle_tpu.observability import registry
+
+        if len(s.resume) <= 1:
+            return
+        if self._step_fn is None:
+            self._step_fn = self._build_step_fn()
+        ms = self.max_slots
+        tables = np.full((ms, self.max_blocks_per_slot), SCRATCH_BLOCK,
+                         np.int32)
+        positions = np.zeros(ms, np.int32)
+        toks = np.zeros(ms, np.int32)
+        seeds = np.zeros(ms, np.uint32)
+        counts = np.zeros(ms, np.int32)
+        seeds[slot_idx] = np.uint32(s.req.seed)
+        for j, tok in enumerate(s.resume[:-1]):
+            self._ensure_blocks(slot_idx)   # append position = s.pos
+            tables[slot_idx, :s.ntab] = s.blocks
+            positions[slot_idx] = s.pos
+            toks[slot_idx] = int(tok)
+            counts[slot_idx] = j + 1
+            _nxt, self.kv_pool, _pos, _cnt = self._step_fn(
+                self.kv_pool, jnp.asarray(tables),
+                jnp.asarray(positions), jnp.asarray(toks),
+                jnp.asarray(seeds), jnp.asarray(counts),
+                jnp.asarray(self._kv_scales))
+            s.pos += 1
+        n = len(s.resume) - 1
+        self.stats["replay_tokens"] += n
+        registry().counter("serving.replay_tokens").inc(n)
+
     def _adopt_slot(self, slot_idx: int, s: "_Slot", tok: int,
                     lanes_row, kv_row):
         """Join a fully-prefilled slot to the running decode batch: the
@@ -1690,6 +1861,12 @@ class ServingEngine:
             # surviving monotonic base; it restarts the clock)
             s.t_first = (req._t_first if req._t_first is not None
                          else time.perf_counter())
+            # the prefill above covered the PROMPT only (bitwise the
+            # original admission's program); the generated-so-far
+            # tokens replay through the real decode step program so
+            # the resumed KV is bitwise what the uninterrupted run
+            # held — advances s.pos to P + count - 1
+            self._replay_resume(slot_idx, s)
             r.counter("serving.resumed").inc()
         else:
             s.count = 1
@@ -1699,17 +1876,21 @@ class ServingEngine:
             r.counter("serving.tokens_generated").inc()
         if req.deadline_s is not None and s.deadline_at is None:
             s.deadline_at = req._t_submit + req.deadline_s
-        self._positions[slot_idx] = P
+        self._positions[slot_idx] = s.pos
         self._toks[slot_idx] = s.tok
         self._seeds[slot_idx] = np.uint32(req.seed)
         self._counts[slot_idx] = s.count
         if self._history is not None:
-            # ngram proposer: the committed tokens are the feed plus
-            # the slot's current last token (index P) — the suffix the
-            # device matcher extends
+            # ngram proposer: the committed tokens are the prompt, the
+            # replayed resume prefix, and the slot's current last token
+            # — the suffix the device matcher extends
+            # tpu-lint: allow(host-sync): host token-list concat
+            hist = (s.feed if not s.resume else np.concatenate(
+                [s.feed, np.asarray(s.resume[:-1], np.int32)]))
             self._history[slot_idx][:] = 0
-            self._history[slot_idx, :P] = s.feed
-            self._history[slot_idx, min(P, self.max_seq_len - 1)] = s.tok
+            self._history[slot_idx, :len(hist)] = hist
+            self._history[slot_idx,
+                          min(len(hist), self.max_seq_len - 1)] = s.tok
         if self._draft_tables is not None:
             self._run_draft_prefill(slot_idx, s)
         self.stats["prefill_tokens"] += P - s.R
@@ -1793,7 +1974,74 @@ class ServingEngine:
         return lambda *a: jitted(self._state, self._stacked, *a)
 
     # ------------------------------------------------- speculative decode
-    def _build_verify_fn(self):
+    def _prop_zero(self, K: int):
+        """The (proposals, nprop) reset pair for tail width ``K`` —
+        immutable device constants built once per width, so a dirty
+        tick re-arms the proposer without compiling a zeros program."""
+        z = self._prop_zeros.get(K)
+        if z is None:
+            z = (jnp.zeros((self.max_slots, K), jnp.int32),
+                 jnp.zeros((self.max_slots,), jnp.int32))
+            self._prop_zeros[K] = z
+        return z
+
+    def _nprop_full(self, K: int):
+        """The draft proposer's constant all-``K`` proposal count."""
+        a = self._nprop_fulls.get(K)
+        if a is None:
+            a = jnp.full((self.max_slots,), K, jnp.int32)
+            self._nprop_fulls[K] = a
+        return a
+
+    def _current_spec_k(self, active) -> int:
+        """This tick's verify-tail width: the configured k, or with
+        adaptive speculation the MAX per-slot k over the active slots
+        (one batched verify program serves every slot; slots below the
+        max are capped through the device-side ``cap`` vector). 0 means
+        the tick runs the plain per-token decode dispatch — the whole
+        point of adapting down on a low-acceptance mix."""
+        if not self.speculate.adaptive:
+            return self._spec_k
+        return int(max(self._spec_k_slot[i] for i in active))
+
+    def _adapt_spec_k(self, active, acc_np, nprop_np):
+        """Per-slot adaptive-k update off the acceptance EWMA (runs at
+        the end of each speculative tick). A k change is an EVENT: the
+        cap vector re-uploads and the carried proposals re-zero at the
+        (possibly) new tail width on the next tick — steady ticks with
+        a stable k stay 0-H2D."""
+        sc = self.speculate
+        K_eff = self._spec_k_eff
+        for i in active:
+            if self._slots[i] is None:      # retired in this tick's commit
+                continue
+            neff = min(int(nprop_np[i]), int(self._spec_cap[i]), K_eff)
+            if neff > 0:
+                self._spec_acc_ewma[i].update(int(acc_np[i]) / neff)
+        self._spec_adapt_tick += 1
+        if self._spec_adapt_tick % sc.adapt_every:
+            return
+        changed = False
+        for i in active:
+            if self._slots[i] is None:
+                continue
+            ew = self._spec_acc_ewma[i].value
+            if ew is None:
+                continue
+            k_i = int(self._spec_k_slot[i])
+            if ew < sc.acceptance_floor and k_i > sc.k_min:
+                k_i -= 1
+            elif ew > sc.acceptance_ceiling and k_i < sc.k:
+                k_i += 1
+            else:
+                continue
+            self._spec_k_slot[i] = k_i
+            self._spec_cap[i] = k_i
+            changed = True
+        if changed:
+            self._dirty = True
+
+    def _build_verify_fn(self, K: int):
         """ONE program per speculative tick: embed the K+1-token tail
         (last sampled token + K proposals) per slot, score it through
         ``fused_paged_verify_step`` (KV appended through the multi-token
@@ -1814,14 +2062,13 @@ class ServingEngine:
         temperature, top_k, top_p = (self.temperature, self.top_k,
                                      self.top_p)
         pos_cap = self.max_seq_len - 1
-        K = self._spec_k
         K1 = K + 1
         ngram = self.speculate.proposer == "ngram"
         nmax = self.speculate.ngram_max
         nmin = self.speculate.ngram_min
 
         def impl(state, stacked, pool, tables, positions, toks, seeds,
-                 counts, kv_scales, proposals, nprop, *hist):
+                 counts, kv_scales, proposals, nprop, cap, *hist):
             history = hist[0] if ngram else None
             plan_t = model.fused_decode_plan(state)
             blocks = plan_t.get("blocks")
@@ -1856,8 +2103,11 @@ class ServingEngine:
                     gs.append(_sample_logits(plan_t["head"](x[:, j]), ki,
                                              temperature, top_k, top_p))
             g = jnp.stack(gs, axis=1)                     # (b, K1)
+            # per-slot proposal cap: the adaptive-k vector (full k when
+            # adaptivity is off — the clamp is then a no-op)
+            nprop_eff = jnp.minimum(jnp.minimum(nprop, cap), K)
             match = (proposals == g[:, :K]) \
-                & (jnp.arange(K)[None] < nprop[:, None])
+                & (jnp.arange(K)[None] < nprop_eff[:, None])
             acc = jnp.cumprod(match.astype(jnp.int32),
                               axis=1).sum(axis=1)         # (b,)
             tok2 = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
@@ -1876,12 +2126,12 @@ class ServingEngine:
             hist2 = hist2.at[rows, pos2].set(tok2)
             prop2, nprop2 = ngram_propose(hist2, pos2 + 1, K, nmax, nmin)
             return (g, acc, pool, pos2, tok2, counts2, hist2, prop2,
-                    nprop2)
+                    jnp.minimum(nprop2, cap))
 
         jitted = jax.jit(impl, donate_argnums=(2,))
         return lambda *a: jitted(self._state, self._stacked, *a)
 
-    def _build_draft_fn(self):
+    def _build_draft_fn(self, K: int):
         """Draft-proposer round: ONE scanned program runs k+1 greedy
         draft decode steps over the draft's own paged pool (positions
         shared with the target — draft and target appends advance in
@@ -1898,7 +2148,6 @@ class ServingEngine:
         dm = self.speculate.draft_model
         dmeta = self._draft_meta
         darch = self._draft_arch
-        K = self._spec_k
         pos_cap = self.max_seq_len - 1
         cos_tab, sin_tab = self._draft_cos, self._draft_sin
 
@@ -1979,14 +2228,22 @@ class ServingEngine:
         monolithic even on chunked engines: the draft is small by
         contract, so one program over the whole feed doesn't move the
         chunked TPOT bound the way a target prefill would."""
-        P = len(s.feed)
+        # the draft rides the FULL committed context (prompt + replayed
+        # resume tokens): its KV is advisory — proposals only, the
+        # target's sample-match acceptance decides tokens — so the
+        # batched prefill recompute is fine here in a way it is not
+        # for the target's resumed KV (see _replay_resume)
+        # tpu-lint: allow(host-sync): host token-list concat
+        feed = (s.feed if not s.resume else np.concatenate(
+            [s.feed, np.asarray(s.resume[:-1], np.int32)]))
+        P = len(feed)
         BT = self.block_tokens
         dn0 = -(-P // BT)
         fresh = self._draft_pool_blocks.alloc(dn0 - len(s.dblocks))
         self._draft_tables[slot_idx, len(s.dblocks):dn0] = fresh
         s.dblocks.extend(fresh)
         ids = np.zeros((1, dn0 * BT), np.int32)
-        ids[0, :P] = s.feed
+        ids[0, :P] = feed
         fn, _cached = self._draft_prefill_fn(dn0 * BT)
         self.draft_kv_pool = fn(
             self.draft_kv_pool, jnp.asarray(ids),
@@ -2168,17 +2425,31 @@ class ServingEngine:
                     self._decode_since_chunk = 0
         dispatch_s = sync_s = None
         spec = self.speculate is not None
+        spec_tick = False
         # prefilling slots stay OUT of the decode batch: their mirror
         # rows idle against scratch until the last chunk adopts them
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and not s.prefilling]
         if active:
             if spec:
-                if self._verify_fn is None:
-                    self._verify_fn = self._build_verify_fn()
+                self._spec_k_eff = K_eff = self._current_spec_k(active)
+                spec_tick = K_eff > 0
+                if K_eff != self._last_spec_k:
+                    # a changed verify-tail width is an EVENT tick: the
+                    # carried proposals re-zero at the new width and the
+                    # mirrors (incl. the per-slot cap) re-upload
+                    self._dirty = True
+                    self._last_spec_k = K_eff
+            if spec_tick:
+                if K_eff not in self._verify_fns:
+                    self._verify_fns[K_eff] = self._build_verify_fn(K_eff)
                     if self.speculate.proposer == "draft":
-                        self._draft_fn = self._build_draft_fn()
+                        self._draft_fns[K_eff] = self._build_draft_fn(
+                            K_eff)
             elif self._step_fn is None:
+                # non-speculative engines AND adaptive ticks whose every
+                # active slot sits at k=0 ride the plain per-token
+                # dispatch — the "stops paying the verify tail" case
                 self._step_fn = self._build_step_fn()
             for i in active:
                 self._ensure_blocks(i, self._spec_k if spec else 0)
@@ -2203,14 +2474,17 @@ class ServingEngine:
                     # the device matcher re-primes them at the end of
                     # this tick's verify (one plain-decode tick per
                     # event, never a wrong speculation)
-                    self._dev_prop = self._spec_prop_zero
+                    self._dev_prop = (self._prop_zero(self._spec_k_eff)
+                                      if spec_tick else None)
+                if spec:
+                    self._dev_cap = jnp.asarray(self._spec_cap)
                 if self._draft_tables is not None:
                     self._draft_dev = jnp.asarray(self._draft_tables)
                 self._dirty = False
         # everything up to the dispatch call is the admit segment
         # (minus the prefill programs, which _run_prefill_group timed)
         admit_s = max(0.0, time.perf_counter() - t0 - self._tick_prefill_s)
-        if active and spec:
+        if active and spec_tick:
             dispatch_s, sync_s = self._spec_decode(active, steady)
         elif active:
             t_d0 = time.perf_counter()
@@ -2246,6 +2520,11 @@ class ServingEngine:
             r.counter("serving.tokens_generated").inc(len(active))
             r.counter("serving.idle_slot_steps").inc(
                 self.max_slots - len(active))
+            if spec:
+                # adaptive tick with every active slot at k=0: surface
+                # the degraded tail width (the verify path never runs
+                # here, so _spec_decode's gauge set cannot)
+                r.gauge("serving.spec_k_effective").set(0)
             for i in active:
                 s = self._slots[i]
                 tok = int(nxt[i])
@@ -2253,6 +2532,12 @@ class ServingEngine:
                 s.tok = tok
                 s.pos += 1
                 s.count += 1
+                if self._history is not None:
+                    # an adaptive spec engine on a plain (k=0) tick
+                    # keeps the HOST history current; the device twin
+                    # refreshes on the next event tick's dirty upload
+                    self._history[i, min(s.pos,
+                                         self.max_seq_len - 1)] = tok
                 self._positions[i] = s.pos
                 self._toks[i] = tok
                 self._counts[i] = s.count
@@ -2279,20 +2564,24 @@ class ServingEngine:
         from paddle_tpu.observability import registry
 
         ngram = self._history is not None
+        K_eff = self._spec_k_eff
+        verify_fn = self._verify_fns[K_eff]
+        draft_fn = self._draft_fns.get(K_eff)
         t_d0 = time.perf_counter()
 
         def dispatch():
-            if self._draft_fn is not None:
-                props, self.draft_kv_pool = self._draft_fn(
+            if draft_fn is not None:
+                props, self.draft_kv_pool = draft_fn(
                     self.draft_kv_pool, self._draft_dev, self._dev[1],
                     self._dev[2])
-                nprop = self._dev_nprop_full
+                nprop = self._nprop_full(K_eff)
             else:
                 props, nprop = self._dev_prop
-            args = (self.kv_pool, *self._dev, props, nprop)
+            args = (self.kv_pool, *self._dev, props, nprop,
+                    self._dev_cap)
             if ngram:
                 args += (self._dev_hist,)
-            return props, nprop, self._verify_fn(*args)
+            return props, nprop, verify_fn(*args)
 
         if self._sanitize and steady:
             from paddle_tpu.analysis import runtime as _sanitizer
@@ -2338,7 +2627,13 @@ class ServingEngine:
         for i in active:
             s = self._slots[i]
             a = int(acc_np[i])
-            proposed_total += int(nprop_np[i])
+            # the EFFECTIVE proposal count — what the verify program
+            # actually considered: min(raw nprop, per-slot adaptive
+            # cap, tail width). Counting the raw draft nprop would
+            # inflate spec_proposed/spec_rejected for capped slots and
+            # bias the acceptance-rate telemetry low.
+            proposed_total += min(int(nprop_np[i]),
+                                  int(self._spec_cap[i]), K_eff)
             accepted_total += a
             r.histogram("serving.spec_accepted_len",
                         buckets=_SPEC_LEN_BUCKETS).observe(a)
@@ -2373,8 +2668,11 @@ class ServingEngine:
             r.gauge("serving.spec_acceptance_rate").set(
                 self.stats["spec_accepted"]
                 / self.stats["spec_proposed"])
+        r.gauge("serving.spec_k_effective").set(K_eff)
         self._ewma_spec_tokens.update(committed_total / len(active))
         self._tick_spec = (proposed_total, accepted_total)
+        if self.speculate.adaptive:
+            self._adapt_spec_k(active, acc_np, nprop_np)
         tr = obs.active_tracer()
         if tr is not None:
             dur = dispatch_s + sync_s
@@ -2530,10 +2828,13 @@ class ServingEngine:
         self.draft_kv_pool = None
         self._draft_stacked = None
         self._draft_dev = None
-        self._verify_fn = None
-        self._draft_fn = None
+        self._verify_fns = {}
+        self._draft_fns = {}
+        self._prop_zeros = {}
+        self._nprop_fulls = {}
         self._dev_hist = None
         self._dev_prop = None
+        self._dev_cap = None
         self._jit_cache.clear()
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
@@ -2562,9 +2863,10 @@ class ServingEngine:
         deadline), finished results, the prefix-cache keys, and the
         constructor config + a model fingerprint. Token-exact by
         construction: a request's tokens and RNG seed are the COMPLETE
-        decode state — :meth:`restore` re-prefills prompt+generated and
-        continues the same ``fold_in(seed, count)`` stream, so KV never
-        needs to survive the crash.
+        decode state — :meth:`restore` re-prefills the prompt, replays
+        the generated tokens through the decode program and continues
+        the same ``fold_in(seed, count)`` stream, so KV never needs to
+        survive the crash.
 
         Call between ``step()`` calls, or after a ``step()`` that died
         on a fault — the host-side scheduler state stays consistent
@@ -2711,7 +3013,8 @@ class ServingEngine:
         snap = (cls.load_snapshot(source) if isinstance(source, str)
                 else source)
         if snap.get("schema") != ENGINE_SNAPSHOT_SCHEMA:
-            raise ValueError(
+            raise RestoreError(
+                "schema",
                 f"not an engine snapshot: schema "
                 f"{snap.get('schema')!r} != {ENGINE_SNAPSHOT_SCHEMA!r}")
         cfg = dict(snap["config"])
@@ -2719,7 +3022,8 @@ class ServingEngine:
         spec_cfg = cfg.get("speculate")
         if isinstance(spec_cfg, dict) and "speculate" not in overrides:
             if spec_cfg.get("proposer") == "draft":
-                raise ValueError(
+                raise RestoreError(
+                    "draft_model_missing",
                     "snapshot used the draft-model proposer; models "
                     "don't serialize — pass speculate=SpecConfig(..., "
                     "draft_model=...) as a restore override (or "
@@ -2731,7 +3035,9 @@ class ServingEngine:
         if fp and (fp.get("arch") != eng.arch
                    or fp.get("num_layers") != eng._num_layers
                    or fp.get("dkv") != eng._dkv):
-            raise ValueError(
+            eng.close()     # the mismatched engine must not leak its pool
+            raise RestoreError(
+                "model_fingerprint",
                 f"model mismatch: snapshot was taken on "
                 f"{fp}, restoring onto arch={eng.arch} "
                 f"L={eng._num_layers} dkv={eng._dkv}")

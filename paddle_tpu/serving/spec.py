@@ -56,20 +56,65 @@ class SpecConfig:
     tokenizer/vocab. ``ngram_max``/``ngram_min`` bound the suffix
     lengths the n-gram matcher tries (longest first).
 
+    ``adaptive=True`` arms per-slot adaptive k (docs/SERVING.md
+    §Speculative decoding): each slot carries an acceptance EWMA
+    (accepted/proposed per verify tick); every ``adapt_every`` spec
+    ticks a slot whose EWMA sits below ``acceptance_floor`` steps its
+    k down one (toward ``k_min``) and one above ``acceptance_ceiling``
+    steps it back up (toward ``k``). The tick's verify tail is sized
+    by the MAX k over active slots, so a replica whose whole mix has
+    low acceptance stops paying the k-token verify tail — with
+    ``k_min=0`` it degrades all the way to the plain per-token decode
+    dispatch. Committed tokens stay bit-identical at every k
+    (acceptance is exact sample-match; shorter proposals just commit
+    fewer per tick). ``k_min=0`` is one-way per slot: a slot at k=0
+    proposes nothing, so its EWMA can never observe acceptance again
+    until the slot retires — keep ``k_min>=1`` when the mix can turn
+    favorable mid-request.
+
     Everything is validated HERE with plain ``ValueError``s — a bad k
     must not surface deep inside the scheduler.
     """
 
     __slots__ = ("k", "proposer", "ngram_max", "ngram_min",
-                 "draft_model", "draft_state")
+                 "draft_model", "draft_state", "adaptive", "k_min",
+                 "acceptance_floor", "acceptance_ceiling", "adapt_every")
 
     def __init__(self, k: int = 4, proposer: str = "ngram",
                  ngram_max: int = 3, ngram_min: int = 1,
-                 draft_model=None, draft_state: Optional[dict] = None):
+                 draft_model=None, draft_state: Optional[dict] = None,
+                 adaptive: bool = False, k_min: int = 1,
+                 acceptance_floor: float = 0.35,
+                 acceptance_ceiling: float = 0.65,
+                 adapt_every: int = 4):
         if isinstance(k, bool) or not isinstance(k, numbers.Integral) \
                 or k < 1:
             raise ValueError(f"speculate k must be an int >= 1, got {k!r}")
         self.k = int(k)
+        self.adaptive = bool(adaptive)
+        if isinstance(k_min, bool) or not isinstance(k_min, numbers.Integral) \
+                or not 0 <= k_min <= k:
+            raise ValueError(
+                f"k_min must be an int in [0, k={k}], got {k_min!r}")
+        self.k_min = int(k_min)
+        for name, v in (("acceptance_floor", acceptance_floor),
+                        ("acceptance_ceiling", acceptance_ceiling)):
+            if not isinstance(v, numbers.Real) or isinstance(v, bool) \
+                    or not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v!r}")
+        if acceptance_floor > acceptance_ceiling:
+            raise ValueError(
+                f"acceptance_floor {acceptance_floor} > "
+                f"acceptance_ceiling {acceptance_ceiling} (the hysteresis "
+                f"band would thrash k every tick)")
+        self.acceptance_floor = float(acceptance_floor)
+        self.acceptance_ceiling = float(acceptance_ceiling)
+        if isinstance(adapt_every, bool) \
+                or not isinstance(adapt_every, numbers.Integral) \
+                or adapt_every < 1:
+            raise ValueError(
+                f"adapt_every must be an int >= 1, got {adapt_every!r}")
+        self.adapt_every = int(adapt_every)
         if proposer not in PROPOSERS:
             raise ValueError(f"unknown proposer {proposer!r}; one of "
                              f"{PROPOSERS}")
@@ -95,7 +140,11 @@ class SpecConfig:
         is not serializable — ``ServingEngine.restore`` demands it back
         as an override when the snapshot used the draft proposer."""
         return {"k": self.k, "proposer": self.proposer,
-                "ngram_max": self.ngram_max, "ngram_min": self.ngram_min}
+                "ngram_max": self.ngram_max, "ngram_min": self.ngram_min,
+                "adaptive": self.adaptive, "k_min": self.k_min,
+                "acceptance_floor": self.acceptance_floor,
+                "acceptance_ceiling": self.acceptance_ceiling,
+                "adapt_every": self.adapt_every}
 
 
 def ngram_propose(history, lengths, k: int, nmax: int, nmin: int):

@@ -604,6 +604,42 @@ def test_speculative_compile_set_and_steady_tick():
         assert c.count == 0, c.events
 
 
+def test_router_steady_state_zero_h2d_zero_recompiles():
+    """The replicated tier inherits the engine's steady-state claim:
+    after warmup, an event-free router tick — heartbeats, health
+    bookkeeping and one fused dispatch per replica — performs no
+    host->device transfer and compiles nothing, with every replica
+    running ``sanitize=True``."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(4)
+    with serving.Router(m, replicas=2, max_slots=2, block_tokens=32,
+                        max_seq_len=128, sanitize=True) as router:
+        # short prompts (no full affinity block) spread least-loaded
+        # across both replicas; each replica's prefill + step programs
+        # compile during these warmup ticks
+        for i in range(4):
+            router.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                          max_new_tokens=24, seed=i))
+            router.step()
+        assert all(e.active_slots
+                   for e in (router.replica_engine(0),
+                             router.replica_engine(1)))
+        router.step()           # first steady re-dispatch per replica
+        guarded = 0
+        while router.active_slots == 4 and guarded < 6:
+            with rt.no_transfer(what="steady router tick"), \
+                    rt.count_compiles() as c:
+                router.step()
+            assert c.count == 0, c.events
+            guarded += 1
+        assert guarded == 6
+        assert router.stats["sanitized_steps"] >= 2 * guarded
+        router.drain(max_steps=200)
+
+
 @pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
 def test_warm_generate_zero_transfers_zero_recompiles(cache_dtype):
     """A warm ``generate`` with device-resident inputs re-dispatches

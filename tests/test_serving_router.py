@@ -1,0 +1,405 @@
+"""Replicated serving tier (docs/SERVING.md §Replicated tier).
+
+The headline pins: whole-replica death is survived ZERO-LOSS with
+tokens bit-identical to an unfailed run, through BOTH failover paths —
+snapshot restore and journal re-placement onto survivors; placement is
+prefix-affine with a least-loaded fallback and tier-level typed
+shedding; the health state machine is driven through the
+``router.heartbeat`` fault site; elastic drain/add migrate work
+without dropping a request; and the durable journal survives corrupt
+lines and rebuilds a whole router after a process crash.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.inference import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import Fault, faults
+from paddle_tpu.serving.router import RouterJournal
+
+import jax.numpy as jnp
+
+
+def tiny_llama(L=2):
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=L,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return cfg, m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_llama()[1]
+
+
+def _router(model, tmp_path=None, replicas=2, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_tokens", 16)
+    kw.setdefault("max_seq_len", 64)
+    return serving.Router(
+        model, replicas=replicas,
+        root=str(tmp_path / "tier") if tmp_path is not None else None,
+        **kw)
+
+
+# ------------------------------------------------------------- placement
+
+def test_prefix_affinity_routes_same_prefix_together(model):
+    rng = np.random.RandomState(0)
+    prefix = rng.randint(3, 500, (16,))     # exactly one full block
+    with _router(model, replicas=3) as rt:
+        rids = []
+        for i in range(6):
+            p = np.concatenate([prefix, rng.randint(3, 500, (4,))])
+            rids.append(rt.submit(serving.Request(p, max_new_tokens=4,
+                                                  seed=i)))
+        homes = {rt._requests[r].replica for r in rids}
+        assert len(homes) == 1, homes   # one stable affinity home
+        # a different prefix may hash elsewhere; a short prompt (no
+        # full block) has no affinity and goes least-loaded — away
+        # from the loaded affinity home
+        short = rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                          max_new_tokens=4, seed=99))
+        assert rt._requests[short].replica not in homes
+        rt.drain(max_steps=300)
+        assert all(r in rt.results for r in rids)
+
+
+def test_estimated_ttft_cold_default_convention(model):
+    with serving.ServingEngine(model, max_slots=2, block_tokens=16,
+                               max_seq_len=64) as eng:
+        req = serving.Request(np.arange(8) + 3, max_new_tokens=4)
+        # cold: no warm decode dispatch yet -> default, never a guess
+        assert eng.estimated_ttft_s(req) is None
+        assert eng.estimated_ttft_s(req, default=0.0) == 0.0
+        eng.submit(req)
+        eng.drain(max_steps=100)
+        est = eng.estimated_ttft_s(
+            serving.Request(np.arange(8) + 3, max_new_tokens=4))
+        assert est is not None and est >= 0.0
+
+
+def test_tier_saturated_typed_shedding(model):
+    rng = np.random.RandomState(1)
+    with _router(model, replicas=2, max_queue=1) as rt:
+        # fill both replicas' slots AND their bounded queues without
+        # stepping; every further same-priority submit then sheds on
+        # every replica -> the router's tier-level typed rejection
+        for i in range(8):
+            try:
+                rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                          max_new_tokens=4, seed=i))
+            except serving.Rejected:
+                break
+        with pytest.raises(serving.Rejected) as ei:
+            for i in range(4):
+                rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                          max_new_tokens=4, seed=50 + i))
+        assert ei.value.reason == "tier_saturated"
+        assert rt.router_stats["rejected_tier"] >= 1
+        rt.drain(max_steps=400)
+
+
+# -------------------------------------------------------- health machine
+
+def test_heartbeat_faults_drive_suspect_then_dead_then_failover(model):
+    rng = np.random.RandomState(2)
+    with _router(model, replicas=2, dead_after=3) as rt:
+        rids = [rt.submit(serving.Request(rng.randint(3, 500, (10,)),
+                                          max_new_tokens=6, seed=i))
+                for i in range(3)]
+        rt.step()
+        # heartbeat calls round-robin live replicas each tick: replica
+        # 0 sees the even indices of the NEXT plan's counter
+        plan = faults.FaultPlan(
+            Fault("router.heartbeat", at=0), Fault("router.heartbeat", at=2),
+            Fault("router.heartbeat", at=4))
+        faults.arm(plan)
+        try:
+            rt.step()
+            assert rt.health()[0] == "suspect"      # 1 miss
+            rt.step()                               # 2 misses
+            assert rt.health()[0] == "suspect"
+            rt.step()                               # 3rd miss -> dead
+        finally:
+            faults.disarm()
+        # the dead replica was failed over within the tick (rebuilt)
+        assert rt.router_stats["replica_deaths"] == 1
+        assert rt.router_stats["failovers"] == 1
+        assert rt.health()[0] == "healthy"
+        assert rt.health()[1] == "healthy"          # never missed
+        rt.drain(max_steps=400)
+        assert all(r in rt.results for r in rids)
+
+
+# ---------------------------------------------------- zero-loss failover
+
+def _kill_parity(model, tmp_path, wipe_snapshots, temperature=0.0,
+                 cache_int8=False):
+    """Kill a replica mid-flight; every accepted request must finish
+    with tokens bit-identical to isolated generate (greedy and
+    sampled both ride per-request seeds)."""
+    rng = np.random.RandomState(3)
+    cdt = jnp.int8 if cache_int8 else jnp.bfloat16
+    prompts = [rng.randint(3, 500, (rng.randint(6, 20),))
+               for _ in range(6)]
+    budgets = [int(rng.randint(6, 14)) for _ in range(6)]
+    refs = [np.asarray(generate(
+        model, p[None], max_new_tokens=b, temperature=temperature,
+        cache_dtype=cdt, request_seeds=[100 + i]))[0, len(p):]
+        for i, (p, b) in enumerate(zip(prompts, budgets))]
+    rt = _router(model, tmp_path, replicas=2, snapshot_every=2,
+                 temperature=temperature, cache_dtype=cdt)
+    try:
+        rids = [rt.submit(serving.Request(p, max_new_tokens=b,
+                                          seed=100 + i))
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+        for _ in range(4):
+            rt.step()           # generate a few tokens + snapshots
+        victim = rt.live_replicas[0]
+        if wipe_snapshots:
+            import shutil
+            shutil.rmtree(rt.replica_snapshot_root(victim),
+                          ignore_errors=True)
+        rt.kill_replica(victim)
+        rt.drain(max_steps=600)
+        lost = [r for r in rids if r not in rt.results]
+        assert not lost, f"lost accepted requests: {lost}"
+        mode = "redistribute" if wipe_snapshots else "restore"
+        from paddle_tpu.observability import registry
+        assert registry().counter(
+            "serving.router.failovers", mode=mode).value >= 1
+        for i, r in enumerate(rids):
+            assert rt.results[r].tokens.tolist() == refs[i].tolist(), \
+                f"request {i} tokens diverged across {mode} failover"
+    finally:
+        rt.close()
+
+
+def test_kill_replica_restore_path_zero_loss_parity(model, tmp_path):
+    _kill_parity(model, tmp_path, wipe_snapshots=False)
+
+
+def test_kill_replica_redistribute_path_zero_loss_parity(model,
+                                                         tmp_path):
+    _kill_parity(model, tmp_path, wipe_snapshots=True)
+
+
+@pytest.mark.slow
+def test_kill_replica_parity_sampled(model, tmp_path):
+    _kill_parity(model, tmp_path, wipe_snapshots=True, temperature=0.8)
+
+
+@pytest.mark.slow
+def test_kill_replica_parity_int8(model, tmp_path):
+    _kill_parity(model, tmp_path, wipe_snapshots=False, cache_int8=True)
+
+
+def test_step_crash_fault_is_replica_level(model, tmp_path):
+    """An injected decode.dispatch fault inside a replica's tick is a
+    replica event (snapshot-at-crash + failover), never a router
+    crash — and loses nothing."""
+    rng = np.random.RandomState(4)
+    with _router(model, tmp_path, replicas=2) as rt:
+        rids = [rt.submit(serving.Request(rng.randint(3, 500, (10,)),
+                                          max_new_tokens=6, seed=i))
+                for i in range(4)]
+        rt.step()
+        with faults.plan(Fault("decode.dispatch", at=0)):
+            rt.step()           # fault fires inside one replica
+        assert rt.router_stats["failovers"] == 1
+        rt.drain(max_steps=400)
+        assert all(r in rt.results for r in rids)
+
+
+# ------------------------------------------------------------ elasticity
+
+def test_drain_replica_migrates_and_add_replica_joins(model, tmp_path):
+    rng = np.random.RandomState(5)
+    refs = {}
+    with _router(model, tmp_path, replicas=2) as rt:
+        rids = []
+        for i in range(4):
+            p = rng.randint(3, 500, (10,))
+            rids.append(rt.submit(serving.Request(p, max_new_tokens=8,
+                                                  seed=200 + i)))
+            refs[rids[-1]] = np.asarray(generate(
+                model, p[None], max_new_tokens=8,
+                request_seeds=[200 + i]))[0, len(p):]
+        rt.step()
+        idx = rt.add_replica()
+        assert idx == 2 and rt.health()[2] == "healthy"
+        migrated = rt.drain_replica(0)
+        assert rt.health()[0] == "removed"
+        rt.drain(max_steps=400)
+        for r in rids:
+            assert rt.results[r].tokens.tolist() == refs[r].tolist()
+        assert rt.router_stats["drains"] == 1
+        assert rt.router_stats["replaced"] >= len(migrated)
+        # the last live replicas cannot be drained away entirely
+        rt.drain_replica(1)
+        with pytest.raises(ValueError, match="last live replica"):
+            rt.drain_replica(2)
+
+
+# ------------------------------------------------ journal + recovery
+
+def test_journal_replay_skips_corrupt_lines(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RouterJournal(path)
+    for i in range(5):
+        j.append("accept", rid=i)
+    lines = open(path).read().splitlines()
+    lines[2] = lines[2][:-7] + 'corrupt'        # damage one mid line
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    events, corrupt = RouterJournal.replay(path)
+    assert corrupt == 1
+    assert [e["rid"] for e in events] == [0, 1, 3, 4]
+    # a torn (truncated) tail is skipped the same way
+    with open(path, "a") as f:
+        f.write('{"crc": 123, "p": "{\\"kind\\": \\"acc')
+    events, corrupt = RouterJournal.replay(path)
+    assert corrupt == 2 and len(events) == 4
+
+
+def test_router_recover_rebuilds_tier_from_journal(model, tmp_path):
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(3, 500, (10,)) for _ in range(4)]
+    refs = [np.asarray(generate(model, p[None], max_new_tokens=8,
+                                request_seeds=[300 + i]))[0, len(p):]
+            for i, p in enumerate(prompts)]
+    rt = _router(model, tmp_path, replicas=2, snapshot_every=2,
+                 journal_progress_every=1)
+    rids = [rt.submit(serving.Request(p, max_new_tokens=8, seed=300 + i))
+            for i, p in enumerate(prompts)]
+    for _ in range(3):
+        rt.step()
+    done_before = dict(rt.results)
+    # process crash analog: the router object is abandoned un-closed
+    root = rt.root
+    del rt
+    rt2 = serving.Router.recover(model, root, max_slots=2,
+                                 block_tokens=16, max_seq_len=64)
+    try:
+        rt2.drain(max_steps=400)
+        for i, r in enumerate(rids):
+            assert r in rt2.results, f"request {r} lost across recover"
+            assert rt2.results[r].tokens.tolist() == refs[i].tolist()
+        # results finished pre-crash came back from the journal
+        for r in done_before:
+            assert r in rt2.results
+    finally:
+        rt2.close()
+
+
+# ---------------------------------------------------- typed restore errors
+
+def test_restore_errors_are_typed(model, tmp_path):
+    cfg3, m3 = tiny_llama(L=3)
+    with serving.ServingEngine(model, max_slots=2, block_tokens=16,
+                               max_seq_len=64) as eng:
+        eng.submit(serving.Request(np.arange(8) + 3, max_new_tokens=4))
+        eng.step()
+        snap = eng.snapshot()
+    # wrong model fingerprint: typed, machine-readable reason
+    with pytest.raises(serving.RestoreError) as ei:
+        serving.ServingEngine.restore(m3, snap)
+    assert ei.value.reason == "model_fingerprint"
+    assert isinstance(ei.value, ValueError)     # old callers keep working
+    # not an engine snapshot at all
+    with pytest.raises(serving.RestoreError) as ei:
+        serving.ServingEngine.restore(model, {"schema": "bogus/v1"})
+    assert ei.value.reason == "schema"
+
+
+def test_restore_draft_snapshot_missing_model_is_typed(model):
+    _, draft = tiny_llama()
+    eng = serving.ServingEngine(
+        model, max_slots=2, block_tokens=16, max_seq_len=64,
+        speculate=serving.SpecConfig(k=2, proposer="draft",
+                                     draft_model=draft))
+    eng.submit(serving.Request(np.arange(10) + 3, max_new_tokens=4))
+    eng.step()
+    snap = eng.snapshot()
+    eng.close()
+    with pytest.raises(serving.RestoreError) as ei:
+        serving.ServingEngine.restore(model, snap)
+    assert ei.value.reason == "draft_model_missing"
+    # the documented fix works: hand the draft back as an override
+    eng2 = serving.ServingEngine.restore(
+        model, snap, speculate=serving.SpecConfig(
+            k=2, proposer="draft", draft_model=draft))
+    eng2.drain(max_steps=200)
+    eng2.close()
+
+
+# ------------------------------------------------------- bench duck-type
+
+def test_router_duck_types_engine_bench_surface(model):
+    rng = np.random.RandomState(7)
+    with _router(model, replicas=2) as rt:
+        assert rt.idle
+        rids = [rt.submit(rng.randint(3, 500, (8,)))   # bare prompt ok
+                for _ in range(3)]
+        assert not rt.idle
+        rt.drain(max_steps=300)
+        st = rt.stats
+        assert st["decode_tokens"] > 0 and st["requests_finished"] == 3
+        assert st["router_placed"] == 3
+        for r in rids:
+            rt.pop_result(r)
+        rt.reset_stats()
+        assert rt.stats["decode_tokens"] == 0
+    with pytest.raises(RuntimeError, match="closed"):
+        rt.submit(rng.randint(3, 500, (8,)))
+
+
+def test_engine_displacement_rescued_on_sibling_replica(model):
+    """A bounded-queue displacement inside one replica is only final
+    at TIER saturation: the router re-places the displaced accepted
+    request on a sibling with room instead of letting it end 'shed'."""
+    rng = np.random.RandomState(8)
+    prefix = rng.randint(3, 500, (16,))     # one full affinity block
+
+    def mk(seed, prio):
+        p = np.concatenate([prefix, rng.randint(3, 500, (4,))])
+        return serving.Request(p, max_new_tokens=6, seed=seed,
+                               priority=prio), p
+
+    with serving.Router(model, replicas=2, max_slots=2,
+                        block_tokens=16, max_seq_len=64, max_queue=1,
+                        affinity_overload_factor=1e9) as rt:
+        # fill the affinity home's two slots one at a time (the
+        # bounded queue holds only one waiter, so admissions must
+        # interleave with submits)
+        lows = []
+        for i in range(2):
+            r, _ = mk(700 + i, "low")
+            lows.append(rt.submit(r))
+            rt.step()
+        home = rt._requests[lows[0]].replica
+        assert all(rt._requests[r].replica == home for r in lows)
+        victim_req, victim_p = mk(703, "low")
+        victim = rt.submit(victim_req)      # fills home's queue (1/1)
+        assert rt._requests[victim].replica == home
+        ref = np.asarray(generate(model, victim_p[None],
+                                  max_new_tokens=6,
+                                  request_seeds=[703]))[0, len(victim_p):]
+        high, _ = mk(704, "high")
+        rt.submit(high)     # displaces the queued low inside the home
+        rt.drain(max_steps=400)
+        res = rt.results[victim]
+        assert res.finish != "shed", "displaced request ended shed " \
+            "while the sibling replica had room"
+        assert res.tokens.tolist() == ref.tolist()
+        assert rt._requests[victim].replica != home
+        assert rt.router_stats["replaced"] >= 1

@@ -107,7 +107,17 @@ def test_spec_config_validation():
         SpecConfig(proposer="draft")            # needs a draft model
     cfg = SpecConfig(k=3).to_config()
     assert cfg == {"k": 3, "proposer": "ngram", "ngram_max": 3,
-                   "ngram_min": 1}
+                   "ngram_min": 1, "adaptive": False, "k_min": 1,
+                   "acceptance_floor": 0.35, "acceptance_ceiling": 0.65,
+                   "adapt_every": 4}
+    with pytest.raises(ValueError, match="k_min"):
+        SpecConfig(k=2, k_min=3)
+    with pytest.raises(ValueError, match="acceptance_floor"):
+        SpecConfig(acceptance_floor=1.5)
+    with pytest.raises(ValueError, match="thrash"):
+        SpecConfig(acceptance_floor=0.8, acceptance_ceiling=0.2)
+    with pytest.raises(ValueError, match="adapt_every"):
+        SpecConfig(adapt_every=0)
     _, m = tiny_llama()
     with pytest.raises(ValueError):
         serving.ServingEngine(m, speculate="yes")   # not a SpecConfig
@@ -286,8 +296,10 @@ def test_spec_snapshot_restore_token_exact(tmp_path):
     root = str(tmp_path / "snap")
     eng.save_snapshot(root)
     snap = eng.snapshot()
-    assert snap["config"]["speculate"] == {"k": 3, "proposer": "ngram",
-                                           "ngram_max": 3, "ngram_min": 1}
+    assert snap["config"]["speculate"] == {
+        "k": 3, "proposer": "ngram", "ngram_max": 3, "ngram_min": 1,
+        "adaptive": False, "k_min": 1, "acceptance_floor": 0.35,
+        "acceptance_ceiling": 0.65, "adapt_every": 4}
     eng.close()
     eng2 = serving.ServingEngine.restore(m, root)
     assert eng2.speculate is not None and eng2.speculate.k == 3
@@ -488,3 +500,78 @@ def test_spec_engine_on_interpret_kernel_token_exact():
                    "FLAGS_pallas_strict": False})
     assert kern_toks == ref_toks
     assert st["spec_ticks"] > 0
+
+
+# ----------------------------------------------- per-slot adaptive k
+
+def test_adaptive_k_decays_on_low_acceptance_token_exact():
+    """A draft proposer with DIFFERENT weights proposes k tokens every
+    tick that almost never match the target's samples: the per-slot
+    acceptance EWMA decays the slot's k to k_min=0, after which ticks
+    ride the plain per-token dispatch (no verify tail, no draft round
+    — ``stats["steps"] > stats["spec_ticks"]``). Tokens stay
+    bit-identical to isolated generate at every k along the way."""
+    cfg, m = tiny_llama()
+    _, draft = tiny_llama(seed=7)       # different weights on purpose
+    rng = np.random.RandomState(11)
+    p = rng.randint(3, 512, (12,))
+    ref = np.asarray(generate(m, p[None], max_new_tokens=24,
+                              request_seeds=[42]))[0, len(p):]
+    eng = serving.ServingEngine(
+        m, max_slots=2, block_tokens=16, max_seq_len=64,
+        speculate=SpecConfig(k=3, proposer="draft", draft_model=draft,
+                             adaptive=True, k_min=0, adapt_every=1,
+                             acceptance_floor=0.5))
+    rid = eng.submit(serving.Request(p, max_new_tokens=24, seed=42))
+    eng.drain(max_steps=400)
+    assert eng.results[rid].tokens.tolist() == ref.tolist()
+    st = eng.stats
+    # the slot adapted down: later ticks ran WITHOUT the verify tail
+    assert st["spec_ticks"] < st["steps"], st
+    assert st["steps"] - st["spec_ticks"] >= 4, st
+    eng.close()
+
+
+def test_adaptive_k_holds_on_high_acceptance_token_exact():
+    """A repetitive prompt keeps the n-gram acceptance EWMA above the
+    ceiling: k never decays (every tick stays speculative) and tokens
+    stay bit-identical to isolated generate."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(12)
+    motif = rng.randint(3, 512, (6,))
+    p = np.tile(motif, 5)
+    ref = np.asarray(generate(m, p[None], max_new_tokens=20,
+                              request_seeds=[43]))[0, len(p):]
+    eng = serving.ServingEngine(
+        m, max_slots=2, block_tokens=16, max_seq_len=64,
+        speculate=SpecConfig(k=3, adaptive=True, k_min=1,
+                             adapt_every=2))
+    rid = eng.submit(serving.Request(p, max_new_tokens=20, seed=43))
+    eng.drain(max_steps=400)
+    assert eng.results[rid].tokens.tolist() == ref.tolist()
+    st = eng.stats
+    assert st["spec_ticks"] == st["steps"], st
+    # acceptance was genuinely high enough to hold k up
+    assert st["spec_accepted"] > 0
+    eng.close()
+
+
+def test_adaptive_config_survives_snapshot_roundtrip(tmp_path):
+    cfg, m = tiny_llama()
+    eng = serving.ServingEngine(
+        m, max_slots=2, block_tokens=16, max_seq_len=64,
+        speculate=SpecConfig(k=4, adaptive=True, k_min=2,
+                             acceptance_floor=0.2,
+                             acceptance_ceiling=0.9, adapt_every=3))
+    eng.submit(serving.Request(np.arange(10) + 3, max_new_tokens=6,
+                               seed=9))
+    eng.step()
+    snap = eng.snapshot()
+    eng.close()
+    eng2 = serving.ServingEngine.restore(m, snap)
+    sc = eng2.speculate
+    assert (sc.adaptive, sc.k_min, sc.acceptance_floor,
+            sc.acceptance_ceiling, sc.adapt_every) == (True, 2, 0.2,
+                                                       0.9, 3)
+    eng2.drain(max_steps=200)
+    eng2.close()
