@@ -78,8 +78,10 @@ def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
     (engine, accepted_ids, rejected, restores, wall_s)."""
     from paddle_tpu import serving
 
+    from paddle_tpu.analysis import runtime as rt_guard
+
     n = len(reqs)
-    i = rejected = restores = 0
+    i = rejected = restores = tick = 0
     accepted = []
     t0 = time.perf_counter()
     while i < n or not eng.idle:
@@ -111,6 +113,13 @@ def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
             ovr = {"speculate": speculate} if speculate is not None else {}
             eng = type(eng).restore(model, snap_root, **ovr)
             restores += 1
+        tick += 1
+        if ns.roundtrip_every and tick % ns.roundtrip_every == 0:
+            # state-protocol sanitizer: snapshot -> restore -> snapshot
+            # must be byte-identical mid-soak; SnapshotDriftError
+            # propagates (deliberately outside the chaos catch) and
+            # exits the bench non-zero
+            rt_guard.snapshot_roundtrip(eng)
     return eng, accepted, rejected, restores, time.perf_counter() - t0
 
 
@@ -126,10 +135,11 @@ def drive_chaos_router(rt, ns, reqs, arrivals):
     absorbs those as replica step-crashes, never a driver crash.
     Returns (accepted_ids, rejected, kills, wall_s)."""
     from paddle_tpu import serving
+    from paddle_tpu.analysis import runtime as rt_guard
 
     n = len(reqs)
     i = rejected = kills = 0
-    kill_cursor = 0
+    kill_cursor = roundtrip_cursor = 0
     accepted = []
     tick = 0
     t0 = time.perf_counter()
@@ -151,6 +161,14 @@ def drive_chaos_router(rt, ns, reqs, arrivals):
             continue
         rt.step()
         tick += 1
+        if ns.roundtrip_every and tick % ns.roundtrip_every == 0:
+            live = rt.live_replicas
+            if live:
+                # round-robin the roundtrip sanitizer over live
+                # replicas; drift propagates and fails the bench
+                victim = live[roundtrip_cursor % len(live)]
+                roundtrip_cursor += 1
+                rt_guard.snapshot_roundtrip(rt.replica_engine(victim))
         if ns.kill_replica_every and tick % ns.kill_replica_every == 0 \
                 and kills < ns.max_kills:
             live = rt.live_replicas
@@ -227,6 +245,12 @@ def main():
                     help="router mode: round-robin one replica "
                     "snapshot through the integrity-manifest path "
                     "every N router ticks")
+    ap.add_argument("--roundtrip_every", type=int, default=0,
+                    help="run the snapshot_roundtrip sanitizer every N "
+                    "driver ticks (0 = off): snapshot -> restore -> "
+                    "snapshot must be byte-identical in canonical form "
+                    "mid-soak; any drift exits non-zero (router mode "
+                    "round-robins the check over live replicas)")
     ap.add_argument("--verify", type=int, default=3,
                     help="completed requests spot-checked token-exact "
                     "against isolated generate (greedy only)")
@@ -298,6 +322,8 @@ def main():
     faults.arm(plan)
     arrivals = gen_arrivals(ns.requests, ns.load * cap_rps, "poisson",
                             rng)
+    from paddle_tpu.analysis.runtime import SnapshotDriftError
+
     kills = 0
     failovers = None
     try:
@@ -309,6 +335,11 @@ def main():
         else:
             eng, accepted, rejected, restores, wall = drive_chaos(
                 model, eng, ns, reqs, arrivals, snap_root, speculate)
+    except SnapshotDriftError as e:
+        # the exit contract: a snapshot that does not restore
+        # byte-identically is state-protocol corruption, not chaos
+        print(f"# SNAPSHOT ROUNDTRIP DRIFT: {e}", file=sys.stderr)
+        sys.exit(3)
     finally:
         faults.disarm()
 
@@ -388,6 +419,9 @@ def main():
         # survive the crash/restore loop like preemptions does
         prefill_chunks=reg.counter_total("serving.prefill_chunks"),
         shed_rate=round(shed / ns.requests, 4),
+        # registry counter (survives engine restores, spans replicas)
+        roundtrip_checks=reg.counter_total(
+            "serving.snapshot_roundtrips"),
         lost_requests=len(lost), finishes=finishes,
         flight_markers=markers, parity_checked=parity_checked,
         wall_s=round(wall, 3))

@@ -21,9 +21,12 @@ this package is lazy for that reason.
 from paddle_tpu.analysis.lint import (ALL_RULES, Finding, LintResult,
                                       run_lint)
 
-_RUNTIME_NAMES = ("CompileCounter", "RecompileError", "TransferError",
-                  "count_compiles", "no_recompile", "no_transfer",
-                  "sanitize", "compile_events_supported")
+_RUNTIME_NAMES = ("CompileCounter", "RecompileError",
+                  "SnapshotDriftError", "TransferError",
+                  "canonical_snapshot", "canonical_snapshot_bytes",
+                  "compare_snapshots", "count_compiles", "no_recompile",
+                  "no_transfer", "sanitize", "snapshot_roundtrip",
+                  "compile_events_supported")
 
 __all__ = ["ALL_RULES", "Finding", "LintResult", "run_lint",
            *_RUNTIME_NAMES]

@@ -41,6 +41,11 @@ _ALLOW_LINE = re.compile(
     r"#\s*tpu-lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 _ALLOW_FILE = re.compile(
     r"#\s*tpu-lint:\s*allow-file\(([a-z0-9_,\- ]+)\)")
+# `# tpu-lint: volatile(reason)` — the snapshot-coverage rule's
+# field-level classification: "this mutable field is rebuilt, not
+# serialized, and here is why". Sugar for allow(snapshot-coverage)
+# with the reason inside the parens (docs/ANALYSIS.md).
+_VOLATILE_LINE = re.compile(r"#\s*tpu-lint:\s*volatile\(")
 _ALLOW_FILE_SCAN_LINES = 30
 
 
@@ -105,6 +110,11 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, set], set]:
         m = _ALLOW_LINE.search(line)
         if m:
             allowed = {r.strip() for r in m.group(1).split(",")}
+        elif _VOLATILE_LINE.search(line):
+            allowed = {"snapshot-coverage"}
+        else:
+            allowed = None
+        if allowed:
             per_line.setdefault(i, set()).update(allowed)
             if line.lstrip().startswith("#"):
                 # comment-only pragma: cover the next statement's span

@@ -42,6 +42,39 @@ Rule catalog (docs/ANALYSIS.md has the workflow):
     registered in ``resilience.faults.KNOWN_SITES`` — an unregistered
     site is a hook the fault-injection docs and chaos tooling cannot
     see.
+
+``snapshot-coverage``
+    The state-protocol audit (docs/SERVING.md §Snapshot contract).
+    For every class carrying a snapshot protocol — it defines a save
+    method (``snapshot``/``to_config``, or journal-emitting methods)
+    AND a load method (``restore``/``recover``) — every MUTABLE
+    ``self._x`` assigned in ``__init__`` (mutable = reassigned or
+    mutated by another method) must be referenced by both the save and
+    load sides, or carry ``# tpu-lint: volatile(reason)``. Asymmetric
+    coverage (saved but never restored, or vice versa) is its own
+    finding. Owned state classes (``_Slot``) are checked against their
+    owner's protocol. "New engine field added, snapshot() silently
+    loses it" becomes a lint failure, not a chaos-soak surprise.
+
+``journal-coverage``
+    Every terminal request transition in ``serving/`` — a
+    ``RequestResult(...)`` construction, a ``results[...]`` store, a
+    tick transition-marker append — must live in a function that emits
+    a journal event or carries an annotation; every
+    ``journal.append("<kind>")`` literal must be registered in
+    ``serving.journal.KNOWN_EVENTS``, and every registered kind must
+    be emitted somewhere (stale-registry detection). The fault-site
+    rule's design, applied to the durability log.
+
+``rng-stream``
+    In ``serving/``/``inference/`` (request-serving code), every
+    ``jax.random.*`` draw must be keyed by a ``fold_in`` of a request
+    stream — locally, via a fold-returning helper, or via a parameter
+    whose in-package call sites all pass folded keys (callgraph-
+    resolved, with violating CALL SITES flagged). Raw ``PRNGKey`` /
+    ``split`` references are findings: an ad-hoc stream in serving
+    code silently breaks the batch-composition-invariant sampling
+    contract (tests/test_serving.py's parity pins).
 """
 
 import ast
@@ -49,8 +82,9 @@ import os
 import re
 from typing import Dict, Iterator, List, Optional, Set
 
-__all__ = ["Finding", "ALL_RULES", "KERNEL_DIRS", "collect_metric_names",
-           "known_fault_sites", "run_rules"]
+__all__ = ["Finding", "ALL_RULES", "KERNEL_DIRS", "SNAPSHOT_OWNED",
+           "collect_metric_names", "known_fault_sites",
+           "known_journal_events", "run_rules"]
 
 KERNEL_DIRS = ("paddle_tpu/ops", "paddle_tpu/inference",
                "paddle_tpu/serving")
@@ -602,6 +636,696 @@ def check_fault_site(sf: SourceFile, sites: Set[str]) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------- snapshot-coverage
+
+#: method names that SAVE a class's state / LOAD it back
+_SAVE_METHOD_NAMES = ("snapshot", "to_config")
+_LOAD_METHOD_NAMES = ("restore", "recover")
+#: methods whose self-stores do NOT make a field "mutable runtime
+#: state": construction, teardown, and the protocol methods themselves
+_MUTABILITY_EXEMPT = {"__init__", "close", "__exit__"}
+#: method calls that mutate their receiver in place — self._queue.push,
+#: self._open.add, self.prefix_cache.insert are state mutations even
+#: though no attribute store appears
+_MUTATOR_CALLS = {"append", "appendleft", "add", "insert", "update",
+                  "pop", "popleft", "push", "remove", "discard",
+                  "clear", "extend", "setdefault", "free"}
+#: state classes with no protocol of their own whose fields ride an
+#: owner's snapshot/restore (same file): owner class name per state
+#: class. The engine serializes _Slot state as resumable requests.
+SNAPSHOT_OWNED = {"_Slot": "ServingEngine"}
+
+
+def _store_target_attr(node, receiver: Optional[str] = "self"):
+    """The attribute name a store targets, peeling subscripts:
+    ``self.x = / self.x[i] = / self.x[i][:] =`` all mutate ``x``.
+    ``receiver=None`` matches any simple-name receiver."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and (receiver is None or node.value.id == receiver):
+        return node.attr
+    return None
+
+
+def _mutated_attrs(fn, receiver="self") -> Set[str]:
+    """Attribute names this function mutates on ``receiver``: direct /
+    subscript / augmented stores plus in-place mutator calls."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+                for e in elts:
+                    a = _store_target_attr(e, receiver)
+                    if a:
+                        out.add(a)
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            a = _store_target_attr(sub.target, receiver)
+            if a:
+                out.add(a)
+        elif isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _MUTATOR_CALLS:
+            a = _store_target_attr(sub.func.value, receiver)
+            if a:
+                out.add(a)
+    return out
+
+
+def _name_refs(fns) -> Set[str]:
+    """Every attribute name and string constant referenced in the given
+    function bodies — the (deliberately generous) "this side of the
+    protocol mentions the field" test. Engine fields are matched by
+    attribute reads (``self._seeds_issued``), owned-class fields by the
+    serialized dict keys (``rs["tokens"]``)."""
+    names: Set[str] = set()
+    for fn in fns:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Attribute):
+                names.add(sub.attr)
+            elif isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, str):
+                names.add(sub.value)
+    return names
+
+
+def _init_fields(init) -> Dict[str, ast.stmt]:
+    """attr -> FIRST ``self.x = ...`` statement in ``__init__`` (the
+    line findings anchor to and ``volatile(...)`` pragmas annotate)."""
+    fields: Dict[str, ast.stmt] = {}
+    if init is None:
+        return fields
+    for sub in ast.walk(init):
+        targets = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+            targets = [sub.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                fields.setdefault(t.attr, sub)
+    return fields
+
+
+def _journal_emitters(methods: Dict[str, ast.FunctionDef]) -> List[str]:
+    """Methods (other than __init__) containing a journal append — the
+    Router's save side IS its journal writes."""
+    out = []
+    for name, fn in methods.items():
+        if name == "__init__":
+            continue
+        if any(_journal_append_kind(sub) is not _NOT_JOURNAL
+               for sub in ast.walk(fn) if isinstance(sub, ast.Call)):
+            out.append(name)
+    return out
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def check_snapshot_coverage(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = {n.name: n for n in ast.walk(sf.tree)
+               if isinstance(n, ast.ClassDef)}
+    protocols = {}      # class name -> (save fns, load fns, methods)
+    for cname, cls in classes.items():
+        methods = _class_methods(cls)
+        save = [methods[n] for n in _SAVE_METHOD_NAMES if n in methods]
+        save += [methods[n] for n in _journal_emitters(methods)
+                 if methods[n] not in save]
+        load = [methods[n] for n in _LOAD_METHOD_NAMES if n in methods]
+        if "to_config" in methods and "__init__" in methods \
+                and not load:
+            # the SpecConfig pattern: to_config() round-trips through
+            # the constructor (restore does SpecConfig(**cfg))
+            load = [methods["__init__"]]
+        if save and load:
+            protocols[cname] = (save, load, methods)
+
+    def _fmt(fns):
+        names = sorted({f.name for f in fns})
+        if len(names) > 3:
+            return f"{names[0]}, {names[1]} (+{len(names) - 2} more)"
+        return ", ".join(names)
+
+    def _audit(fields, mutated, save, load, what, volatile_hint):
+        save_refs = _name_refs(save)
+        load_refs = _name_refs(load)
+        save_names = _fmt(save)
+        load_names = _fmt(load)
+        for attr in sorted(fields):
+            if attr not in mutated:
+                continue        # assigned once at construction: config
+            node = fields[attr]
+            saved = attr in save_refs or attr.lstrip("_") in save_refs
+            loaded = attr in load_refs or attr.lstrip("_") in load_refs
+            if saved and loaded:
+                continue
+            if saved:
+                findings.append(sf.finding(
+                    "snapshot-coverage", node,
+                    f"{what}.{attr} is saved by {save_names}() but "
+                    f"never restored by {load_names}() — asymmetric "
+                    f"snapshot coverage"))
+            elif loaded:
+                findings.append(sf.finding(
+                    "snapshot-coverage", node,
+                    f"{what}.{attr} is restored by {load_names}() but "
+                    f"never saved by {save_names}() — asymmetric "
+                    f"snapshot coverage"))
+            else:
+                findings.append(sf.finding(
+                    "snapshot-coverage", node,
+                    f"{what}.{attr} is mutable state not covered by "
+                    f"the snapshot protocol: serialize it in "
+                    f"{save_names}() + {load_names}(), or annotate "
+                    f"{volatile_hint}"))
+
+    for cname, (save, load, methods) in protocols.items():
+        fields = _init_fields(methods.get("__init__"))
+        if "__init__" in [f.name for f in load]:
+            # to_config-style: a field is loaded iff the constructor
+            # takes it back as a parameter
+            load_params = set()
+            for fn in load:
+                a = fn.args
+                load_params |= {p.arg for p in (a.posonlyargs + a.args
+                                                + a.kwonlyargs)}
+            mutated = set()
+        else:
+            load_params = set()
+            exempt = _MUTABILITY_EXEMPT \
+                | {f.name for f in save} | {f.name for f in load}
+            mutated = set()
+            for mname, fn in methods.items():
+                if mname not in exempt:
+                    mutated |= _mutated_attrs(fn)
+        if load_params:
+            # to_config classes: flag fields that don't round-trip
+            save_refs = _name_refs(save)
+            for attr in sorted(fields):
+                if attr in load_params:
+                    continue
+                if attr in save_refs:
+                    continue    # serialized but constructor-external
+                findings.append(sf.finding(
+                    "snapshot-coverage", fields[attr],
+                    f"{cname}.{attr} does not round-trip through "
+                    f"to_config() -> __init__(**cfg)"))
+            continue
+        _audit(fields, mutated, save, load, cname,
+               "`# tpu-lint: volatile(reason)`")
+
+    # owned state classes ride their owner's protocol: their fields
+    # must appear in the owner's save AND load bodies (serialized dict
+    # keys count), or be annotated volatile at their __init__ line
+    for owned_name, owner_name in sorted(SNAPSHOT_OWNED.items()):
+        if owned_name not in classes or owner_name not in protocols:
+            continue
+        save, load, owner_methods = protocols[owner_name]
+        owned_methods = _class_methods(classes[owned_name])
+        fields = _init_fields(owned_methods.get("__init__"))
+        exempt = _MUTABILITY_EXEMPT \
+            | {f.name for f in save} | {f.name for f in load}
+        mutated = set()
+        for mname, fn in owner_methods.items():
+            if mname not in exempt:
+                # stores on any receiver: the owner mutates slot
+                # objects through locals (s.pos = ..., s.tokens.append)
+                mutated |= _mutated_attrs(fn, receiver=None)
+        _audit(fields, mutated, save, load, owned_name,
+               "`# tpu-lint: volatile(reason)`")
+    return findings
+
+
+# ----------------------------------------------------- journal-coverage
+
+_JOURNAL_SCOPE = "paddle_tpu/serving/"
+_JOURNAL_REGISTRY_PATH = "paddle_tpu/serving/journal.py"
+#: the engine's per-tick transition markers: an append to one IS a
+#: request-state transition site (preempt/resume/retire/shed/finish)
+_TRANSITION_MARKERS = {"_tick_preempted", "_tick_resumed",
+                       "_tick_retired", "_tick_shed", "_finished_tick",
+                       "_pending_finished"}
+_NOT_JOURNAL = object()
+
+
+def _journal_append_kind(call: ast.Call):
+    """For ``<...journal...>.append(kind, ...)`` calls: the kind (a str
+    literal, or None for a non-literal kind). ``_NOT_JOURNAL`` for any
+    other call. The receiver chain must mention "journal" so list
+    appends and the tick markers never match."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr != "append":
+        return _NOT_JOURNAL
+    node, mentions = f.value, False
+    while isinstance(node, ast.Attribute):
+        mentions = mentions or "journal" in node.attr
+        node = node.value
+    if isinstance(node, ast.Name):
+        mentions = mentions or "journal" in node.id
+    if not mentions:
+        return _NOT_JOURNAL
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def known_journal_events(journal_source: str) -> Set[str]:
+    """Parse serving/journal.py for the KNOWN_EVENTS literal (dict or
+    tuple) without importing it — no jax on the lint path."""
+    tree = ast.parse(journal_source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_EVENTS":
+                    v = node.value
+                    if isinstance(v, ast.Dict):
+                        return {k.value for k in v.keys
+                                if isinstance(k, ast.Constant)}
+                    if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                        return {e.value for e in v.elts
+                                if isinstance(e, ast.Constant)}
+    return set()
+
+
+class _JournalVisitor(_FuncScoper):
+    def __init__(self, sf: SourceFile, events: Set[str],
+                 findings: List[Finding], emitted: Set[str]):
+        super().__init__()
+        self.sf = sf
+        self.events = events
+        self.findings = findings
+        self.emitted = emitted
+        # per function-frame: (anchor nodes, emits journal?)
+        self.frames: List = [[[], False]]
+
+    def enter_function(self, node, qualname):
+        self.frames.append([[], False])
+
+    def exit_function(self, node):
+        anchors, emits = self.frames.pop()
+        if emits or not anchors:
+            return
+        # ONE finding per transition function, anchored at its first
+        # transition statement — the site is the function, and one
+        # annotation should classify it
+        self.findings.append(self.sf.finding(
+            "journal-coverage", anchors[0],
+            f"terminal request transition in "
+            f"{'.'.join(self.stack) or '<module>'} emits no "
+            f"journal event — journal it (a KNOWN_EVENTS kind) or "
+            f"annotate why the protocol covers it elsewhere"))
+
+    def _anchor(self, node):
+        self.frames[-1][0].append(node)
+
+    def visit_Call(self, node):
+        kind = _journal_append_kind(node)
+        if kind is not _NOT_JOURNAL:
+            self.frames[-1][1] = True
+            if kind is None:
+                self.findings.append(self.sf.finding(
+                    "journal-coverage", node,
+                    "journal event kind must be a string literal so "
+                    "the registry pin can see it"))
+            else:
+                self.emitted.add(kind)
+                if kind not in self.events:
+                    self.findings.append(self.sf.finding(
+                        "journal-coverage", node,
+                        f"journal event {kind!r} is not registered in "
+                        f"serving.journal.KNOWN_EVENTS"))
+        else:
+            f = node.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else None)
+            if name == "RequestResult":
+                self._anchor(node)
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "append" \
+                    and _store_target_attr(f.value, None) \
+                    in _TRANSITION_MARKERS:
+                self._anchor(node)
+        self.generic_visit(node)
+
+    def _check_store(self, target):
+        if isinstance(target, ast.Subscript) \
+                and _store_target_attr(target, None) == "results":
+            self._anchor(target)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_store(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_store(node.target)
+        self.generic_visit(node)
+
+    def exit_module(self):
+        anchors, emits = self.frames[0]
+        if anchors and not emits:
+            self.findings.append(self.sf.finding(
+                "journal-coverage", anchors[0],
+                "terminal request transition at module level emits "
+                "no journal event"))
+
+
+def check_journal_coverage(files: Dict[str, "SourceFile"]
+                           ) -> List[Finding]:
+    reg_sf = files.get(_JOURNAL_REGISTRY_PATH)
+    events = (known_journal_events(reg_sf.source)
+              if reg_sf is not None else set())
+    findings: List[Finding] = []
+    emitted: Set[str] = set()
+    for path, sf in files.items():
+        if not path.startswith(_JOURNAL_SCOPE) \
+                or path == _JOURNAL_REGISTRY_PATH:
+            continue
+        v = _JournalVisitor(sf, events, findings, emitted)
+        v.visit(sf.tree)
+        v.exit_module()
+    if reg_sf is not None:
+        for kind in sorted(events - emitted):
+            # anchor at the KNOWN_EVENTS entry so the finding names the
+            # rotting registry line
+            line = next((i for i, text in enumerate(reg_sf.lines, 1)
+                         if f'"{kind}"' in text), 1)
+            findings.append(Finding(
+                "journal-coverage", reg_sf.path, line, 0,
+                f"KNOWN_EVENTS kind {kind!r} is registered but never "
+                f"emitted anywhere in serving/ — stale registry entry",
+                reg_sf.line_text(line)))
+    return findings
+
+
+# ---------------------------------------------------------- rng-stream
+
+_RNG_SCOPE = ("paddle_tpu/serving/", "paddle_tpu/inference/")
+#: jax.random samplers whose first argument is a PRNG key
+_RANDOM_DRAWS = {"categorical", "uniform", "normal", "gumbel",
+                 "bernoulli", "randint", "truncated_normal",
+                 "exponential", "choice", "permutation", "laplace",
+                 "logistic", "beta", "gamma", "poisson", "rademacher",
+                 "dirichlet", "shuffle"}
+#: raw stream constructors: creating/forking a stream in serving code
+#: is the finding — request code derives keys via fold_in
+_RAW_STREAMS = {"PRNGKey", "split", "key"}
+
+
+def _is_jax_random(node, random_aliases: Set[str]):
+    """(kind, name) when ``node`` references jax.random.<name> — via
+    the attribute chain or a from-import alias; (None, None) else."""
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        chain = []
+        while isinstance(base, ast.Attribute):
+            chain.append(base.attr)
+            base = base.value
+        if isinstance(base, ast.Name):
+            chain.append(base.id)
+        if "random" in chain:
+            return ("attr", node.attr)
+    if isinstance(node, ast.Name) and node.id in random_aliases:
+        return ("name", node.id)
+    return (None, None)
+
+
+def _random_from_imports(tree: ast.Module) -> Set[str]:
+    """Local names bound by ``from jax.random import X [as y]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "jax.random":
+            for a in node.names:
+                out.add(a.asname or a.name)
+    return out
+
+
+class _RngFuncInfo:
+    """One function's rng-relevant facts, kept for the cross-function
+    call-site pass."""
+
+    __slots__ = ("sf", "qualname", "params", "folded", "param_draws",
+                 "calls")
+
+    def __init__(self, sf, qualname, params):
+        self.sf = sf
+        self.qualname = qualname
+        self.params = params            # name -> position
+        self.folded: Set[str] = set()   # locals carrying folded keys
+        self.param_draws: List = []     # (param_name, draw node)
+        self.calls: List = []           # (callee name, call node)
+
+
+def _expr_is_folded(node, folded_vars: Set[str],
+                    folding_fns: Set[str]) -> bool:
+    """Does this expression derive from a fold_in? True when any node
+    within it references ``fold_in`` (jax.random.fold_in, vmapped or
+    not), calls a known fold-returning helper, or reads a local already
+    carrying a folded key."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "fold_in":
+            return True
+        if isinstance(sub, ast.Name) and (sub.id == "fold_in"
+                                          or sub.id in folded_vars):
+            return True
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            callee = (f.id if isinstance(f, ast.Name)
+                      else f.attr if isinstance(f, ast.Attribute)
+                      else None)
+            if callee in folding_fns:
+                return True
+    return False
+
+
+def _fn_params(args: ast.arguments) -> Dict[str, int]:
+    params = {}
+    for i, p in enumerate(args.posonlyargs + args.args):
+        params[p.arg] = i
+    for p in args.kwonlyargs:
+        params[p.arg] = -1
+    return params
+
+
+class _RngVisitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, random_aliases: Set[str],
+                 folding_fns: Set[str], infos: Dict[str, "_RngFuncInfo"],
+                 findings: List[Finding]):
+        self.sf = sf
+        self.aliases = random_aliases
+        self.folding_fns = folding_fns
+        self.infos = infos
+        self.findings = findings
+        self.stack: List[_RngFuncInfo] = []
+        self.qual: List[str] = []
+        # Lambda node -> positional application args (the
+        # ``jax.vmap(lambda k, ...)(key, ...)`` pattern): a draw keyed
+        # by a lambda param resolves through the applied argument. The
+        # application Call is visited BEFORE the Lambda it contains, so
+        # the mapping exists when the lambda frame is pushed.
+        self.lambda_apps: Dict[int, List] = {}
+        self.lambda_frames: List = []   # (params, applied args or None)
+
+    # ------------------------------------------------------------ defs
+    def _visit_func(self, node):
+        self.qual.append(node.name)
+        info = _RngFuncInfo(self.sf, ".".join(self.qual),
+                            _fn_params(node.args))
+        # two-pass local taint: locals assigned from folded expressions
+        for _ in range(2):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _expr_is_folded(
+                        sub.value, info.folded, self.folding_fns):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            info.folded.add(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            for e in t.elts:
+                                if isinstance(e, ast.Name):
+                                    info.folded.add(e.id)
+        self.infos.setdefault(node.name, []).append(info)
+        self.stack.append(info)
+        self.generic_visit(node)
+        self.stack.pop()
+        self.qual.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self.qual.append(node.name)
+        self.generic_visit(node)
+        self.qual.pop()
+
+    def visit_Lambda(self, node):
+        self.lambda_frames.append((_fn_params(node.args),
+                                   self.lambda_apps.get(id(node))))
+        self.generic_visit(node)
+        self.lambda_frames.pop()
+
+    # ----------------------------------------------------------- calls
+    def visit_Call(self, node):
+        # record lambda applications: (vmap-ish(lambda ...))(args) or
+        # (lambda ...)(args) — maps lambda params to applied exprs
+        if isinstance(node.func, ast.Lambda):
+            self.lambda_apps[id(node.func)] = list(node.args)
+        elif isinstance(node.func, ast.Call):
+            for a in node.func.args:
+                if isinstance(a, ast.Lambda):
+                    self.lambda_apps[id(a)] = list(node.args)
+        kind, name = _is_jax_random(node.func, self.aliases)
+        if kind and name in _RANDOM_DRAWS:
+            self._check_draw(node)
+        elif self.stack:
+            f = node.func
+            callee = (f.id if isinstance(f, ast.Name)
+                      else f.attr if isinstance(f, ast.Attribute)
+                      else None)
+            if callee is not None:
+                self.stack[-1].calls.append((callee, node))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self._check_raw(node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        self._check_raw(node)
+        self.generic_visit(node)
+
+    def _check_raw(self, node):
+        kind, name = _is_jax_random(node, self.aliases)
+        if name in _RAW_STREAMS and isinstance(getattr(
+                node, "ctx", None), ast.Load):
+            # flag the OUTERMOST reference only (jax.random.PRNGKey is
+            # one finding, not one per chain link); Name hits only for
+            # from-imports
+            if kind == "attr" or (kind == "name"
+                                  and name in self.aliases):
+                self.findings.append(self.sf.finding(
+                    "rng-stream", node,
+                    f"raw jax.random.{name} in request-serving code — "
+                    f"derive per-request keys via fold_in (or annotate "
+                    f"the sanctioned base-key builder)"))
+
+    def _key_expr(self, node: ast.Call):
+        if node.args:
+            return node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "key":
+                return kw.value
+        return None
+
+    def _check_draw(self, node: ast.Call):
+        key = self._key_expr(node)
+        info = self.stack[-1] if self.stack else None
+        folded = info.folded if info else set()
+        if key is None or _expr_is_folded(key, folded,
+                                          self.folding_fns):
+            return
+        if isinstance(key, ast.Name):
+            # a lambda param resolves through its application site:
+            # ``jax.vmap(lambda k, lg: draw(k, lg))(key, logits)``
+            # draws from whatever was applied at k's position
+            for params, applied in reversed(self.lambda_frames):
+                if key.id in params:
+                    pos = params[key.id]
+                    if applied is None or not 0 <= pos < len(applied):
+                        return          # unapplied lambda: blind spot
+                    key = applied[pos]
+                    break
+        if _expr_is_folded(key, folded, self.folding_fns):
+            return
+        if isinstance(key, ast.Name) and info is not None \
+                and key.id in info.params:
+            info.param_draws.append((key.id, node))
+            return
+        self.findings.append(self.sf.finding(
+            "rng-stream", node,
+            "jax.random draw keyed by a non-fold_in stream — request-"
+            "serving draws must fold a request seed (fold_in(key, t))"))
+
+
+def check_rng_stream(files: Dict[str, "SourceFile"]) -> List[Finding]:
+    scope = {p: sf for p, sf in files.items()
+             if p.startswith(_RNG_SCOPE)}
+    findings: List[Finding] = []
+    # fold-returning helpers, by bare name across the scope: a function
+    # whose body references fold_in returns folded keys (_fold_rows)
+    folding_fns: Set[str] = set()
+    for sf in scope.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(isinstance(s, ast.Attribute)
+                       and s.attr == "fold_in"
+                       or isinstance(s, ast.Name) and s.id == "fold_in"
+                       for s in ast.walk(node)):
+                    folding_fns.add(node.name)
+    infos: Dict[str, List[_RngFuncInfo]] = {}
+    for sf in scope.values():
+        v = _RngVisitor(sf, _random_from_imports(sf.tree), folding_fns,
+                        infos, findings)
+        v.visit(sf.tree)
+    # cross-function pass: a function drawing from its own parameter is
+    # fine IFF every in-scope call site passes a folded key (or its own
+    # parameter, which propagates the obligation) — flag the call site
+    forwarding: Dict[str, Set[int]] = {}    # fn name -> key positions
+    for name, fn_infos in infos.items():
+        for info in fn_infos:
+            for pname, _ in info.param_draws:
+                pos = info.params.get(pname, -1)
+                if pos >= 0:
+                    forwarding.setdefault(name, set()).add(pos)
+    changed = True
+    flagged: Set[int] = set()
+    while changed:
+        changed = False
+        for fn_infos in infos.values():
+            for info in fn_infos:
+                for callee, call in info.calls:
+                    for pos in forwarding.get(callee, ()):
+                        if pos >= len(call.args):
+                            continue
+                        arg = call.args[pos]
+                        if isinstance(arg, ast.Constant) \
+                                and arg.value is None:
+                            continue    # key=None: greedy, no draw
+                        if _expr_is_folded(arg, info.folded,
+                                           folding_fns):
+                            continue
+                        if isinstance(arg, ast.Name) \
+                                and arg.id in info.params:
+                            p = info.params[arg.id]
+                            name = info.qualname.rsplit(".", 1)[-1]
+                            if p >= 0 and p not in forwarding.get(
+                                    name, set()):
+                                forwarding.setdefault(name,
+                                                      set()).add(p)
+                                changed = True
+                            continue
+                        if id(call) not in flagged:
+                            flagged.add(id(call))
+                            findings.append(info.sf.finding(
+                                "rng-stream", call,
+                                f"passes a non-fold_in key into "
+                                f"{callee}(), which draws from it — "
+                                f"fold a request seed at this call "
+                                f"site"))
+    return findings
+
+
 # -------------------------------------------------------------- driver
 
 def _module_name(path: str) -> str:
@@ -613,7 +1337,8 @@ def _module_name(path: str) -> str:
 
 
 ALL_RULES = ("host-sync", "traced-branch", "default-dtype",
-             "metric-drift", "fault-site")
+             "metric-drift", "fault-site", "snapshot-coverage",
+             "journal-coverage", "rng-stream")
 
 
 def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
@@ -623,13 +1348,19 @@ def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
     per_file = {"host-sync": lambda sf: check_host_sync(sf, graph),
                 "traced-branch": lambda sf: check_traced_branch(sf, graph),
                 "default-dtype": check_default_dtype,
-                "fault-site": lambda sf: check_fault_site(sf, fault_sites)}
+                "fault-site": lambda sf: check_fault_site(sf, fault_sites),
+                "snapshot-coverage": check_snapshot_coverage}
+    aggregate = {"journal-coverage": check_journal_coverage,
+                 "rng-stream": check_rng_stream}
     for rule in rules:
         if rule == "metric-drift":
             sources = {p: sf.source for p, sf in files.items()}
             findings.extend(check_metric_drift(
                 sources, docs_text,
                 lambda p, ln: files[p].line_text(ln)))
+            continue
+        if rule in aggregate:
+            findings.extend(aggregate[rule](files))
             continue
         fn = per_file[rule]
         for sf in files.values():

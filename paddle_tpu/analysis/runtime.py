@@ -19,6 +19,12 @@ module enforces what a region *actually does* at runtime:
 * :func:`sanitize` — both at once; what ``ServingEngine(sanitize=True)``
   wraps steady-state dispatches in and the benches arm under
   ``--sanitize``.
+* :func:`snapshot_roundtrip` — the STATE-protocol guard (the runtime
+  half of the ``snapshot-coverage`` lint rule): snapshot → restore →
+  snapshot must be byte-identical in canonical form, or a serialized
+  field is rotting. ``ServingEngine(sanitize="roundtrip"|"all")`` runs
+  it on every ``save_snapshot``; ``chaos_bench --roundtrip_every N``
+  exercises it mid-soak.
 
 Guards compose with ``with`` nesting and are thread-visible the way
 jax's own context managers are; the compile listener is registered
@@ -27,15 +33,18 @@ a cache-hit dispatch), so leaving it registered is free on the hot
 path.
 """
 
+import json
 import threading
 from contextlib import contextmanager
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 
-__all__ = ["CompileCounter", "RecompileError", "TransferError",
+__all__ = ["CompileCounter", "RecompileError", "SnapshotDriftError",
+           "TransferError", "canonical_snapshot",
+           "canonical_snapshot_bytes", "compare_snapshots",
            "count_compiles", "no_recompile", "no_transfer", "sanitize",
-           "compile_events_supported"]
+           "snapshot_roundtrip", "compile_events_supported"]
 
 #: the monitoring event one real XLA backend compile emits (jax 0.4+);
 #: trace-only events (jaxpr_trace) deliberately NOT counted — a
@@ -164,3 +173,104 @@ def sanitize(what: str = "region", h2d: bool = True, d2h: bool = False,
     with no_transfer(h2d=h2d, d2h=d2h, what=what), \
             no_recompile(allow=allow_compiles, what=what):
         yield
+
+
+# ------------------------------------------------- snapshot round trip
+
+class SnapshotDriftError(RuntimeError):
+    """snapshot -> restore -> snapshot was not byte-identical in
+    canonical form: a serialized field is being lost, re-derived
+    differently, or restored asymmetrically."""
+
+
+def canonical_snapshot(snap: Dict) -> Dict:
+    """The canonical form of a ``paddle_tpu.engine_snapshot/v1`` dict:
+    everything the protocol promises to round-trip, nothing that is
+    volatile by contract. Slots and queue merge into ONE scheduling-
+    ordered request list — a just-restored engine holds every request
+    in its queue, so slot-vs-queue placement is scheduling state, not
+    protocol state. Excluded as volatile BY CONTRACT (docs/SERVING.md
+    §Snapshot contract): ``ts`` (wall clock), ``step_seq`` (restore
+    bumps it), ``prefix_keys`` (postmortem info; the cache rebuilds
+    from traffic), per-request ``chunk_filled`` (restore re-prefills
+    from tokens) and ``deadline_remaining_s`` (re-anchored to the
+    restore wall clock — only its None-ness is protocol state), and
+    the ``sanitize``/``flight_dump_path`` config knobs (debug guard
+    and postmortem sink — the roundtrip itself restores with the guard
+    off and the sink detached)."""
+    from paddle_tpu.serving.engine import _PRIORITY_RANK
+
+    reqs = []
+    for e in list(snap.get("slots", ())) + list(snap.get("queue", ())):
+        d = {k: v for k, v in e.items()
+             if k not in ("chunk_filled", "deadline_remaining_s")}
+        d["has_deadline"] = e.get("deadline_remaining_s") is not None
+        reqs.append(d)
+    reqs.sort(key=lambda d: (-_PRIORITY_RANK.get(d.get("priority",
+                                                       "normal"), 1),
+                             d.get("seq", 0)))
+    results = sorted(snap.get("results", ()),
+                     key=lambda r: r["request_id"])
+    config = {k: v for k, v in snap.get("config", {}).items()
+              if k not in ("sanitize", "flight_dump_path")}
+    return {"schema": snap.get("schema"), "config": config,
+            "model": snap.get("model"), "requests": reqs,
+            "results": results,
+            "seeds_issued": snap.get("seeds_issued"),
+            "submit_seq": snap.get("submit_seq")}
+
+
+def canonical_snapshot_bytes(snap: Dict) -> bytes:
+    return json.dumps(canonical_snapshot(snap), sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def compare_snapshots(snap1: Dict, snap2: Dict,
+                      what: str = "snapshot roundtrip"):
+    """Raise :class:`SnapshotDriftError` naming the first diverging
+    canonical section when the two snapshots differ."""
+    c1, c2 = canonical_snapshot(snap1), canonical_snapshot(snap2)
+    if c1 == c2:
+        return
+    for key in c1:
+        if c1[key] != c2[key]:
+            raise SnapshotDriftError(
+                f"{what}: canonical section {key!r} diverged —\n"
+                f"  before restore: {json.dumps(c1[key], sort_keys=True)[:400]}\n"
+                f"  after restore:  {json.dumps(c2[key], sort_keys=True)[:400]}")
+    raise SnapshotDriftError(f"{what}: snapshots diverged "
+                             f"(keys {sorted(c1)} vs {sorted(c2)})")
+
+
+def snapshot_roundtrip(engine, snap: Optional[Dict] = None):
+    """The state-protocol sanitizer: assert that restoring ``engine``'s
+    snapshot and re-snapshotting reproduces the SAME canonical bytes —
+    no field silently lost, none re-derived differently. Builds a real
+    restored engine (its own pool + programs) and closes it, so this is
+    a debug/chaos tier, not a hot-path guard. Returns the verified
+    snapshot. Raises :class:`SnapshotDriftError` on drift.
+
+    Wired in: ``ServingEngine(sanitize="roundtrip"|"all")`` runs this
+    inside every ``save_snapshot`` (the snapshot you are about to trust
+    is the one checked), and ``examples/chaos_bench.py
+    --roundtrip_every N`` calls it mid-soak."""
+    from paddle_tpu.observability import registry
+
+    snap1 = snap if snap is not None else engine.snapshot()
+    # the restored twin must neither recurse the roundtrip nor dump
+    # into the live engine's flight sink; the draft proposer's model
+    # does not serialize, so hand the live SpecConfig back
+    overrides = dict(sanitize=False, flight_dump_path=None)
+    if getattr(engine, "speculate", None) is not None:
+        overrides["speculate"] = engine.speculate
+    eng2 = type(engine).restore(engine.model, snap1,
+                                state=engine._state, **overrides)
+    try:
+        snap2 = eng2.snapshot()
+    finally:
+        eng2.close()
+    compare_snapshots(snap1, snap2)
+    engine.stats["roundtrip_checks"] = (
+        engine.stats.get("roundtrip_checks", 0) + 1)
+    registry().counter("serving.snapshot_roundtrips").inc()
+    return snap1

@@ -68,6 +68,8 @@ class StackedLlamaDecoder:
         layout via jax.random (no host->device transfer — materializing
         Llama-2-7B through a remote-TPU tunnel host-side takes tens of
         minutes; on-device it is seconds) and never held twice."""
+        # tpu-lint: allow(rng-stream): weight-init stream, not request
+        # sampling — request draws fold per-request seeds (PR 5)
         key = jax.random.PRNGKey(seed)
         L, h, ffn = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
         hd = cfg.head_dim
@@ -80,14 +82,17 @@ class StackedLlamaDecoder:
 
         def nxt():
             nonlocal key
+            # tpu-lint: allow(rng-stream): weight-init stream fork
             key, sub = jax.random.split(key)
             return sub
 
         def w(*shape, pad_axis=None, pad_to=0):
             if int8:
+                # tpu-lint: allow(rng-stream): weight-init draw
                 a = jax.random.randint(nxt(), shape, -127, 128,
                                        dtype=jnp.int8)
             else:
+                # tpu-lint: allow(rng-stream): weight-init draw
                 a = (jax.random.normal(nxt(), shape, jnp.float32)
                      * sd).astype(dtype)
             if pad_axis is not None and pad_to > shape[pad_axis]:
@@ -115,17 +120,20 @@ class StackedLlamaDecoder:
         if int8:
             params.update(wqkv_s=sc(dqkv), wo_s=sc(h), wg_s=sc(ffn, fp),
                           wu_s=sc(ffn, fp), wd_s=sc(h))
+        # tpu-lint: allow(rng-stream): weight-init draw
         embed_w = (jax.random.normal(nxt(), (cfg.vocab_size, h),
                                      jnp.float32) * sd).astype(dtype)
         norm_w = jnp.ones((h,), dtype)
         if cfg.tie_word_embeddings:
             head = ("tied",)
         elif int8:
+            # tpu-lint: allow(rng-stream): weight-init draw
             head = ("int8",
                     jax.random.randint(nxt(), (h, cfg.vocab_size), -127,
                                        128, dtype=jnp.int8),
                     jnp.full((cfg.vocab_size,), sd / 127.0, jnp.float32))
         else:
+            # tpu-lint: allow(rng-stream): weight-init draw
             head = ("dense",
                     (jax.random.normal(nxt(), (h, cfg.vocab_size),
                                        jnp.float32) * sd).astype(dtype))

@@ -80,6 +80,10 @@ _BENCH_OPTIONAL = {
     "proposer": str,
     "acceptance_rate": numbers.Real,
     "accepted_len_hist": dict,
+    # state-protocol sanitizer field (chaos_bench --roundtrip_every):
+    # snapshot->restore->snapshot byte-identity checks run mid-soak
+    # (analysis.runtime.snapshot_roundtrip; any drift exits non-zero)
+    "roundtrip_checks": numbers.Integral,
     # replicated-tier fields (chaos_bench/load_bench --replicas):
     # replicas = engine replicas behind the serving router (null/1 =
     # single engine), replica_kills = whole-replica kills injected over

@@ -301,15 +301,26 @@ class _Slot:
     def __init__(self, req: Request, worst_blocks: int,
                  prefix_hit_blocks: int, feed: np.ndarray,
                  resume: Optional[List[int]]):
+        # snapshot-coverage (docs/SERVING.md §Snapshot contract): a
+        # slot's tokens/seed ARE its complete resumable state — the
+        # cursor and KV fields below are volatile by design, rebuilt
+        # when restore() re-admits the request through the resume path
         self.req = req
+        # tpu-lint: volatile(reconstructed from tokens by resume replay)
         self.tok = 0            # last sampled, kv not yet appended
+        # tpu-lint: volatile(reconstructed from tokens by resume replay)
         self.pos = 0            # append position of the next decode step
+        # tpu-lint: volatile(count == len(tokens); resume re-derives it)
         self.count = 0          # tokens generated so far
         self.tokens: List[int] = []
+        # tpu-lint: volatile(pool KV never survives a crash by design)
         self.blocks: List[int] = []     # owned pool refs (shared + private)
+        # tpu-lint: volatile(block-table depth; re-derived at re-admission)
         self.ntab = 0                   # blocks allocated for this slot
         self.worst_blocks = worst_blocks
+        # tpu-lint: volatile(wall-clock; TTFT survives via req._t_first)
         self.t_first: Optional[float] = None
+        # tpu-lint: volatile(re-anchored from deadline_remaining_s)
         self.deadline_at: Optional[float] = None
         self.prefix_hit_blocks = prefix_hit_blocks
         # what the prefill program runs over: the PROMPT (for fresh and
@@ -328,13 +339,20 @@ class _Slot:
         # adopts. A prefilling slot stays OUT of the decode batch (its
         # mirror table row points at scratch) until its last chunk
         # samples the first token.
+        # tpu-lint: volatile(restore re-prefills from tokens; the
+        # serialized chunk cursor is informational)
         self.prefilling = False
+        # tpu-lint: volatile(chunk cursor; re-prefill restarts it)
         self.filled = 0
+        # tpu-lint: volatile(prefix depth; re-probed at re-admission)
         self.R = 0                      # prefix-hit depth in tokens
+        # tpu-lint: volatile(device KV carry between chunk programs)
         self.carry = None
+        # tpu-lint: volatile(prefix-cache refs; re-probed at re-admission)
         self.hits = None
         # draft-proposer block table rows (speculative engines with a
         # draft model: the draft's KV pages for this slot)
+        # tpu-lint: volatile(draft pages rebuilt at resume adoption)
         self.dblocks: List[int] = []
 
 
@@ -550,9 +568,15 @@ class ServingEngine:
                 num_blocks = max(2, int(pool_bytes) // bpb)
             else:   # worst case: every slot filled to max_seq_len
                 num_blocks = max_slots * self.max_blocks_per_slot + 1
+        # tpu-lint: volatile(occupancy re-derives as restored requests
+        # re-admit; num_blocks rides the snapshot config)
         self.pool = BlockPool(num_blocks, block_tokens)
+        # tpu-lint: volatile(device KV never survives a crash by design
+        # — restore re-prefills prompts and replays generated tokens)
         self.kv_pool = jnp.zeros(
             (L, num_blocks, block_tokens, 2 * self._dkv), self.cache_dtype)
+        # tpu-lint: volatile(rebuilds from traffic; snapshot keys are
+        # postmortem info only)
         self.prefix_cache = (PrefixCache(self.pool, prefix_cache_blocks)
                              if prefix_caching else None)
 
@@ -585,45 +609,87 @@ class ServingEngine:
         self._cos_tab, self._sin_tab = rope_ops.rope_cos_sin(
             max_seq_len, hd, base=meta["rope_base"])
 
-        # host mirrors of the per-slot device state
+        # host mirrors of the per-slot device state — all volatile:
+        # resume admission rebuilds every row from the serialized
+        # (tokens, seed) resumable requests
         ms = self.max_slots
+        # tpu-lint: volatile(rebuilt by resume admission)
         self._tables = np.full((ms, self.max_blocks_per_slot),
                                SCRATCH_BLOCK, np.int32)
+        # tpu-lint: volatile(rebuilt by resume admission)
         self._positions = np.zeros(ms, np.int32)
+        # tpu-lint: volatile(rebuilt by resume admission)
         self._toks = np.zeros(ms, np.int32)
+        # tpu-lint: volatile(rebuilt by resume admission)
         self._seeds = np.zeros(ms, np.uint32)
+        # tpu-lint: volatile(rebuilt by resume admission)
         self._counts = np.zeros(ms, np.int32)
+        # tpu-lint: volatile(int8 calibration reproduces scales exactly)
         self._kv_scales = np.ones((L, ms, 2 * self._dkv), np.float32)
 
         # ---- speculative decoding (docs/SERVING.md §Speculative) ----
         self.speculate = speculate
         self._spec_k = 0
+        # tpu-lint: volatile(compiled-program cache)
         self._verify_fns: Dict[int, object] = {}   # keyed by tail k
+        # tpu-lint: volatile(compiled-program cache)
         self._draft_fns: Dict[int, object] = {}
+        # tpu-lint: volatile(device constants, rebuilt per tail width)
         self._prop_zeros: Dict = {}     # ngram: per-k proposal reset
+        # tpu-lint: volatile(device constants, rebuilt per tail width)
         self._nprop_fulls: Dict = {}    # draft: per-k full-proposal consts
         # per-slot adaptive k state (SpecConfig(adaptive=True)): the
         # device-side proposal cap, its host mirror, the per-slot k and
         # acceptance EWMAs, and the tick's effective tail width (max k
         # over active slots — one batched program serves every slot)
+        # tpu-lint: volatile(adaptive k restarts at the configured k —
+        # acceptance re-learns after restore, documented in SERVING.md)
         self._spec_cap = None
+        # tpu-lint: volatile(device twin; re-uploads on dirty ticks)
         self._dev_cap = None
+        # tpu-lint: volatile(adaptive k restarts at the configured k)
         self._spec_k_slot = None
+        # tpu-lint: volatile(acceptance EWMA re-learns after restore)
         self._spec_acc_ewma = None
+        # tpu-lint: volatile(adapt cadence counter)
         self._spec_adapt_tick = 0
+        # tpu-lint: volatile(tail-width change detector)
         self._last_spec_k = None
+        # tpu-lint: volatile(per-tick effective tail width)
         self._spec_k_eff = 0
+        # tpu-lint: volatile(re-primed from committed tokens at adoption)
         self._history = None            # ngram: host mirror (ms, S)
+        # tpu-lint: volatile(device twin; re-uploads on dirty ticks)
         self._dev_hist = None           # ngram: device history twin
+        # tpu-lint: volatile(re-primed by the next verify dispatch)
         self._dev_prop = None           # ngram: carried device proposals
+        # tpu-lint: volatile(device twin; re-uploads on dirty ticks)
         self._draft_dev = None          # draft: device block-table twin
+        # tpu-lint: volatile(rebuilt by resume adoption)
         self._draft_tables = None
+        # tpu-lint: volatile(draft pages rebuilt at resume adoption)
         self._draft_pool_blocks = None
+        # tpu-lint: volatile(draft KV re-prefills at resume adoption)
         self.draft_kv_pool = None
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_spec = None          # (proposed, accepted) this tick
+        # k=0 recovery probing (SpecConfig(adaptive=True, k_min=0);
+        # docs/SERVING.md §Speculative decoding): a slot parked at k=0
+        # proposes nothing, so its acceptance EWMA can never observe
+        # again — every `adapt_every` parked ticks the engine raises
+        # the slot's cap to ONE proposal for a two-tick window so the
+        # EWMA re-observes and the slot can climb back
+        # tpu-lint: volatile(probe cadence counter)
+        self._spec_probe_wait = 0
+        # tpu-lint: volatile(in-flight probe window; restore re-probes)
+        self._probe_window = 0
+        # tpu-lint: volatile(in-flight probe window; restore re-probes)
+        self._probe_slots: List[int] = []
         # committed tokens per active slot per decode dispatch — what
         # the TTFT estimator divides decode work by so shed_infeasible
         # doesn't over-shed when speculation multiplies tokens/tick
+        # tpu-lint: volatile(capacity estimator re-learns; cold
+        # convention documented on estimated_ttft_s)
         self._ewma_spec_tokens = _Ewma()
         if speculate is not None:
             if not isinstance(speculate, SpecConfig):
@@ -702,7 +768,9 @@ class ServingEngine:
         self._queue = _PriorityQueue()
         self._submit_seq = 0
         self.results: Dict[int, RequestResult] = {}
+        # tpu-lint: volatile(re-derived as restored requests re-admit)
         self._reserved = 0      # blocks promised to in-flight slots
+        # tpu-lint: volatile(compiled program)
         self._step_fn = None
         # the stacked per-layer weight copy is built ONCE here and fed to
         # the step program as a traced argument: a per-token dispatch has
@@ -715,10 +783,17 @@ class ServingEngine:
         # advance ON DEVICE inside the step program (no per-step H2D
         # uploads); a join/leave/table event marks them dirty and the
         # next step re-uploads from the host mirrors
+        # tpu-lint: volatile(device twins re-upload from host mirrors)
         self._dev = None
+        # tpu-lint: volatile(upload flag; restore starts dirty)
         self._dirty = True
+        # tpu-lint: volatile(compiled-program cache)
         self._jit_cache: Dict = {}
+        # tpu-lint: volatile(per-incarnation telemetry; registry
+        # counters are the cross-restore accounting)
         self.stats = self._fresh_stats()
+        # tpu-lint: volatile(per-tick report; results dict carries the
+        # outcomes across a restore)
         self._finished_tick: List[int] = []
         # flight recorder: one compact event per step() into a fixed
         # ring; auto-dumped at the resilience seams when a dump path is
@@ -727,40 +802,76 @@ class ServingEngine:
                                      auto_dump_path=flight_dump_path,
                                      name="serving-engine")
         self._step_seq = 0              # flight event ordinal
+        # tpu-lint: volatile(flight-dump latch, per tick)
         self._dump_pending: Optional[str] = None
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_admitted: List[int] = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_retired: List = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_prefills: List = []
+        # tpu-lint: volatile(per-tick segment timing)
         self._tick_prefill_s = 0.0
         # overload-control tick markers + capacity estimator state
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_preempted: List[int] = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_resumed: List[int] = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_shed: List = []      # (request_id, reason) pairs
+        # tpu-lint: volatile(shed results land in results, which the
+        # snapshot serializes; the tick report is per-incarnation)
         self._pending_finished: List[int] = []  # shed between ticks
+        # tpu-lint: volatile(capacity estimator re-learns; cold = no
+        # estimate, the documented estimated_ttft_s convention)
         self._ewma_step = _Ewma()       # decode dispatch+sync per step
         # prefill cost PER TOKEN (wall seconds / new tokens prefilled):
         # the estimator must price a 2048-token prompt ~64x a 32-token
         # one, not one flat wave term — deadline-infeasibility shedding
         # would otherwise over-shed short prompts queued behind long
         # ones (tests/test_serving_chunked.py pins the bimodal case)
+        # tpu-lint: volatile(capacity estimator re-learns)
         self._ewma_prefill_tok = _Ewma()
+        # tpu-lint: volatile(capacity estimator re-learns)
         self._ewma_chunk = _Ewma()      # per chunk-program wall time
         # chunked-prefill scheduler state: FIFO of (slot_idx, slot)
         # still mid-prefill (stale entries lazily dropped by identity
         # check), chunk events this tick, and decode dispatches since
         # the last chunk (the decode_per_chunk interleave budget;
         # initialized satisfied so the first chunk runs immediately)
+        # tpu-lint: volatile(mid-prefill slots snapshot as resumable
+        # requests; restore re-admits them through the queue)
         self._prefill_fifo: List = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_chunks: List = []    # (request_id, start, ntok)
+        # tpu-lint: volatile(interleave budget restarts satisfied)
         self._decode_since_chunk = self.decode_per_chunk
+        # tpu-lint: volatile(a restored engine re-pays the compile)
         self._step_fn_warm = False      # first dispatch pays the compile
-        # dispatch sanitizer (paddle_tpu.analysis.runtime,
-        # docs/ANALYSIS.md): with sanitize=True every STEADY-STATE
-        # fused dispatch — warm step program, no join/leave/table event
-        # since the last upload — runs under no_transfer(h2d) +
-        # no_recompile, so a stray host upload or shape-churn recompile
-        # raises instead of silently regressing dispatch latency
-        self._sanitize = bool(sanitize)
+        # sanitizer tiers (paddle_tpu.analysis.runtime,
+        # docs/ANALYSIS.md): "dispatch" (== True, the PR 9 behavior)
+        # wraps every STEADY-STATE fused dispatch — warm step program,
+        # no join/leave/table event since the last upload — in
+        # no_transfer(h2d) + no_recompile, so a stray host upload or
+        # shape-churn recompile raises at the offending step;
+        # "roundtrip" runs the snapshot->restore->snapshot byte-
+        # identity check inside every save_snapshot; "all" arms both.
+        if sanitize in (False, None):
+            mode = None
+        elif sanitize is True or sanitize == "dispatch":
+            mode = "dispatch"
+        elif sanitize in ("roundtrip", "all"):
+            mode = sanitize
+        else:
+            raise ValueError(
+                f"sanitize must be a bool or one of "
+                f"'dispatch'/'roundtrip'/'all', got {sanitize!r}")
+        self._sanitize = mode in ("dispatch", "all")
+        self._sanitize_roundtrip = mode in ("roundtrip", "all")
+        # the constructor-shaped value, so snapshots round-trip the
+        # configured tier (not the normalized booleans)
+        self._sanitize_mode = (sanitize if isinstance(sanitize, str)
+                               else bool(sanitize))
         self._gauges_init()
 
     # ------------------------------------------------------------- helpers
@@ -800,6 +911,7 @@ class ServingEngine:
                     requests_shed=0, requests_rejected=0,
                     sanitized_steps=0, decode_slot_dispatches=0,
                     spec_ticks=0, spec_proposed=0, spec_accepted=0,
+                    spec_k_probes=0, roundtrip_checks=0,
                     step_admit_s=0.0, step_prefill_s=0.0,
                     step_dispatch_s=0.0, step_sync_s=0.0)
 
@@ -829,6 +941,9 @@ class ServingEngine:
         from paddle_tpu.observability import registry
         registry().counter("serving.rejected", reason=reason).inc()
         self.stats["requests_rejected"] += 1
+        # tpu-lint: allow(journal-coverage): submit-time rejection —
+        # the request was never ACCEPTED, so the zero-loss journal owes
+        # it nothing (the router counts tier-level rejects separately)
         self._tick_shed.append((request.request_id, reason))
         # at most one overload dump per tick, at the next step boundary
         # (a per-rejection dump would flood the sink under sustained
@@ -848,6 +963,11 @@ class ServingEngine:
         ttft = (victim._t_first - victim._t_submit
                 if victim._t_first is not None
                 and victim._t_submit is not None else None)
+        # tpu-lint: allow(journal-coverage): engine-level displacement;
+        # the Router rescues the victim onto a sibling replica or
+        # journals "finish" when it collects this shed result —
+        # single-engine durability is the snapshot, which serializes
+        # results
         res = RequestResult(victim.request_id, victim.prompt, toks,
                             len(toks), "shed", ttft, None, 0)
         self.results[victim.request_id] = res
@@ -1485,6 +1605,9 @@ class ServingEngine:
         self._queue.push(req)
         self.stats["preemptions"] += 1
         registry().counter("serving.preemptions").inc()
+        # tpu-lint: allow(journal-coverage): preemption is NOT terminal
+        # — the request requeues in-engine with its tokens, which the
+        # router's periodic "progress" events keep mirroring
         self._tick_preempted.append(req.request_id)
         if self._dump_pending is None:
             self._dump_pending = "preemption"
@@ -1703,6 +1826,9 @@ class ServingEngine:
             self.stats["requests_admitted"] += 1
             if resume:
                 self.stats["requests_resumed"] += 1
+                # tpu-lint: allow(journal-coverage): resume admission is
+                # not terminal; the router already journaled the
+                # re-placement ("place") that queued this resume
                 self._tick_resumed.append(req.request_id)
             wave.append((slot_idx, slot, hits, R, s_pad))
             wave_idx.add(slot_idx)
@@ -1999,10 +2125,75 @@ class ServingEngine:
         (one batched verify program serves every slot; slots below the
         max are capped through the device-side ``cap`` vector). 0 means
         the tick runs the plain per-token decode dispatch — the whole
-        point of adapting down on a low-acceptance mix."""
+        point of adapting down on a low-acceptance mix. A k=0 recovery
+        probe temporarily raises a parked slot's CAP above its k, so
+        the width is the max over both."""
         if not self.speculate.adaptive:
             return self._spec_k
-        return int(max(self._spec_k_slot[i] for i in active))
+        return int(max(max(int(self._spec_k_slot[i]),
+                           int(self._spec_cap[i])) for i in active))
+
+    def _maybe_probe(self, active):
+        """k=0 recovery probing (runs at the top of every decode tick
+        of an adaptive engine): a slot parked at ``k_min=0`` proposes
+        nothing, so its acceptance EWMA would never observe again and
+        the slot could never climb back when the mix turns favorable.
+        Every ``adapt_every`` consecutive parked ticks, raise each
+        parked active slot's proposal cap to ONE for a two-tick probe
+        window — the first (dirty) tick re-zeroes the carried ngram
+        proposals and primes the device matcher, the second verifies a
+        real one-token proposal and feeds the EWMA (the draft proposer
+        observes on both). ``serving.spec_k_probes`` counts probed
+        slots; the cap drops back when the window closes unless
+        ``_adapt_spec_k`` climbed the slot's k in between."""
+        from paddle_tpu.observability import registry
+
+        if self._probe_window > 0:
+            # window survives only while a probed slot is still active
+            # (a retirement mid-window resets its cap via
+            # _release_slot; without this the window could never close
+            # once every probed slot is gone and ticks turn plain)
+            if any(self._slots[i] is not None
+                   for i in self._probe_slots):
+                return
+            self._probe_window = 0
+            self._probe_slots = []
+            return
+        parked = [i for i in active if self._spec_k_slot[i] == 0]
+        if not parked:
+            self._spec_probe_wait = 0
+            return
+        self._spec_probe_wait += 1
+        if self._spec_probe_wait < self.speculate.adapt_every:
+            return
+        self._spec_probe_wait = 0
+        self._probe_window = 2
+        self._probe_slots = list(parked)
+        for i in parked:
+            self._spec_cap[i] = 1
+        self._dirty = True
+        self.stats["spec_k_probes"] += len(parked)
+        registry().counter("serving.spec_k_probes").inc(len(parked))
+
+    def _close_probe_window(self):
+        """End-of-spec-tick bookkeeping for an open probe window: when
+        it closes, parked slots drop back to cap 0 — unless the adapt
+        step just climbed their k (the probe's success case)."""
+        if self._probe_window <= 0:
+            return
+        self._probe_window -= 1
+        if self._probe_window:
+            return
+        changed = False
+        for i in self._probe_slots:
+            if self._slots[i] is not None \
+                    and int(self._spec_cap[i]) \
+                    != int(self._spec_k_slot[i]):
+                self._spec_cap[i] = int(self._spec_k_slot[i])
+                changed = True
+        self._probe_slots = []
+        if changed:
+            self._dirty = True
 
     def _adapt_spec_k(self, active, acc_np, nprop_np):
         """Per-slot adaptive-k update off the acceptance EWMA (runs at
@@ -2310,6 +2501,10 @@ class ServingEngine:
         else:
             ttft = None
         tpot = ((now - s.t_first) / (s.count - 1) if s.count > 1 else None)
+        # tpu-lint: allow(journal-coverage): THE engine finish site —
+        # the Router journals "finish" when it collects this result
+        # from step(); single-engine durability is the snapshot, which
+        # serializes results
         res = RequestResult(s.req.request_id, s.req.prompt, toks, gen_len,
                             finish, ttft, tpot, s.prefix_hit_blocks)
         self.results[s.req.request_id] = res
@@ -2432,6 +2627,8 @@ class ServingEngine:
                   if s is not None and not s.prefilling]
         if active:
             if spec:
+                if self.speculate.adaptive:
+                    self._maybe_probe(active)
                 self._spec_k_eff = K_eff = self._current_spec_k(active)
                 spec_tick = K_eff > 0
                 if K_eff != self._last_spec_k:
@@ -2673,6 +2870,7 @@ class ServingEngine:
         self._tick_spec = (proposed_total, accepted_total)
         if self.speculate.adaptive:
             self._adapt_spec_k(active, acc_np, nprop_np)
+            self._close_probe_window()
         tr = obs.active_tracer()
         if tr is not None:
             dur = dispatch_s + sync_s
@@ -2933,7 +3131,7 @@ class ServingEngine:
                   "decode_per_chunk": self.decode_per_chunk,
                   "speculate": (self.speculate.to_config()
                                 if self.speculate is not None else None),
-                  "sanitize": self._sanitize}
+                  "sanitize": self._sanitize_mode}
         fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
                        "dkv": self._dkv}
         return {"schema": ENGINE_SNAPSHOT_SCHEMA, "ts": time.time(),
@@ -2958,6 +3156,25 @@ class ServingEngine:
 
         _faults.maybe_fire("serving.snapshot")
         snap = self.snapshot()
+        if self._sanitize_roundtrip:
+            # sanitize="roundtrip"/"all": verify the snapshot being
+            # committed restores byte-identically (canonical form)
+            # BEFORE trusting it — SnapshotDriftError beats silently
+            # persisting a snapshot that loses state. The check builds
+            # a full twin engine (second KV pool!); if it CANNOT run —
+            # e.g. no allocator headroom on a crash path — commit
+            # unverified with a warning rather than abort the very
+            # snapshot meant to preserve state: only genuine drift is
+            # worth refusing to persist.
+            from paddle_tpu.analysis import runtime as _sanitizer
+            try:
+                _sanitizer.snapshot_roundtrip(self, snap=snap)
+            except _sanitizer.SnapshotDriftError:
+                raise
+            except Exception:   # noqa: BLE001 — check unavailable
+                logger.warning(
+                    "snapshot roundtrip check could not run; "
+                    "committing the snapshot UNVERIFIED", exc_info=True)
         step = snap["step_seq"]
         step_dir = os.path.join(root, f"step_{step}")
         os.makedirs(step_dir, exist_ok=True)
@@ -3062,6 +3279,9 @@ class ServingEngine:
             eng._queue.push(req)
             restored.append(req.request_id)
         for rr in snap.get("results", []):
+            # tpu-lint: allow(journal-coverage): reconstructs results a
+            # terminal transition already produced (and, router-side,
+            # already journaled) — not a new transition
             # tpu-lint: allow(host-sync): snapshot JSON is host data
             eng.results[rr["request_id"]] = RequestResult(
                 rr["request_id"], np.asarray(rr["prompt"], np.int32),

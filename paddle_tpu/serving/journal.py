@@ -1,0 +1,139 @@
+"""Durable request journal + the journal-event registry.
+
+The serving tier's zero-loss story rests on two write paths: replica
+snapshots (``ServingEngine.save_snapshot``, integrity-manifest
+committed) and THIS append-only CRC-framed journal — the router logs
+every request-state transition it owns (accept / place / progress /
+finish / failover / drain / ...) so a dead replica or a crashed router
+process can be folded back together from the log
+(docs/RESILIENCE.md §Router journal).
+
+:data:`KNOWN_EVENTS` is the pinned registry of event kinds, exactly
+like ``resilience.faults.KNOWN_SITES`` is for fault-injection sites:
+the ``journal-coverage`` lint rule (docs/ANALYSIS.md) checks that
+every ``journal.append("<kind>", ...)`` in the serving tier uses a
+registered kind, that every registered kind is actually emitted
+somewhere, and that every terminal request transition (a
+``RequestResult`` construction, a ``results[...]`` store, a tick
+transition marker) lives in a function that either journals or carries
+a classified ``# tpu-lint: allow(journal-coverage)`` annotation. A new
+transition added without an event is a recovery blind spot — the rule
+makes it a lint failure instead of a chaos-soak surprise.
+"""
+
+import json
+import logging
+import os
+import time
+import zlib
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["KNOWN_EVENTS", "ROUTER_JOURNAL_SCHEMA", "RouterJournal"]
+
+ROUTER_JOURNAL_SCHEMA = "paddle_tpu.router_journal/v1"
+
+#: The journal-event registry: every kind the serving tier may append,
+#: with the transition it records. ``journal-coverage`` (tpu-lint) pins
+#: emit sites against this dict and flags registered-but-never-emitted
+#: kinds; docs/RESILIENCE.md renders it as the event table. Replay
+#: folds events in this order: accept -> place -> progress -> finish.
+KNOWN_EVENTS = {
+    "header": "journal birth record: schema, replica count, router seed",
+    "accept": "request accepted by the tier (prompt, seed, priority, "
+              "deadline, first placement) — the zero-loss contract "
+              "starts here",
+    "place": "request (re-)placed onto a replica: failover/drain "
+             "re-placement and tier-level shed rescue",
+    "progress": "periodic generated-so-far token mirror for unfinished "
+                "requests (any prefix is a token-exact resume point)",
+    "finish": "request reached a terminal state (eos/length/deadline/"
+              "shed) with its tokens and latency telemetry",
+    "failover": "dead replica rebuilt (mode=restore|redistribute)",
+    "drain": "replica elastically drained; its work migrated",
+    "add_replica": "tier grew by one (warm-joined) replica slot",
+    "close": "router closed cleanly (no recovery needed past here)",
+    "recover": "router process rebuilt from this journal",
+}
+
+
+class RouterJournal:
+    """Append-only CRC-framed JSONL journal.
+
+    Each line is ``{"crc": crc32(payload_str), "p": payload_str}`` where
+    ``payload_str`` is the compact-JSON event — the crc is computed over
+    the exact serialized bytes, so :meth:`replay` detects torn tails and
+    bit-flips without re-serialization ambiguity. Corrupt lines are
+    SKIPPED (counted under ``resilience.journal_corrupt_skipped``), not
+    fatal: an append-only journal's last line is the only one a crash
+    can tear, and one damaged line must not strand the recovery — the
+    same walk-past philosophy as the snapshot manifests."""
+
+    def __init__(self, path: str, retry_policy=None):
+        from paddle_tpu.resilience.retry import RetryPolicy
+        self.path = path
+        self.retry_policy = retry_policy or RetryPolicy()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def append(self, kind: str, **fields) -> bool:
+        """Durably append one event; returns False (and warns) when the
+        sink stays broken past the retry budget — journal loss degrades
+        router-crash durability, it must not reject live work. An
+        unregistered ``kind`` warns (mirroring ``faults.arm`` on an
+        unknown site) but still appends: durability first, registry
+        hygiene is the lint rule's job."""
+        from paddle_tpu.observability import registry
+        from paddle_tpu.observability.registry import append_jsonl_lines
+        from paddle_tpu.resilience.retry import call_with_retry
+
+        if kind not in KNOWN_EVENTS:
+            logger.warning(
+                "journal event kind %r is not registered in "
+                "serving.journal.KNOWN_EVENTS (known: %s) — replay "
+                "tooling cannot see it", kind, ", ".join(KNOWN_EVENTS))
+        evt = {"kind": kind, "ts": round(time.time(), 6)}
+        evt.update(fields)
+        p = json.dumps(evt, separators=(",", ":"), sort_keys=True)
+        line = json.dumps({"crc": zlib.crc32(p.encode()), "p": p},
+                          separators=(",", ":"))
+        try:
+            call_with_retry(lambda: append_jsonl_lines(self.path, [line]),
+                            policy=self.retry_policy,
+                            retry_on=(OSError,),
+                            describe="router.journal")
+        except OSError:
+            logger.warning("router journal append to %s failed past the "
+                           "retry budget (kind=%s)", self.path, kind,
+                           exc_info=True)
+            return False
+        registry().counter("serving.router.journal_events",
+                           kind=kind).inc()
+        return True
+
+    @staticmethod
+    def replay(path: str):
+        """(events, corrupt_count): every intact event oldest-first.
+        Unparseable or crc-failing lines (torn tail, bit rot) are
+        skipped and counted — ``resilience.journal_corrupt_skipped``."""
+        from paddle_tpu.resilience import record_event
+
+        events, corrupt = [], 0
+        if not os.path.isfile(path):
+            return events, corrupt
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    outer = json.loads(ln)
+                    p = outer["p"]
+                    if zlib.crc32(p.encode()) != outer["crc"]:
+                        raise ValueError("crc mismatch")
+                    events.append(json.loads(p))
+                except Exception:   # noqa: BLE001 — any damage = skip
+                    corrupt += 1
+                    record_event("journal_corrupt_skipped")
+        return events, corrupt

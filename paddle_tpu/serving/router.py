@@ -61,17 +61,17 @@ process is alive), only router-process durability is waived.
 """
 
 import hashlib
-import json
 import logging
 import os
 import time
-import zlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from paddle_tpu.serving.engine import (Rejected, Request, RequestResult,
                                        RestoreError, ServingEngine)
+from paddle_tpu.serving.journal import (ROUTER_JOURNAL_SCHEMA,
+                                        RouterJournal)
 from paddle_tpu.serving.pool import PoolExhausted
 
 logger = logging.getLogger("paddle_tpu.serving")
@@ -79,88 +79,12 @@ logger = logging.getLogger("paddle_tpu.serving")
 __all__ = ["Router", "RouterJournal", "ROUTER_JOURNAL_SCHEMA",
            "REPLICA_STATES"]
 
-ROUTER_JOURNAL_SCHEMA = "paddle_tpu.router_journal/v1"
-
 #: replica health states. healthy/suspect take placements (suspect only
 #: when no healthy replica can), draining serves but takes none, dead is
 #: awaiting failover, removed is a retired slot (kept so prefix-affinity
 #: hashing stays stable as the tier grows).
 REPLICA_STATES = ("healthy", "suspect", "dead", "draining", "removed")
 _STATE_RANK = {s: i for i, s in enumerate(REPLICA_STATES)}
-
-
-class RouterJournal:
-    """Append-only CRC-framed JSONL journal.
-
-    Each line is ``{"crc": crc32(payload_str), "p": payload_str}`` where
-    ``payload_str`` is the compact-JSON event — the crc is computed over
-    the exact serialized bytes, so :meth:`replay` detects torn tails and
-    bit-flips without re-serialization ambiguity. Corrupt lines are
-    SKIPPED (counted under ``resilience.journal_corrupt_skipped``), not
-    fatal: an append-only journal's last line is the only one a crash
-    can tear, and one damaged line must not strand the recovery — the
-    same walk-past philosophy as the snapshot manifests."""
-
-    def __init__(self, path: str, retry_policy=None):
-        from paddle_tpu.resilience.retry import RetryPolicy
-        self.path = path
-        self.retry_policy = retry_policy or RetryPolicy()
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-
-    def append(self, kind: str, **fields) -> bool:
-        """Durably append one event; returns False (and warns) when the
-        sink stays broken past the retry budget — journal loss degrades
-        router-crash durability, it must not reject live work."""
-        from paddle_tpu.observability import registry
-        from paddle_tpu.observability.registry import append_jsonl_lines
-        from paddle_tpu.resilience.retry import call_with_retry
-
-        evt = {"kind": kind, "ts": round(time.time(), 6)}
-        evt.update(fields)
-        p = json.dumps(evt, separators=(",", ":"), sort_keys=True)
-        line = json.dumps({"crc": zlib.crc32(p.encode()), "p": p},
-                          separators=(",", ":"))
-        try:
-            call_with_retry(lambda: append_jsonl_lines(self.path, [line]),
-                            policy=self.retry_policy,
-                            retry_on=(OSError,),
-                            describe="router.journal")
-        except OSError:
-            logger.warning("router journal append to %s failed past the "
-                           "retry budget (kind=%s)", self.path, kind,
-                           exc_info=True)
-            return False
-        registry().counter("serving.router.journal_events",
-                           kind=kind).inc()
-        return True
-
-    @staticmethod
-    def replay(path: str):
-        """(events, corrupt_count): every intact event oldest-first.
-        Unparseable or crc-failing lines (torn tail, bit rot) are
-        skipped and counted — ``resilience.journal_corrupt_skipped``."""
-        from paddle_tpu.resilience import record_event
-
-        events, corrupt = [], 0
-        if not os.path.isfile(path):
-            return events, corrupt
-        with open(path) as f:
-            for ln in f:
-                ln = ln.strip()
-                if not ln:
-                    continue
-                try:
-                    outer = json.loads(ln)
-                    p = outer["p"]
-                    if zlib.crc32(p.encode()) != outer["crc"]:
-                        raise ValueError("crc mismatch")
-                    events.append(json.loads(p))
-                except Exception:   # noqa: BLE001 — any damage = skip
-                    corrupt += 1
-                    record_event("journal_corrupt_skipped")
-        return events, corrupt
 
 
 class _Tracked:
@@ -248,6 +172,8 @@ class Router:
         self.model = model
         self._state = state if state is not None else _inference_state(
             model)
+        # tpu-lint: volatile(constructor config — recover() rebuilds it
+        # from router_kwargs; set_overload_controls re-arms post-bench)
         self._engine_kwargs = dict(engine_kwargs)
         # one postmortem file for the whole tier: replica engines
         # inherit the router's dump path unless given their own, so
@@ -277,17 +203,25 @@ class Router:
         self._requests: Dict[int, _Tracked] = {}
         self._open: set = set()         # accepted, not yet finished
         self.results: Dict[int, RequestResult] = {}
+        # tpu-lint: volatile(recover() rebuilds orphans through
+        # _queue_replace from the journal fold)
         self._pending_replace: List[_Tracked] = []
+        # tpu-lint: volatile(journal/snapshot cadence counter)
         self._tick = 0
+        # tpu-lint: volatile(round-robin snapshot cursor)
         self._snap_cursor = 0
         self._closed = False
         self.flight = FlightRecorder(capacity=flight_capacity,
                                      auto_dump_path=flight_dump_path,
                                      name="serving-router")
+        # tpu-lint: volatile(tier telemetry; the registry counters are
+        # the cross-recovery accounting)
         self.router_stats = dict(
             placed=0, rejected_tier=0, heartbeat_misses=0,
             replica_deaths=0, failovers=0, replaced=0, drains=0,
             replica_kills=0, snapshots=0)
+        # tpu-lint: volatile(absorbed stats of retired engines —
+        # telemetry, not protocol state)
         self._stats_base: Dict[str, float] = {}
         if self.journal is not None:
             self.journal.append("header", schema=ROUTER_JOURNAL_SCHEMA,
@@ -1155,6 +1089,18 @@ class Router:
                         accepted[rid]["tokens"] = toks
             elif k == "finish" and e.get("rid") in accepted:
                 accepted[e["rid"]]["finish"] = e
+        # re-anchor the seed source past every router-assigned seed in
+        # the journal: a recovered router that reset _seeds_issued to 0
+        # would mint the SAME seed for its next fresh submit as the
+        # first pre-crash request drew — two requests sharing one RNG
+        # stream (the snapshot-coverage audit's find; engine restore
+        # already carries seeds_issued in its snapshot for the same
+        # reason)
+        rt._seeds_issued = max(
+            [rt._seeds_issued]
+            + [e["seed"] - rt.seed + 1 for e in accepted.values()
+               if isinstance(e.get("seed"), int)
+               and e["seed"] >= rt.seed])
         # replicas were built fresh by the constructor; swap in restored
         # engines where a committed snapshot survives
         covered = set()

@@ -67,10 +67,12 @@ class SpecConfig:
     ``k_min=0`` it degrades all the way to the plain per-token decode
     dispatch. Committed tokens stay bit-identical at every k
     (acceptance is exact sample-match; shorter proposals just commit
-    fewer per tick). ``k_min=0`` is one-way per slot: a slot at k=0
-    proposes nothing, so its EWMA can never observe acceptance again
-    until the slot retires — keep ``k_min>=1`` when the mix can turn
-    favorable mid-request.
+    fewer per tick). A slot parked at ``k_min=0`` proposes nothing,
+    so by itself its EWMA could never observe acceptance again; the
+    engine therefore PROBES parked slots — every ``adapt_every``
+    parked ticks their cap is raised to one proposal for a two-tick
+    window (``serving.spec_k_probes``), letting the EWMA re-observe
+    and the slot climb back when the mix turns favorable.
 
     Everything is validated HERE with plain ``ValueError``s — a bad k
     must not surface deep inside the scheduler.

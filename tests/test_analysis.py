@@ -200,6 +200,142 @@ def test_metric_drift_rule_shared_implementation():
                           "serving.wrapped_rotten"}
 
 
+# -------------------------------------- state-protocol rules (PR 13)
+
+def test_snapshot_coverage_rule():
+    """A class with snapshot()+restore(): mutable fields must round-trip
+    or carry volatile(...); asymmetric coverage is its own finding."""
+    src = (
+        "class Engine:\n"
+        "    def __init__(self, cap):\n"
+        "        self.cap = cap\n"                  # immutable: config
+        "        self._count = 0\n"                 # covered both ways
+        "        self._lost = 0\n"                  # flagged: uncovered
+        "        self._half = 0\n"                  # flagged: asymmetric
+        "        self._tmp = None  # tpu-lint: volatile(scratch)\n"
+        "    def bump(self):\n"
+        "        self._count += 1\n"
+        "        self._lost += 1\n"
+        "        self._half += 1\n"
+        "        self._tmp = 3\n"
+        "    def snapshot(self):\n"
+        "        return {'count': self._count, 'half': self._half}\n"
+        "    def restore(self, snap):\n"
+        "        self._count = snap['count']\n")
+    res = _lint(_files(mod=src), rules=("snapshot-coverage",))
+    assert sorted(f.line for f in res.findings) == [5, 6], res.findings
+    msgs = {f.line: f.message for f in res.findings}
+    assert "not covered" in msgs[5]
+    assert "never restored" in msgs[6]
+    assert len(res.suppressed) == 1     # the volatile(...) pragma
+
+    # a class without BOTH protocol halves is out of scope entirely
+    src_noload = src.replace("    def restore(self, snap):\n"
+                             "        self._count = snap['count']\n", "")
+    assert not _lint(_files(mod=src_noload),
+                     rules=("snapshot-coverage",)).findings
+
+
+def test_snapshot_coverage_mutator_calls_and_tuple_stores():
+    """In-place mutator calls (self._q.push) and tuple-unpack stores
+    (a, self._pool, b = ...) both count as mutation."""
+    src = (
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._q = []\n"
+        "        self._pool = None\n"
+        "    def run(self):\n"
+        "        self._q.append(1)\n"
+        "        x, self._pool = f()\n"
+        "    def snapshot(self):\n"
+        "        return {}\n"
+        "    def restore(self, snap):\n"
+        "        pass\n")
+    res = _lint(_files(mod=src), rules=("snapshot-coverage",))
+    assert sorted(f.line for f in res.findings) == [3, 4], res.findings
+
+
+def test_journal_coverage_rule():
+    """Terminal transitions must journal-or-annotate; event kinds pin
+    against KNOWN_EVENTS; registered-but-never-emitted kinds are stale."""
+    journal_src = ('KNOWN_EVENTS = {"finish": "terminal",\n'
+                   '                "ghost": "never emitted"}\n')
+    mod = (
+        "class E:\n"
+        "    def good(self, rid, res):\n"
+        "        self.results[rid] = res\n"
+        "        if self.journal is not None:\n"
+        "            self.journal.append('finish', rid=rid)\n"
+        "    def bad_kind(self):\n"
+        "        self.journal.append('bogus')\n"      # unregistered
+        "    def uncovered(self, rid, res):\n"
+        "        self.results[rid] = res\n"           # flagged
+        "    def maker(self, req):\n"
+        "        return RequestResult(req)\n"         # flagged anchor
+        "    def annotated(self, rid, res):\n"
+        "        # tpu-lint: allow(journal-coverage): router covers\n"
+        "        self.results[rid] = res\n")
+    files = _files(**{"serving.journal": journal_src,
+                      "serving.mod": mod})
+    res = _lint(files, rules=("journal-coverage",))
+    by_path = {}
+    for f in res.findings:
+        by_path.setdefault(f.path, []).append(f.line)
+    assert sorted(by_path["paddle_tpu/serving/mod.py"]) == [7, 9, 11], \
+        res.findings
+    # the stale "ghost" registry entry anchors in journal.py
+    assert by_path["paddle_tpu/serving/journal.py"] == [2]
+    assert len(res.suppressed) == 1
+    # outside serving/, the same source is out of scope
+    res2 = _lint(_files(**{"serving.journal": journal_src, "mod": mod}),
+                 rules=("journal-coverage",))
+    assert {f.path for f in res2.findings} == {
+        "paddle_tpu/serving/journal.py"}    # only the stale ghost
+
+
+def test_rng_stream_rule():
+    """Raw PRNGKey/split and non-fold_in-keyed draws are findings; the
+    fold taint flows through locals, helpers and parameters — a bad
+    key is flagged at the CALL SITE of a key-forwarding function."""
+    src = (
+        "import jax\n"
+        "def bad(x):\n"
+        "    k = jax.random.PRNGKey(0)\n"             # raw stream
+        "    return jax.random.categorical(k, x)\n"   # unfolded draw
+        "def good(x, base, t):\n"
+        "    k = jax.random.fold_in(base, t)\n"
+        "    return jax.random.categorical(k, x)\n"
+        "def vmapped(x, base, t):\n"
+        "    k = jax.random.fold_in(base, t)\n"
+        "    return jax.vmap(\n"
+        "        lambda kk, lg: jax.random.categorical(kk, lg))(k, x)\n"
+        "def helper(logits, key):\n"
+        "    return jax.random.categorical(key, logits)\n"
+        "def call_bad(x, raw_key):\n"
+        "    return helper(x, raw_key)\n"             # propagates: param
+        "def call_good(x, base, t):\n"
+        "    return helper(x, jax.random.fold_in(base, t))\n"
+        "def outer_bad(x):\n"
+        "    return call_bad(x, jax.random.split(None)[0])\n")
+    res = _lint(_files(**{"serving.mod": src}), rules=("rng-stream",))
+    lines = sorted(f.line for f in res.findings)
+    # 3: PRNGKey, 4: unfolded draw, 19: split (raw) + call-site into
+    # the call_bad->helper forwarding chain
+    assert lines == [3, 4, 19, 19], res.findings
+    # same module outside serving//inference/: out of scope
+    assert not _lint(_files(mod=src), rules=("rng-stream",)).findings
+
+
+def test_new_rules_in_all_and_filterable():
+    """--rules accepts the three new names and the tree is clean under
+    them (the serving/resilience burn-down, pinned)."""
+    assert {"snapshot-coverage", "journal-coverage",
+            "rng-stream"} <= set(lint.ALL_RULES)
+    res = lint.run_lint(ROOT, rules=("snapshot-coverage",
+                                     "journal-coverage", "rng-stream"))
+    assert res.ok, res.findings
+
+
 # ------------------------------------------- suppressions and baseline
 
 def test_inline_and_statement_suppressions():

@@ -301,6 +301,34 @@ def test_router_recover_rebuilds_tier_from_journal(model, tmp_path):
         rt2.close()
 
 
+def test_recover_reanchors_seed_source_past_journaled_seeds(
+        model, tmp_path):
+    """A recovered router must not mint a fresh request the SAME
+    router-assigned seed a pre-crash request drew (two requests on one
+    RNG stream) — recover re-anchors _seeds_issued from the journaled
+    accepts (the snapshot-coverage audit's find)."""
+    rng = np.random.RandomState(7)
+    rt = _router(model, tmp_path, replicas=2)
+    pre = [rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                     max_new_tokens=4))
+           for _ in range(3)]
+    pre_seeds = {rt._requests[r].seed for r in pre}
+    root = rt.root
+    del rt     # process crash analog
+    rt2 = serving.Router.recover(model, root, max_slots=2,
+                                 block_tokens=16, max_seq_len=64)
+    try:
+        fresh = serving.Request(rng.randint(3, 500, (8,)),
+                                max_new_tokens=4)
+        rt2.submit(fresh)
+        assert fresh.seed not in pre_seeds, (
+            f"recovered router re-minted seed {fresh.seed} "
+            f"(pre-crash seeds: {sorted(pre_seeds)})")
+        rt2.drain(max_steps=400)
+    finally:
+        rt2.close()
+
+
 # ---------------------------------------------------- typed restore errors
 
 def test_restore_errors_are_typed(model, tmp_path):

@@ -507,10 +507,12 @@ def test_spec_engine_on_interpret_kernel_token_exact():
 def test_adaptive_k_decays_on_low_acceptance_token_exact():
     """A draft proposer with DIFFERENT weights proposes k tokens every
     tick that almost never match the target's samples: the per-slot
-    acceptance EWMA decays the slot's k to k_min=0, after which ticks
-    ride the plain per-token dispatch (no verify tail, no draft round
-    — ``stats["steps"] > stats["spec_ticks"]``). Tokens stay
-    bit-identical to isolated generate at every k along the way."""
+    acceptance EWMA decays the slot's k to k_min=0, after which most
+    ticks ride the plain per-token dispatch (no verify tail, no draft
+    round — ``stats["steps"] > stats["spec_ticks"]``), with the
+    periodic one-proposal recovery probe (PR 13) re-observing every
+    ``adapt_every`` parked ticks. Tokens stay bit-identical to
+    isolated generate at every k along the way."""
     cfg, m = tiny_llama()
     _, draft = tiny_llama(seed=7)       # different weights on purpose
     rng = np.random.RandomState(11)
@@ -520,7 +522,7 @@ def test_adaptive_k_decays_on_low_acceptance_token_exact():
     eng = serving.ServingEngine(
         m, max_slots=2, block_tokens=16, max_seq_len=64,
         speculate=SpecConfig(k=3, proposer="draft", draft_model=draft,
-                             adaptive=True, k_min=0, adapt_every=1,
+                             adaptive=True, k_min=0, adapt_every=3,
                              acceptance_floor=0.5))
     rid = eng.submit(serving.Request(p, max_new_tokens=24, seed=42))
     eng.drain(max_steps=400)
@@ -529,6 +531,49 @@ def test_adaptive_k_decays_on_low_acceptance_token_exact():
     # the slot adapted down: later ticks ran WITHOUT the verify tail
     assert st["spec_ticks"] < st["steps"], st
     assert st["steps"] - st["spec_ticks"] >= 4, st
+    # ... and the parked slot kept probing (and kept being rejected —
+    # the mismatched draft never earns its k back)
+    assert st["spec_k_probes"] >= 1, st
+    eng.close()
+
+
+def test_spec_k_zero_probe_reobserves_and_climbs_back():
+    """The k=0 recovery probe (ROADMAP carry-over): a slot parked at
+    ``k_min=0`` proposes nothing, so without probing its acceptance
+    EWMA could never observe again. Every ``adapt_every`` parked ticks
+    the engine raises its cap to ONE proposal (counted under
+    ``serving.spec_k_probes``); with the draft == the target, every
+    probe accepts, the EWMA crosses the ceiling and the slot CLIMBS
+    back above k=0 — and the tokens stay bit-identical to isolated
+    generate through park, probe and climb."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(13)
+    p = rng.randint(3, 512, (12,))
+    ref = np.asarray(generate(m, p[None], max_new_tokens=24,
+                              request_seeds=[44]))[0, len(p):]
+    eng = serving.ServingEngine(
+        m, max_slots=1, block_tokens=16, max_seq_len=64,
+        speculate=SpecConfig(k=2, proposer="draft", draft_model=m,
+                             adaptive=True, k_min=0, adapt_every=2,
+                             acceptance_floor=0.0,
+                             acceptance_ceiling=0.0))
+    rid = eng.submit(serving.Request(p, max_new_tokens=24, seed=44))
+    eng.step()      # admit + first speculative tick
+    i = next(j for j, s in enumerate(eng._slots) if s is not None)
+    # park the slot directly (the decay path has its own pin above)
+    eng._spec_k_slot[i] = 0
+    eng._spec_cap[i] = 0
+    eng._dirty = True
+    ticks = 0
+    while eng._slots[i] is not None and eng._spec_k_slot[i] == 0 \
+            and ticks < 20:
+        eng.step()
+        ticks += 1
+    assert eng.stats["spec_k_probes"] >= 1, eng.stats
+    assert eng._slots[i] is None or eng._spec_k_slot[i] > 0, (
+        "parked slot never climbed back despite perfect acceptance")
+    eng.drain(max_steps=200)
+    assert eng.results[rid].tokens.tolist() == ref.tolist()
     eng.close()
 
 
@@ -553,6 +598,8 @@ def test_adaptive_k_holds_on_high_acceptance_token_exact():
     assert st["spec_ticks"] == st["steps"], st
     # acceptance was genuinely high enough to hold k up
     assert st["spec_accepted"] > 0
+    # k_min=1 never parks a slot, so the k=0 recovery probe never fires
+    assert st["spec_k_probes"] == 0, st
     eng.close()
 
 

@@ -1,0 +1,181 @@
+"""State-protocol round-trip pins (docs/SERVING.md §Snapshot contract).
+
+The runtime half of PR 13's state-lint: ``snapshot -> restore ->
+snapshot`` must be BYTE-IDENTICAL in canonical form — mid-flight, for
+every engine configuration the snapshot schema claims to cover
+(monolithic bf16, chunked prefill with a mid-prefill slot, int8 KV,
+speculative decoding, a live router replica). The canonical form
+(``analysis.runtime.canonical_snapshot``) merges slots+queue into one
+scheduling-ordered request list and drops only the documented
+volatile-by-contract keys; anything else diverging raises
+``SnapshotDriftError`` — the guard ``ServingEngine(sanitize=
+"roundtrip"|"all")`` and ``chaos_bench --roundtrip_every`` arm.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.analysis import runtime as rt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_llama(L=2):
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=L,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_llama()
+
+
+ENGINE_CONFIGS = {
+    "plain_bf16": dict(),
+    "chunked": dict(chunk_tokens=32, max_seq_len=256),
+    "int8": dict(cache_dtype=jnp.int8),
+    "speculative": dict(speculate="ngram_k2"),
+}
+
+
+def _build(model, name, **extra):
+    kw = dict(max_slots=2, block_tokens=32, max_seq_len=128)
+    kw.update(ENGINE_CONFIGS[name])
+    if kw.get("speculate") == "ngram_k2":
+        kw["speculate"] = serving.SpecConfig(k=2)
+    kw.update(extra)
+    return serving.ServingEngine(model, **kw)
+
+
+@pytest.mark.parametrize("config", sorted(ENGINE_CONFIGS))
+def test_snapshot_roundtrip_byte_identity_mid_flight(model, config):
+    """THE pin: a mid-flight engine — active slots, queued work (mixed
+    priorities/deadlines, a mid-prefill slot on the chunked config) —
+    round-trips byte-identically in canonical form."""
+    rng = np.random.RandomState(hash(config) % 2 ** 16)
+    with _build(model, config) as eng:
+        long_p = 70 if config == "chunked" else 12
+        eng.submit(serving.Request(rng.randint(3, 500, (long_p,)),
+                                   max_new_tokens=8, priority="high",
+                                   seed=11))
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=8, deadline_s=60.0,
+                                   seed=12))
+        eng.submit(serving.Request(rng.randint(3, 500, (9,)),
+                                   max_new_tokens=8, priority="low",
+                                   seed=13))
+        eng.step()      # chunked: leaves the long prompt MID-prefill
+        eng.step()
+        snap = rt.snapshot_roundtrip(eng)
+        assert eng.stats["roundtrip_checks"] == 1
+        # byte-level, explicitly: two canonical serializations of the
+        # same verified snapshot are identical bytes
+        assert rt.canonical_snapshot_bytes(snap) \
+            == rt.canonical_snapshot_bytes(copy.deepcopy(snap))
+        eng.drain()
+        # ... and again with finished results + empty slots
+        rt.snapshot_roundtrip(eng)
+        assert eng.stats["roundtrip_checks"] == 2
+
+
+def test_snapshot_roundtrip_router_replica(model):
+    """A live router replica's engine round-trips too (the failover
+    restore path is the same protocol)."""
+    rng = np.random.RandomState(5)
+    with serving.Router(model, replicas=2, max_slots=2, block_tokens=32,
+                        max_seq_len=128) as router:
+        for i in range(4):
+            router.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                          max_new_tokens=8, seed=50 + i))
+            router.step()
+        probed = 0
+        for i in router.live_replicas:
+            eng = router.replica_engine(i)
+            if eng.active_slots or eng.queued:
+                rt.snapshot_roundtrip(eng)
+                probed += 1
+        assert probed >= 1
+        router.drain(max_steps=200)
+
+
+def test_sanitize_roundtrip_tier_wired_into_save_snapshot(
+        model, tmp_path):
+    """``sanitize="all"`` arms BOTH tiers: save_snapshot runs the
+    roundtrip check before committing, and the mode (not a normalized
+    bool) rides the snapshot config."""
+    rng = np.random.RandomState(6)
+    with _build(model, "plain_bf16", sanitize="all") as eng:
+        assert eng._sanitize and eng._sanitize_roundtrip
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=6, seed=9))
+        eng.step()
+        eng.save_snapshot(str(tmp_path / "snap"))
+        assert eng.stats["roundtrip_checks"] == 1
+        snap = serving.ServingEngine.load_snapshot(str(tmp_path / "snap"))
+        assert snap["config"]["sanitize"] == "all"
+        eng.drain()
+    # "roundtrip" alone leaves the dispatch guard off
+    with _build(model, "plain_bf16", sanitize="roundtrip") as eng:
+        assert not eng._sanitize and eng._sanitize_roundtrip
+    with pytest.raises(ValueError, match="sanitize"):
+        _build(model, "plain_bf16", sanitize="bogus")
+
+
+def test_snapshot_drift_detection(model):
+    """Any canonical-section divergence raises SnapshotDriftError
+    naming the section — tokens, config, results and seed source."""
+    rng = np.random.RandomState(7)
+    with _build(model, "plain_bf16") as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=6, seed=21))
+        eng.step()
+        snap = eng.snapshot()
+    for mutate, section in (
+            (lambda s: s["queue"].append(dict(
+                (s["slots"] + s["queue"])[0], request_id=999)),
+             "requests"),
+            (lambda s: s["config"].update(top_k=7), "config"),
+            (lambda s: s.update(seeds_issued=s["seeds_issued"] + 1),
+             "seeds_issued")):
+        bad = copy.deepcopy(snap)
+        mutate(bad)
+        with pytest.raises(rt.SnapshotDriftError, match=section):
+            rt.compare_snapshots(snap, bad)
+    # the volatile-by-contract keys do NOT trip the comparison
+    ok = copy.deepcopy(snap)
+    ok["ts"] = 0.0
+    ok["step_seq"] = 10 ** 6
+    ok["prefix_keys"] = ["bogus"]
+    ok["config"]["sanitize"] = "all"
+    ok["config"]["flight_dump_path"] = "/elsewhere.jsonl"
+    rt.compare_snapshots(snap, ok)
+
+
+def test_canonical_form_merges_slots_and_queue(model):
+    """Slot-vs-queue placement is scheduling state, not protocol
+    state: a snapshot with a request in a SLOT and one with the same
+    request QUEUED are canonically identical."""
+    rng = np.random.RandomState(8)
+    with _build(model, "plain_bf16") as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=6, seed=31))
+        eng.step()
+        snap = eng.snapshot()
+    assert snap["slots"] and not snap["queue"]
+    moved = copy.deepcopy(snap)
+    moved["queue"] = [dict(e) for e in moved["slots"]]
+    moved["slots"] = []
+    for e in moved["queue"]:
+        e.pop("chunk_filled", None)
+    assert rt.canonical_snapshot_bytes(snap) \
+        == rt.canonical_snapshot_bytes(moved)
