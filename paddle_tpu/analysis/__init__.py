@@ -6,12 +6,17 @@ Two layers:
   ``python -m paddle_tpu.analysis``): AST rules for implicit host
   syncs, Python branches on traced values in jit-reachable code,
   float64 defaults in kernel files, metric-name drift vs the docs
-  table, and unregistered fault sites — with a checked-in baseline
-  and ``# tpu-lint: allow(<rule>)`` inline suppressions.
+  table, unregistered fault sites, state/journal/rng protocol
+  coverage, and the mesh/donation rules (``collective-axis`` /
+  ``pspec-axis`` pinned against ``parallel.topology.KNOWN_AXES``,
+  ``donation`` for undonated RMW carries) — with a checked-in
+  baseline and ``# tpu-lint: allow(<rule>)`` inline suppressions.
 * **runtime** — the dispatch sanitizer
   (:mod:`paddle_tpu.analysis.runtime`): ``no_transfer`` /
   ``no_recompile`` / ``sanitize`` context guards, wired into
-  ``ServingEngine(sanitize=True)`` and the benches' ``--sanitize``.
+  ``ServingEngine(sanitize=True)`` and the benches' ``--sanitize``;
+  ``snapshot_roundtrip`` for the state protocol; ``donation_report``
+  for compiled input→output aliasing.
 
 The lint layer never imports jax (it must run in seconds as a tier-1
 gate); the runtime layer does. Importing the runtime names through
@@ -21,10 +26,11 @@ this package is lazy for that reason.
 from paddle_tpu.analysis.lint import (ALL_RULES, Finding, LintResult,
                                       run_lint)
 
-_RUNTIME_NAMES = ("CompileCounter", "RecompileError",
-                  "SnapshotDriftError", "TransferError",
-                  "canonical_snapshot", "canonical_snapshot_bytes",
-                  "compare_snapshots", "count_compiles", "no_recompile",
+_RUNTIME_NAMES = ("CompileCounter", "DonationError", "DonationReport",
+                  "RecompileError", "SnapshotDriftError",
+                  "TransferError", "canonical_snapshot",
+                  "canonical_snapshot_bytes", "compare_snapshots",
+                  "count_compiles", "donation_report", "no_recompile",
                   "no_transfer", "sanitize", "snapshot_roundtrip",
                   "compile_events_supported")
 

@@ -174,10 +174,12 @@ def run_lint(root: Optional[str] = None,
             raise ValueError(f"unknown rule {r!r}; one of {ALL_RULES}")
     if files is None:
         files = package_sources(root)
-    # the call graph feeds only the jit-reachability rules; a
+    # ONE call graph, built once and reused by every rule that needs
+    # reachability or import resolution (host-sync/traced-branch jit
+    # reachability, the donation rule's cross-module RMW fixpoint); a
     # metric-drift-only run (tests/test_slo.py's delegate) skips the
     # whole-package walk
-    if {"host-sync", "traced-branch"} & set(rules):
+    if {"host-sync", "traced-branch", "donation"} & set(rules):
         graph = callgraph_mod.build_callgraph(
             {p: sf.tree for p, sf in files.items()})
     else:
@@ -195,9 +197,25 @@ def run_lint(root: Optional[str] = None,
     faults_rel = "paddle_tpu/resilience/faults.py"
     fault_sites = (rules_mod.known_fault_sites(files[faults_rel].source)
                    if faults_rel in files else set())
+    # the mesh-axis registry: from the files mapping when present
+    # (normal runs), else from the tree on disk (synthetic-files test
+    # runs); with neither, the axis rules are dropped like metric-drift
+    topo_rel = "paddle_tpu/parallel/topology.py"
+    topo_disk = os.path.join(root, "paddle_tpu", "parallel",
+                             "topology.py")
+    if topo_rel in files:
+        known_axes = rules_mod.known_mesh_axes(files[topo_rel].source)
+    elif os.path.exists(topo_disk):
+        with open(topo_disk, encoding="utf-8") as fh:
+            known_axes = rules_mod.known_mesh_axes(fh.read())
+    else:
+        known_axes = {}
+        rules = tuple(r for r in rules
+                      if r not in ("collective-axis", "pspec-axis"))
 
     all_findings = rules_mod.run_rules(files, graph, docs_text,
-                                       fault_sites, rules=rules)
+                                       fault_sites, rules=rules,
+                                       known_axes=known_axes)
     if paths:
         norm = [p.rstrip("/") for p in paths]
         all_findings = [f for f in all_findings

@@ -75,6 +75,46 @@ Rule catalog (docs/ANALYSIS.md has the workflow):
     ``split`` references are findings: an ad-hoc stream in serving
     code silently breaks the batch-composition-invariant sampling
     contract (tests/test_serving.py's parity pins).
+
+``collective-axis``
+    Every named-axis collective (``lax.psum``/``pmean``/``pmax``/
+    ``pmin``/``ppermute``/``all_gather``/``psum_scatter``/
+    ``all_to_all``/``axis_index``/``axis_size``/``pcast``/
+    ``pbroadcast`` — 0.9 and jaxcompat-shim spellings alike) whose
+    axis-name argument resolves to a string literal (directly, via a
+    parameter default, a local assign, or a module constant) must name
+    an axis registered in ``parallel.topology.KNOWN_AXES`` — the axis
+    set the hybrid mesh can bind and the multichip dryrun validates. A
+    typo'd or out-of-registry axis is a lint finding at author time
+    instead of an unbound-axis trace error on a v5p mesh. Calls whose
+    axis is genuinely dynamic (an un-defaulted parameter) are the
+    documented blind spot. ``axis_name=`` keywords on ANY call (the
+    ``partial(local, axis_name=...)`` currying sites) are checked too.
+
+``pspec-axis``
+    Every ``PartitionSpec`` literal must reference registered axes
+    (same registry and same literal resolution as ``collective-axis``);
+    where a spec is attached to a statically-known shape
+    (``jax.ShapeDtypeStruct((4, 6), ..., sharding=NamedSharding(mesh,
+    P("dp", None)))``), each sharded dim must divide by the axis's
+    validated degree — the AOT feasibility path fails on indivisible
+    dims only at lowering time on the real mesh.
+
+``donation``
+    A jitted function whose array argument flows through an RMW chain
+    (``x.at[...].set/add``, ``lax.dynamic_update_slice``) into an
+    output — directly, through tuple-unpacked aliases, through
+    ``lax.scan``/``while_loop``/``fori_loop`` carries, or through
+    calls into other package functions (cross-module fixpoint) — must
+    donate that argnum, or every dispatch pays a full buffer copy (the
+    BENCH_r06 O(prompt²/chunk) carry-copy class). The inverse hazard
+    is also flagged: an argument donated at a jit site and then read
+    again by the caller after the dispatch is a use-after-free. The
+    sanctioned conditional-donation spelling is
+    ``inference.carry_donate_argnums(...)`` — the rule reads the
+    argnums through it. ``*args``-signature impls whose positions
+    can't be mapped are the documented blind spot (the runtime
+    ``analysis.runtime.donation_report`` guard covers them).
 """
 
 import ast
@@ -84,7 +124,7 @@ from typing import Dict, Iterator, List, Optional, Set
 
 __all__ = ["Finding", "ALL_RULES", "KERNEL_DIRS", "SNAPSHOT_OWNED",
            "collect_metric_names", "known_fault_sites",
-           "known_journal_events", "run_rules"]
+           "known_journal_events", "known_mesh_axes", "run_rules"]
 
 KERNEL_DIRS = ("paddle_tpu/ops", "paddle_tpu/inference",
                "paddle_tpu/serving")
@@ -1326,6 +1366,857 @@ def check_rng_stream(files: Dict[str, "SourceFile"]) -> List[Finding]:
     return findings
 
 
+# ------------------------------------------- mesh-axis literal support
+
+def known_mesh_axes(topology_source: str) -> Dict[str, Optional[int]]:
+    """Parse parallel/topology.py for the KNOWN_AXES dict literal —
+    axis name -> validated degree (or None) — without importing the
+    package (no jax on the lint path)."""
+    tree = ast.parse(topology_source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "KNOWN_AXES" \
+                    and isinstance(node.value, ast.Dict):
+                out: Dict[str, Optional[int]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        out[k.value] = (v.value if isinstance(
+                            v, ast.Constant)
+                            and isinstance(v.value, int) else None)
+                return out
+    return {}
+
+
+class _AxisScopes:
+    """Literal resolution for axis-name expressions: a Name resolves
+    through the enclosing function's parameter defaults and local
+    string assigns, then module-level string constants. Returns the
+    resolved string or None (dynamic — the documented blind spot)."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_consts: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_consts[t.id] = node.value.value
+        self.stack: List[Dict[str, str]] = []
+
+    @staticmethod
+    def _fn_scope(node) -> Dict[str, str]:
+        scope: Dict[str, str] = {}
+        a = node.args
+        pos = a.posonlyargs + a.args
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                scope[p.arg] = d.value
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                scope[p.arg] = d.value
+        # shallow: a nested function's locals shadow — they are pushed
+        # as their own frame when the scoper enters them, and must not
+        # leak into (or override) the enclosing scope here. Assigns
+        # apply in TEXT order (the shallow walk's stack order is not
+        # source order), so `ax = 'tmp'; ax = 'mp'` resolves to 'mp' —
+        # the value in effect at any later call site
+        assigns = [s for s in _walk_shallow(node)
+                   if isinstance(s, ast.Assign)
+                   and isinstance(s.value, ast.Constant)
+                   and isinstance(s.value.value, str)]
+        for s in sorted(assigns, key=lambda s: s.lineno):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    scope[t.id] = s.value.value
+        return scope
+
+    def push(self, node):
+        self.stack.append(self._fn_scope(node))
+
+    def pop(self):
+        self.stack.pop()
+
+    def resolve_name(self, name: str) -> Optional[str]:
+        for scope in reversed(self.stack):
+            if name in scope:
+                return scope[name]
+        return self.module_consts.get(name)
+
+    def axis_literals(self, node) -> List[str]:
+        """Every axis-name string this expression statically resolves
+        to: a constant, a tuple/list of constants, or resolvable
+        Names. Dynamic parts resolve to nothing (never a false
+        positive from an unresolvable expression)."""
+        if isinstance(node, ast.Constant):
+            return ([node.value] if isinstance(node.value, str) else [])
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: List[str] = []
+            for e in node.elts:
+                out.extend(self.axis_literals(e))
+            return out
+        if isinstance(node, ast.Name):
+            v = self.resolve_name(node.id)
+            return [v] if v is not None else []
+        return []
+
+
+# ------------------------------------------------------ collective-axis
+
+#: named-axis collectives -> positional index of the axis-name operand
+#: (0.9 names; pcast/pbroadcast are the vma-cast pair the jaxcompat
+#: shim grafts onto 0.4.x — the AST spelling is identical either way)
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1, "pshuffle": 1,
+    "pcast": 1, "pbroadcast": 1, "axis_index": 0, "axis_size": 0,
+}
+#: keyword spellings of the axis operand on those calls
+_COLLECTIVE_AXIS_KW = ("axis_name", "axes")
+
+
+class _CollectiveAxisVisitor(_FuncScoper):
+    def __init__(self, sf: SourceFile, axes: Dict[str, Optional[int]],
+                 lax_aliases: Dict[str, str], findings: List[Finding]):
+        super().__init__()
+        self.sf = sf
+        self.axes = axes
+        self.lax_aliases = lax_aliases
+        self.scopes = _AxisScopes(sf.tree)
+        self.findings = findings
+
+    def enter_function(self, node, qualname):
+        self.scopes.push(node)
+
+    def exit_function(self, node):
+        self.scopes.pop()
+
+    def _collective_name(self, func) -> Optional[str]:
+        """The CANONICAL collective name when this callee is one:
+        jax.lax.psum / lax.psum / a from-import or module-level alias
+        of one (``from jax.lax import psum as ps`` resolves to
+        ``psum``)."""
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _COLLECTIVE_AXIS_POS:
+            chain = _jax_chain(func)
+            if "lax" in chain[:-1] or chain[0] in ("jax", "collective"):
+                return func.attr
+        if isinstance(func, ast.Name):
+            return self.lax_aliases.get(func.id)
+        return None
+
+    def _check_axis_expr(self, node, expr, what: str):
+        for axis in self.scopes.axis_literals(expr):
+            if axis not in self.axes:
+                registered = ", ".join(sorted(self.axes)) or "<none>"
+                self.findings.append(self.sf.finding(
+                    "collective-axis", node,
+                    f"{what} names mesh axis {axis!r}, which is not "
+                    f"registered in parallel.topology.KNOWN_AXES "
+                    f"({registered}) — a typo'd or out-of-scope axis "
+                    f"only fails at trace time on a multichip mesh"))
+
+    def visit_Call(self, node):
+        name = self._collective_name(node.func)
+        if name is not None:
+            pos = _COLLECTIVE_AXIS_POS[name]
+            expr = node.args[pos] if pos < len(node.args) else None
+            if expr is None:
+                for kw in node.keywords:
+                    if kw.arg in _COLLECTIVE_AXIS_KW:
+                        expr = kw.value
+                        break
+            if expr is not None:
+                self._check_axis_expr(node, expr, f"lax.{name}")
+        else:
+            # currying sites: axis_name= on any call (partial(local,
+            # axis_name=ax), shard_map(..., axis_names={...}))
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    self._check_axis_expr(node, kw.value,
+                                          f"{kw.arg}= keyword")
+        self.generic_visit(node)
+
+
+def _lax_collective_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> CANONICAL collective name for ``from jax.lax
+    import psum [as ps]`` bindings and module-level ``psum =
+    jax.lax.psum`` re-exports (parallel/collective.py's in-jit
+    primitives)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for a in node.names:
+                if a.name in _COLLECTIVE_AXIS_POS:
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr in _COLLECTIVE_AXIS_POS \
+                and "lax" in _jax_chain(node.value)[:-1]:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.attr
+    return out
+
+
+def check_collective_axis(sf: SourceFile,
+                          axes: Dict[str, Optional[int]]
+                          ) -> List[Finding]:
+    findings: List[Finding] = []
+    _CollectiveAxisVisitor(sf, axes, _lax_collective_aliases(sf.tree),
+                           findings).visit(sf.tree)
+    return findings
+
+
+# ---------------------------------------------------------- pspec-axis
+
+def _pspec_aliases(tree: ast.Module) -> Set[str]:
+    out = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    out.add(a.asname or a.name)
+    return out
+
+
+class _PspecVisitor(_FuncScoper):
+    def __init__(self, sf: SourceFile, axes: Dict[str, Optional[int]],
+                 p_names: Set[str], findings: List[Finding]):
+        super().__init__()
+        self.sf = sf
+        self.axes = axes
+        self.p_names = p_names
+        self.scopes = _AxisScopes(sf.tree)
+        self.findings = findings
+
+    def enter_function(self, node, qualname):
+        self.scopes.push(node)
+
+    def exit_function(self, node):
+        self.scopes.pop()
+
+    def _is_pspec(self, func) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.p_names
+        return isinstance(func, ast.Attribute) \
+            and func.attr == "PartitionSpec"
+
+    def _dim_axes(self, expr) -> List[str]:
+        return self.scopes.axis_literals(expr)
+
+    def visit_Call(self, node):
+        if self._is_pspec(node.func):
+            for arg in node.args:
+                for axis in self._dim_axes(arg):
+                    if axis not in self.axes:
+                        registered = ", ".join(sorted(self.axes)) \
+                            or "<none>"
+                        self.findings.append(self.sf.finding(
+                            "pspec-axis", node,
+                            f"PartitionSpec references mesh axis "
+                            f"{axis!r}, which is not registered in "
+                            f"parallel.topology.KNOWN_AXES "
+                            f"({registered})"))
+        else:
+            self._check_divisibility(node)
+        self.generic_visit(node)
+
+    def _check_divisibility(self, node):
+        """jax.ShapeDtypeStruct((4, 6), ..., sharding=NamedSharding(
+        mesh, P("dp", None))) — the statically-knowable case: each
+        sharded dim must divide by the axis's validated degree."""
+        f = node.func
+        name = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if name != "ShapeDtypeStruct" or not node.args:
+            return
+        shape = node.args[0]
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return
+        dims = [e.value if isinstance(e, ast.Constant)
+                and isinstance(e.value, int) else None
+                for e in shape.elts]
+        spec = None
+        for kw in node.keywords:
+            if kw.arg == "sharding":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call) \
+                            and self._is_pspec(sub.func):
+                        spec = sub
+                        break
+        if spec is None:
+            return
+        for i, arg in enumerate(spec.args):
+            if i >= len(dims) or dims[i] is None:
+                continue
+            degree = 1
+            for axis in self._dim_axes(arg):
+                d = self.axes.get(axis)
+                degree *= d if d else 1
+            if degree > 1 and dims[i] % degree:
+                self.findings.append(self.sf.finding(
+                    "pspec-axis", spec,
+                    f"dim {i} of size {dims[i]} is sharded over axes "
+                    f"of validated degree {degree} "
+                    f"(parallel.topology.KNOWN_AXES) but is not "
+                    f"divisible by it — this spec fails at lowering "
+                    f"time on the real mesh"))
+
+
+def check_pspec_axis(sf: SourceFile, axes: Dict[str, Optional[int]]
+                     ) -> List[Finding]:
+    findings: List[Finding] = []
+    _PspecVisitor(sf, axes, _pspec_aliases(sf.tree),
+                  findings).visit(sf.tree)
+    return findings
+
+
+# ------------------------------------------------------------ donation
+
+#: .at[...].<mutator> suffixes — the RMW half of the donation contract
+_AT_MUTATORS = {"set", "add", "subtract", "multiply", "divide", "power",
+                "min", "max", "apply"}
+_DUS_NAMES = {"dynamic_update_slice", "dynamic_update_slice_in_dim",
+              "dynamic_update_index_in_dim"}
+#: the sanctioned conditional-donation helper (inference.
+#: carry_donate_argnums): the rule reads argnums through a call to any
+#: name with this suffix
+_DONATION_HELPER_SUFFIX = "donate_argnums"
+
+
+class _FnEntry:
+    """One function with its lexical scope links — the donation rule's
+    unit of analysis."""
+
+    __slots__ = ("sf", "module", "qualname", "node", "parent", "locals",
+                 "params", "vararg", "nparams", "rmw", "taint")
+
+    def __init__(self, sf, module, qualname, node, parent):
+        self.sf = sf
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.parent = parent            # enclosing _FnEntry or None
+        self.locals: Dict[str, "_FnEntry"] = {}
+        a = node.args
+        pos = a.posonlyargs + a.args
+        self.params = {p.arg: i for i, p in enumerate(pos)}
+        self.nparams = len(pos)
+        self.vararg = a.vararg.arg if a.vararg else None
+        #: (param position, carry component) pairs RMW'd into an
+        #: output; component None = the whole argument, an int = the
+        #: i-th element of a tuple-valued argument (so a scan carry
+        #: whose POOL component is RMW'd does not taint its token and
+        #: position components)
+        self.rmw: Set[tuple] = set()
+        #: _fn_taint cache — taint depends only on this function's own
+        #: params/assigns, never on other entries' facts, so it is
+        #: invariant across fixpoint sweeps
+        self.taint: Optional[Dict[str, Set[tuple]]] = None
+
+    def rmw_argnums(self) -> Set[int]:
+        return {p for p, _ in self.rmw}
+
+    def param_label(self, pos: int) -> str:
+        for name, i in self.params.items():
+            if i == pos:
+                return name
+        if self.vararg is not None and pos >= self.nparams:
+            return f"*{self.vararg}[{pos - self.nparams}]"
+        return f"argnum {pos}"
+
+
+def _walk_shallow(node):
+    """Walk a function body without descending into nested function /
+    class definitions (their params shadow; they are entries of their
+    own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class _DonationIndex:
+    """All functions in the package, with lexical-scope and
+    cross-module (from-import / module-alias) name resolution."""
+
+    def __init__(self, files: Dict[str, SourceFile], graph):
+        self.entries: List[_FnEntry] = []
+        self.by_module: Dict[str, Dict[str, List[_FnEntry]]] = {}
+        self.by_node: Dict[int, _FnEntry] = {}
+        self.graph = graph
+        for path, sf in files.items():
+            module = _module_name(path)
+            self.by_module.setdefault(module, {})
+            self._collect(sf, module, sf.tree, None, [])
+
+    def _collect(self, sf, module, node, parent, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                q = ".".join(qual + [child.name])
+                e = _FnEntry(sf, module, q, child, parent)
+                self.entries.append(e)
+                self.by_node[id(child)] = e
+                self.by_module[module].setdefault(child.name,
+                                                  []).append(e)
+                if parent is not None:
+                    parent.locals.setdefault(child.name, e)
+                self._collect(sf, module, child, e, qual + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                self._collect(sf, module, child, parent,
+                              qual + [child.name])
+            else:
+                self._collect(sf, module, child, parent, qual)
+
+    def resolve(self, entry: _FnEntry, func) -> List[_FnEntry]:
+        """Callee candidates for a Call's func expression: nearest
+        lexical scope, then module, then explicit imports (the same
+        name discipline as analysis/callgraph.py)."""
+        if isinstance(func, ast.Name):
+            name = func.id
+            e = entry
+            while e is not None:
+                if name in e.locals:
+                    return [e.locals[name]]
+                e = e.parent
+            hits = self.by_module.get(entry.module, {}).get(name)
+            if hits:
+                return hits
+            src = self.graph.from_imports.get(entry.module,
+                                              {}).get(name)
+            if src is not None:
+                return self.by_module.get(src[0], {}).get(src[1], [])
+            return []
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            base = func.value.id
+            if base in ("self", "cls"):
+                return self.by_module.get(entry.module,
+                                          {}).get(func.attr, [])
+            mod = self.graph.module_imports.get(entry.module,
+                                                {}).get(base)
+            if mod is not None:
+                return self.by_module.get(mod, {}).get(func.attr, [])
+        return []
+
+
+def _root_name(node) -> Optional[ast.AST]:
+    """Peel subscripts down to the Name a buffer expression roots at
+    (``carry[1]`` -> carry); attribute reads are NOT peeled (``x.T``
+    is a view of a different object in the taint sense we need)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def _taint_positions(node, taint: Dict[str, Set[tuple]],
+                     entry: _FnEntry) -> Set[tuple]:
+    """(param position, component) pairs this expression's BUFFER may
+    alias: a tainted Name, a subscript of one (``carry[1]``), or — for
+    the vararg — a constant subscript resolving to ``nparams + i``."""
+    if isinstance(node, ast.IfExp):
+        return _taint_positions(node.body, taint, entry) \
+            | _taint_positions(node.orelse, taint, entry)
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.value, ast.Name) \
+            and entry.vararg is not None \
+            and node.value.id == entry.vararg:
+        idx = node.slice
+        if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+            return {(entry.nparams + idx.value, None)}
+        return set()
+    root = _root_name(node)
+    if root is not None:
+        return taint.get(root.id, set())
+    return set()
+
+
+def _fn_taint(entry: _FnEntry) -> Dict[str, Set[tuple]]:
+    """name -> (param position, component) pairs whose buffer the
+    local may alias. Buffer-preserving flows only: plain rebinds,
+    tuple unpacks and subscripts — ``y = kv + 1`` is a NEW buffer and
+    must not taint. A tuple unpack from a whole-argument name tags
+    each target with its component index (``tok, kv, keys = carry``:
+    kv is component 1 of carry's buffer tree — an RMW on kv must not
+    implicate tok)."""
+    taint: Dict[str, Set[tuple]] = {n: {(p, None)}
+                                    for n, p in entry.params.items()}
+    for _ in range(2):
+        for sub in _walk_shallow(entry.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            pairs = _taint_positions(sub.value, taint, entry)
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and pairs:
+                    taint.setdefault(t.id, set()).update(pairs)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    if isinstance(sub.value, (ast.Tuple, ast.List)) \
+                            and len(sub.value.elts) == len(t.elts):
+                        # element-wise: (a, b) = (x, y)
+                        for te, ve in zip(t.elts, sub.value.elts):
+                            p = _taint_positions(ve, taint, entry)
+                            if isinstance(te, ast.Name) and p:
+                                taint.setdefault(te.id,
+                                                 set()).update(p)
+                    elif pairs:
+                        # `tok, kv, keys = carry`: component-tagged
+                        for i, te in enumerate(t.elts):
+                            if not isinstance(te, ast.Name):
+                                continue
+                            tagged = {(p, i if c is None else c)
+                                      for p, c in pairs}
+                            taint.setdefault(te.id,
+                                             set()).update(tagged)
+    return taint
+
+
+#: lax control-flow combinators: (callee positional index of the body
+#: fn, positional index of the carry operand, carry's param position
+#: in the body fn)
+_CARRY_COMBINATORS = {"scan": (0, 1, 0), "while_loop": (1, 2, 0),
+                      "fori_loop": (2, 3, 1)}
+
+
+def _peel_partial(func_expr):
+    """(callable expr, n leading curried positional args) — peeling
+    functools.partial(f, a, b) so body-param indexing shifts. The
+    partial predicate is SHARED with the callgraph's entry marking
+    (analysis/callgraph.py) so the two passes never disagree on what
+    counts as a curried callable."""
+    from paddle_tpu.analysis.callgraph import _is_partial
+    if isinstance(func_expr, ast.Call) and _is_partial(func_expr.func) \
+            and func_expr.args:
+        return func_expr.args[0], len(func_expr.args) - 1
+    return func_expr, 0
+
+
+def _rmw_pass(index: _DonationIndex) -> bool:
+    """One fixpoint sweep: grow each function's RMW'd-param set from
+    direct RMW sites, resolvable callees' facts, and control-flow
+    carries. Returns whether anything changed."""
+    changed = False
+    for entry in index.entries:
+        if entry.taint is None:
+            entry.taint = _fn_taint(entry)
+        taint = entry.taint
+        found: Set[tuple] = set()
+        for sub in _walk_shallow(entry.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            # x.at[...].set(...) — receiver buffer is RMW'd
+            if isinstance(f, ast.Attribute) and f.attr in _AT_MUTATORS \
+                    and isinstance(f.value, ast.Subscript) \
+                    and isinstance(f.value.value, ast.Attribute) \
+                    and f.value.value.attr == "at":
+                found |= _taint_positions(f.value.value.value, taint,
+                                          entry)
+                continue
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else None)
+            if name in _DUS_NAMES and sub.args:
+                found |= _taint_positions(sub.args[0], taint, entry)
+                continue
+            if name in _CARRY_COMBINATORS and len(sub.args) \
+                    > _CARRY_COMBINATORS[name][1]:
+                body_i, carry_i, carry_pos = _CARRY_COMBINATORS[name]
+                body_expr, offset = _peel_partial(sub.args[body_i])
+                init = sub.args[carry_i]
+                for cand in index.resolve(entry, body_expr):
+                    for pos, comp in cand.rmw:
+                        if pos != carry_pos + offset:
+                            continue
+                        if comp is not None and isinstance(
+                                init, (ast.Tuple, ast.List)) \
+                                and comp < len(init.elts):
+                            # only the RMW'd carry COMPONENT taints
+                            found |= _taint_positions(init.elts[comp],
+                                                      taint, entry)
+                        else:
+                            elts = (init.elts if isinstance(
+                                init, (ast.Tuple, ast.List))
+                                else [init])
+                            for e in elts:
+                                found |= _taint_positions(e, taint,
+                                                          entry)
+                continue
+            # ordinary call into a function with known RMW facts
+            for cand in index.resolve(entry, f):
+                if not cand.rmw:
+                    continue
+                # bound-method calls (self.scatter(pool, i)) consume
+                # the callee's param 0 as the receiver: caller arg j
+                # binds callee param j+1
+                recv = 1 if (isinstance(f, ast.Attribute)
+                             and isinstance(f.value, ast.Name)
+                             and f.value.id in ("self", "cls")
+                             and (cand.params.get("self") == 0
+                                  or cand.params.get("cls") == 0)) \
+                    else 0
+                for pos in cand.rmw_argnums():
+                    ai = pos - recv
+                    if 0 <= ai < len(sub.args):
+                        found |= _taint_positions(sub.args[ai], taint,
+                                                  entry)
+                rmw_names = {n for n, i in cand.params.items()
+                             if i in cand.rmw_argnums()}
+                for kw in sub.keywords:
+                    if kw.arg in rmw_names:
+                        found |= _taint_positions(kw.value, taint,
+                                                  entry)
+        if not found <= entry.rmw:
+            entry.rmw |= found
+            changed = True
+    return changed
+
+
+def _donated_argnums(jit_call: ast.Call) -> Optional[Set[int]]:
+    """The donated set a jit site declares: a tuple/int literal, an
+    ``(...) if cond else ()`` conditional (counted as donated — the
+    enabled branch is the contract), or a call to the sanctioned
+    ``*_donate_argnums`` helper. NO donate_argnums keyword returns
+    ``set()`` (nothing donated — the rule's main flagging case); an
+    UNRESOLVABLE expression returns None and the rule skips the site
+    rather than guessing. A ``donate_argnames=`` spelling also returns
+    None: this rule reasons by position, and a by-name donation must
+    not be flagged as undonated."""
+    expr = None
+    if any(kw.arg == "donate_argnames" for kw in jit_call.keywords):
+        return None
+    for kw in jit_call.keywords:
+        if kw.arg == "donate_argnums":
+            expr = kw.value
+            break
+    if expr is None:
+        return set()
+
+    def parse(e) -> Optional[Set[int]]:
+        if isinstance(e, ast.Constant):
+            return {e.value} if isinstance(e.value, int) else None
+        if isinstance(e, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for el in e.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, int):
+                    out.add(el.value)
+                else:
+                    return None
+            return out
+        if isinstance(e, ast.IfExp):
+            a, b = parse(e.body), parse(e.orelse)
+            if a is None and b is None:
+                return None
+            return (a or set()) | (b or set())
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            a, b = parse(e.left), parse(e.right)
+            if a is None or b is None:
+                return None
+            return a | b
+        if isinstance(e, ast.Call):
+            f = e.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else "")
+            if name.endswith(_DONATION_HELPER_SUFFIX):
+                out = set()
+                for el in e.args:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        out.add(el.value)
+                    else:
+                        return None
+                return out
+        return None
+
+    return parse(expr)
+
+
+def _is_jit_callee(func) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in ("jit", "pjit")
+    return isinstance(func, ast.Attribute) and func.attr in ("jit",
+                                                             "pjit")
+
+
+class _DonationVisitor(_FuncScoper):
+    """Per-file pass over jit sites: undonated-RMW findings plus the
+    donated-then-reused caller hazard."""
+
+    def __init__(self, sf: SourceFile, index: _DonationIndex,
+                 module: str, findings: List[Finding]):
+        super().__init__()
+        self.sf = sf
+        self.index = index
+        self.module = module
+        self.findings = findings
+        # per function frame: local name -> (donated set, jit Call);
+        # plus (call node, donated arg Name, position) dispatch records
+        self.frames: List[Dict] = [{"jitted": {}, "dispatches": []}]
+        self.entry_stack: List[Optional[_FnEntry]] = [None]
+
+    def enter_function(self, node, qualname):
+        self.frames.append({"jitted": {}, "dispatches": []})
+        entry = self.index.by_node.get(id(node))
+        self.entry_stack.append(entry)
+        if entry is not None:
+            self._check_decorators(node, entry)
+
+    def _check_decorators(self, node, entry):
+        """@jax.jit / @functools.partial(jax.jit, donate_argnums=...)
+        — the decorator-form jit site."""
+        for dec in node.decorator_list:
+            call = None
+            if isinstance(dec, ast.Call) and _is_jit_callee(dec.func):
+                call = dec
+            elif isinstance(dec, ast.Call) \
+                    and any(_is_jit_callee(a) for a in dec.args):
+                call = dec                  # partial(jax.jit, ...)
+            elif _is_jit_callee(dec):
+                for pos in sorted(entry.rmw_argnums()):
+                    self.findings.append(self._rmw_finding(dec, entry,
+                                                           pos))
+                return
+            if call is None:
+                continue
+            donated = _donated_argnums(call)
+            if donated is None:
+                return
+            for pos in sorted(entry.rmw_argnums() - donated):
+                self.findings.append(self._rmw_finding(call, entry,
+                                                       pos))
+            return
+
+    def _rmw_finding(self, node, cand: _FnEntry, pos: int) -> Finding:
+        return self.sf.finding(
+            "donation", node,
+            f"{cand.qualname}() RMWs its {cand.param_label(pos)} "
+            f"(argnum {pos}) into an output, but this jit site does "
+            f"not donate it — every dispatch copies the buffer (the "
+            f"BENCH_r06 carry-copy class); add it to donate_argnums "
+            f"or annotate why the copy is intended")
+
+    def exit_function(self, node):
+        self._flush_frame(node)
+        self.entry_stack.pop()
+
+    def exit_module(self):
+        self._flush_frame(self.sf.tree)
+
+    def _flush_frame(self, scope_node):
+        frame = self.frames.pop()
+        for call, arg_name, pos in frame["dispatches"]:
+            self._check_reuse(scope_node, call, arg_name, pos)
+
+    def _check_reuse(self, scope_node, call, arg_name, pos):
+        """A donated argument read again after the dispatch line (with
+        no intervening rebind) is a use-after-free on any backend that
+        honors donation."""
+        end = getattr(call, "end_lineno", call.lineno)
+        stores = []
+        loads = []
+        for sub in _walk_shallow(scope_node):
+            if not isinstance(sub, ast.Name) or sub.id != arg_name:
+                continue
+            # stores ON the dispatch line count as rebinds — the
+            # canonical `kv = j(kv, xs)` spelling rebinds the name to
+            # the program output in the dispatch statement itself;
+            # loads on that line are the dispatch arguments, not reuse
+            if isinstance(sub.ctx, ast.Store) \
+                    and sub.lineno >= call.lineno:
+                stores.append(sub.lineno)
+            elif isinstance(sub.ctx, ast.Load) and sub.lineno > end:
+                loads.append(sub.lineno)
+        for ln in sorted(loads):
+            # strictly-earlier stores only: `kv = kv + 1` READS the
+            # donated buffer before its own same-line store
+            if any(s < ln for s in stores):
+                break           # rebound before this read: fresh value
+            self.findings.append(Finding(
+                "donation", self.sf.path, ln, 0,
+                f"{arg_name!r} is donated to the dispatch on line "
+                f"{call.lineno} and read again here — donated buffers "
+                f"are deleted; this is a use-after-free wherever "
+                f"donation is honored", self.sf.line_text(ln)))
+            break               # one finding per dispatch
+
+    def visit_Call(self, node):
+        if _is_jit_callee(node.func) and node.args:
+            donated = _donated_argnums(node)
+            target_expr = node.args[0]
+            entry = self.entry_stack[-1]
+            candidates = []
+            if entry is not None:
+                candidates = self.index.resolve(entry, target_expr)
+            elif isinstance(target_expr, ast.Name):
+                candidates = self.index.by_module.get(
+                    self.module, {}).get(target_expr.id, [])
+            if donated is not None and candidates:
+                cand = candidates[0]
+                for pos in sorted(cand.rmw_argnums() - donated):
+                    self.findings.append(self._rmw_finding(node, cand,
+                                                           pos))
+        else:
+            # dispatch through a jitted local: record donated-arg names
+            f = node.func
+            frame = self.frames[-1]
+            rec = None
+            if isinstance(f, ast.Name):
+                # nearest enclosing frame holding the handle — a
+                # module-level `j = jax.jit(...)` dispatched inside a
+                # function is still a donation site
+                for fr in reversed(self.frames):
+                    if f.id in fr["jitted"]:
+                        rec = fr["jitted"][f.id]
+                        break
+            elif isinstance(f, ast.Call) and _is_jit_callee(f.func):
+                d = _donated_argnums(f)
+                rec = d if d else None
+            if rec:
+                for pos in rec:
+                    if pos < len(node.args) \
+                            and isinstance(node.args[pos], ast.Name) \
+                            and not any(isinstance(a, ast.Starred)
+                                        for a in node.args[:pos]):
+                        frame["dispatches"].append(
+                            (node, node.args[pos].id, pos))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # `jitted = jax.jit(impl, donate_argnums=...)` — remember the
+        # local handle's donated set for dispatch-site reuse checks
+        if isinstance(node.value, ast.Call) \
+                and _is_jit_callee(node.value.func):
+            donated = _donated_argnums(node.value)
+            if donated:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.frames[-1]["jitted"][t.id] = donated
+        self.generic_visit(node)
+
+
+def check_donation(files: Dict[str, SourceFile], graph
+                   ) -> List[Finding]:
+    index = _DonationIndex(files, graph)
+    for _ in range(8):              # cross-module fixpoint
+        if not _rmw_pass(index):
+            break
+    findings: List[Finding] = []
+    for path, sf in files.items():
+        v = _DonationVisitor(sf, index, _module_name(path), findings)
+        v.visit(sf.tree)
+        v.exit_module()
+    return findings
+
+
 # -------------------------------------------------------------- driver
 
 def _module_name(path: str) -> str:
@@ -1338,20 +2229,28 @@ def _module_name(path: str) -> str:
 
 ALL_RULES = ("host-sync", "traced-branch", "default-dtype",
              "metric-drift", "fault-site", "snapshot-coverage",
-             "journal-coverage", "rng-stream")
+             "journal-coverage", "rng-stream", "collective-axis",
+             "pspec-axis", "donation")
 
 
 def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
               fault_sites: Set[str],
-              rules=ALL_RULES) -> List[Finding]:
+              rules=ALL_RULES,
+              known_axes: Optional[Dict[str, Optional[int]]] = None
+              ) -> List[Finding]:
     findings: List[Finding] = []
+    axes = known_axes or {}
     per_file = {"host-sync": lambda sf: check_host_sync(sf, graph),
                 "traced-branch": lambda sf: check_traced_branch(sf, graph),
                 "default-dtype": check_default_dtype,
                 "fault-site": lambda sf: check_fault_site(sf, fault_sites),
-                "snapshot-coverage": check_snapshot_coverage}
+                "snapshot-coverage": check_snapshot_coverage,
+                "collective-axis":
+                    lambda sf: check_collective_axis(sf, axes),
+                "pspec-axis": lambda sf: check_pspec_axis(sf, axes)}
     aggregate = {"journal-coverage": check_journal_coverage,
-                 "rng-stream": check_rng_stream}
+                 "rng-stream": check_rng_stream,
+                 "donation": lambda fs: check_donation(fs, graph)}
     for rule in rules:
         if rule == "metric-drift":
             sources = {p: sf.source for p, sf in files.items()}

@@ -34,16 +34,18 @@ path.
 """
 
 import json
+import re
 import threading
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 import jax
 
-__all__ = ["CompileCounter", "RecompileError", "SnapshotDriftError",
-           "TransferError", "canonical_snapshot",
-           "canonical_snapshot_bytes", "compare_snapshots",
-           "count_compiles", "no_recompile", "no_transfer", "sanitize",
+__all__ = ["CompileCounter", "DonationError", "DonationReport",
+           "RecompileError", "SnapshotDriftError", "TransferError",
+           "canonical_snapshot", "canonical_snapshot_bytes",
+           "compare_snapshots", "count_compiles", "donation_report",
+           "no_recompile", "no_transfer", "sanitize",
            "snapshot_roundtrip", "compile_events_supported"]
 
 #: the monitoring event one real XLA backend compile emits (jax 0.4+);
@@ -173,6 +175,208 @@ def sanitize(what: str = "region", h2d: bool = True, d2h: bool = False,
     with no_transfer(h2d=h2d, d2h=d2h, what=what), \
             no_recompile(allow=allow_compiles, what=what):
         yield
+
+
+# ----------------------------------------------------- donation report
+
+class DonationError(RuntimeError):
+    """A ``DonationReport.expect_aliased`` pin failed: an input the
+    program was expected to alias into an output is being copied."""
+
+
+#: one `{out...}: (param, {...}, kind)` entry in the compiled HLO
+#: module header's input_output_alias table
+_ALIAS_ENTRY = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[^}]*\},\s*([a-z-]+)\)")
+
+
+def _alias_table(hlo_text: str) -> str:
+    """The brace-balanced body of ``input_output_alias={...}`` in a
+    compiled module header ('' when the program aliases nothing)."""
+    key = "input_output_alias={"
+    i = hlo_text.find(key)
+    if i < 0:
+        return ""
+    depth, j = 1, i + len(key)
+    while j < len(hlo_text) and depth:
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+        j += 1
+    return hlo_text[i + len(key):j - 1]
+
+
+def _entry_param_types(hlo_text: str) -> List[str]:
+    """Layout-stripped parameter type strings ('bf16[2,34,32,128]')
+    from the compiled module's entry_computation_layout, in parameter
+    order. The OPTIMIZED module's parameter numbering — XLA dead-codes
+    unused inputs and renumbers — so alias entries must be matched to
+    jax-level arguments by type, not by flat position."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text)
+    if not m:
+        return []
+    out = []
+    for tok in m.group(1).split(", "):
+        tok = re.sub(r"/\*[^*]*\*/", "", tok)       # /*index=N*/
+        out.append(re.sub(r"\{[^}]*\}", "", tok).strip())
+    return out
+
+
+#: numpy dtype name -> HLO primitive-type name
+_HLO_DTYPES = {"float32": "f32", "float64": "f64", "float16": "f16",
+               "bfloat16": "bf16", "int8": "s8", "int16": "s16",
+               "int32": "s32", "int64": "s64", "uint8": "u8",
+               "uint16": "u16", "uint32": "u32", "uint64": "u64",
+               "bool": "pred", "complex64": "c64", "complex128": "c128"}
+
+
+def _aval_type(aval) -> str:
+    dt = _HLO_DTYPES.get(str(aval.dtype), str(aval.dtype))
+    return f"{dt}[{','.join(str(d) for d in aval.shape)}]"
+
+
+class DonationReport:
+    """What ONE lowered+compiled program actually does with its
+    inputs: per python-argnum leaf counts, how many leaves the caller
+    DECLARED donated (``jax.jit(..., donate_argnums=)``), and how many
+    XLA actually wired into the input_output_alias table (with the
+    alias kind — ``may-alias``/``must-alias``). The static half of the
+    donation story is the ``donation`` lint rule; this is the runtime
+    proof that "the TPU path aliases the carry away" — or the evidence
+    that a backend quietly copies instead."""
+
+    __slots__ = ("what", "args", "alias_kinds")
+
+    def __init__(self, what: str):
+        self.what = what
+        #: argnum -> {"leaves", "donated", "aliased"}
+        self.args: Dict[int, Dict] = {}
+        self.alias_kinds: List[str] = []
+
+    @property
+    def donated_argnums(self) -> List[int]:
+        return sorted(a for a, d in self.args.items() if d["donated"])
+
+    @property
+    def aliased_argnums(self) -> List[int]:
+        return sorted(a for a, d in self.args.items() if d["aliased"])
+
+    def fully_aliased(self, argnum: int) -> bool:
+        d = self.args.get(argnum)
+        return bool(d) and d["aliased"] == d["leaves"]
+
+    def expect_aliased(self, *argnums: int):
+        """Assert every listed argnum has ALL its leaves aliased into
+        outputs — the test-pin form. Returns self for chaining."""
+        for a in argnums:
+            if not self.fully_aliased(a):
+                d = self.args.get(a, {"leaves": 0, "donated": 0,
+                                      "aliased": 0})
+                raise DonationError(
+                    f"{self.what}: argnum {a} expected input->output "
+                    f"aliasing but got {d['aliased']}/{d['leaves']} "
+                    f"leaves aliased ({d['donated']} declared donated) "
+                    f"— the dispatch copies this buffer")
+        return self
+
+    def __repr__(self):
+        rows = ", ".join(
+            f"{a}: {d['aliased']}/{d['leaves']} aliased"
+            f"{' (donated)' if d['donated'] else ''}"
+            for a, d in sorted(self.args.items()))
+        return f"DonationReport({self.what}: {rows})"
+
+
+def donation_report(fn, *args, static_argnums=(), what="program",
+                    **kwargs) -> DonationReport:
+    """Lower AND compile ``fn(*args, **kwargs)`` and report which
+    inputs actually aliased outputs — the runtime half of the
+    ``donation`` lint rule (docs/ANALYSIS.md §donation).
+
+    ``fn`` is a jitted callable (anything with ``.lower``), or an
+    engine program handle carrying ``.jitted``/``.bound`` attributes
+    (the serving engine's step/verify/chunk lambdas expose these so
+    test pins can audit the live programs with their bound state).
+    ``static_argnums`` must repeat the jit wrapper's, so flat
+    parameters map back to the right python argnums. Argnums are
+    positions in the LOWERED call — bound leading arguments included.
+
+    The declared side comes from ``Lowered.args_info`` (per-leaf
+    ``donated`` flags); the actual side is parsed from the compiled
+    module's ``input_output_alias`` header — one entry per flat
+    parameter XLA wired to an output buffer. A backend that drops
+    donation (old-jax CPU) shows declared > aliased, which is exactly
+    the BENCH_r06 chunked-capacity caveat made visible."""
+    target = fn
+    bound = ()
+    if not hasattr(target, "lower"):
+        jitted = getattr(fn, "jitted", None)
+        if jitted is None:
+            raise TypeError(
+                f"donation_report needs a jitted callable (or an "
+                f"engine program handle with .jitted/.bound); got "
+                f"{type(fn).__name__}")
+        b = getattr(fn, "bound", ())
+        bound = tuple(b() if callable(b) else b)
+        target = jitted
+    lowered = target.lower(*bound, *args, **kwargs)
+    compiled = lowered.compile()
+
+    report = DonationReport(what)
+    info_args, _info_kwargs = lowered.args_info
+    statics = set(static_argnums)
+    # python argnums of the DYNAMIC positional args, in order (statics
+    # never reach args_info or the parameter list)
+    n_total = len(info_args) + len(statics)
+    dyn_argnums = [i for i in range(n_total) if i not in statics]
+
+    # the OPTIMIZED module renumbers parameters (DCE drops unused
+    # inputs — the step program dead-codes most state leaves), so
+    # alias entries map back to jax arguments by TYPE: only donated
+    # leaves are alias candidates. Identically-typed donated leaves
+    # are indistinguishable in the table, so a type is credited only
+    # when the aliased supply covers EVERY donated leaf of that type —
+    # a partially-aliased ambiguous type counts as copied for all of
+    # them (expect_aliased fails closed instead of false-passing on
+    # whichever argnum is visited first).
+    hlo = compiled.as_text()
+    param_types = _entry_param_types(hlo)
+    aliased_types: Dict[str, int] = {}
+    for entry in _ALIAS_ENTRY.finditer(_alias_table(hlo)):
+        idx = int(entry.group(1))
+        report.alias_kinds.append(entry.group(2))
+        if idx < len(param_types):
+            t = param_types[idx]
+            aliased_types[t] = aliased_types.get(t, 0) + 1
+
+    def _leaf_type(leaf) -> Optional[str]:
+        aval = getattr(leaf, "_aval", None) or getattr(leaf, "aval",
+                                                       None)
+        return None if aval is None else _aval_type(aval)
+
+    donated_demand: Dict[str, int] = {}
+    for tree in info_args:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if getattr(leaf, "donated", False):
+                t = _leaf_type(leaf)
+                if t is not None:
+                    donated_demand[t] = donated_demand.get(t, 0) + 1
+
+    for argnum, tree in zip(dyn_argnums, info_args):
+        leaves = jax.tree_util.tree_leaves(tree)
+        donated = aliased = 0
+        for leaf in leaves:
+            if not getattr(leaf, "donated", False):
+                continue
+            donated += 1
+            t = _leaf_type(leaf)
+            if t is not None and aliased_types.get(t, 0) \
+                    >= donated_demand.get(t, 0):
+                aliased += 1
+        report.args[argnum] = {"leaves": len(leaves),
+                               "donated": donated, "aliased": aliased}
+    return report
 
 
 # ------------------------------------------------- snapshot round trip

@@ -106,6 +106,19 @@ def _fold_rows(keys, t):
     return jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, t)
 
 
+def carry_donate_argnums(*argnums):
+    """``donate_argnums`` for a chunked-decode KV carry: the given
+    argnums on accelerators, ``()`` on the CPU backend (jax-0.4 CPU
+    executes donation as a defensive copy per chunk — the BENCH_r06
+    capacity caveat — and older jaxlibs warn per program; the TPU path
+    aliases the carry away, which ``analysis.runtime.donation_report``
+    makes checkable). ONE definition shared by `generate`'s traced
+    chunk programs and the stacked decoder's — and the spelling the
+    ``donation`` lint rule recognizes as a sanctioned conditional
+    donation (docs/ANALYSIS.md §donation)."""
+    return tuple(argnums) if jax.default_backend() != "cpu" else ()
+
+
 def _request_seeds(request_seeds, seed, b):
     """(b,) uint32 per-request seeds — explicit streams, or the default
     ``seed + row`` convention. ONE definition: `generate`, the stacked
@@ -346,13 +359,12 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
             # donate the carry across the chunk dispatches so XLA
             # aliases the KV buffer instead of copying it per chunk (a 7B
             # cache copied every 32 tokens would skew the TPOT this mode
-            # measures and double peak HBM). CPU never implements
-            # donation — skip there to avoid per-program warnings.
-            don = jax.default_backend() != "cpu"
+            # measures and double peak HBM); carry_donate_argnums gates
+            # the CPU backend off
             traced_fns = (
                 jax.jit(_prefill_impl),
                 jax.jit(_decode_impl, static_argnums=(4,),
-                        donate_argnums=(1,) if don else ()))
+                        donate_argnums=carry_donate_argnums(1)))
             jit_cache[jit_key + ("traced",)] = traced_fns
 
     # per-request RNG streams: row r samples token t from

@@ -368,13 +368,13 @@ class StackedLlamaDecoder:
                 self._jit_cache[jk] = run
             else:
                 # donate the KV carry across chunk dispatches (see
-                # inference.generate: avoids a full-cache copy per chunk
-                # on accelerators; CPU skips — donation unimplemented)
-                don = jax.default_backend() != "cpu"
+                # inference.carry_donate_argnums: avoids a full-cache
+                # copy per chunk on accelerators; CPU gated off)
+                from paddle_tpu.inference import carry_donate_argnums
                 traced_fns = (
                     jax.jit(_prefill_impl),
                     jax.jit(_decode_impl, static_argnums=(7,),
-                            donate_argnums=(4,) if don else ()))
+                            donate_argnums=carry_donate_argnums(4)))
                 self._jit_cache[jk + ("traced",)] = traced_fns
 
         head_arrays = tuple(self.head[1:])

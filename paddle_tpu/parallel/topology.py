@@ -24,6 +24,29 @@ from jax.sharding import Mesh
 
 AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
 
+#: The sanctioned mesh-axis names, mapped to the degree the multichip
+#: dryrun validates (MULTICHIP_r0x leg(16): {dp: 2, pp: 2, sharding: 2,
+#: mp: 2} with loss invariance) — None for axes with no pinned degree
+#: (`sep` runs degree 1 in the dryrun, `ep` is carved out of
+#: dp×sharding per deployment, `g` is the eager collective veneer's
+#: private 1-D group axis). This registry is what the `collective-axis`
+#: and `pspec-axis` lint rules (paddle_tpu/analysis/rules.py,
+#: docs/ANALYSIS.md) pin axis-name literals against: a typo'd or
+#: unregistered axis is a lint finding at author time instead of a
+#: trace error on a v5p mesh. The degrees feed the pspec-axis
+#: sharded-dim divisibility check where tensor sizes are statically
+#: known. Registering a new axis here is the one-line gate for
+#: introducing it anywhere in the package.
+KNOWN_AXES = {
+    "dp": 2,
+    "pp": 2,
+    "sharding": 2,
+    "sep": None,
+    "mp": 2,
+    "ep": None,
+    "g": None,
+}
+
 
 def build_mesh(axis_dims: Dict[str, int], devices=None) -> Mesh:
     """Build a named Mesh from {axis: degree}; degrees must multiply to #devices

@@ -132,6 +132,17 @@ _req_id_state = {"next": 0}
 _req_id_lock = threading.Lock()
 
 
+def _program_handle(jitted, bound):
+    """Wrap a jitted program with its bound leading arguments and
+    attach the ``.jitted``/``.bound`` audit handle
+    ``analysis.runtime.donation_report`` lowers the REAL program
+    through (docs/ANALYSIS.md §Donation report). ``bound`` is a
+    thunk so the handle tracks state swaps (restore/recover)."""
+    fn = lambda *a: jitted(*bound(), *a)    # noqa: E731
+    fn.jitted, fn.bound = jitted, bound
+    return fn
+
+
 def _next_req_id() -> int:
     with _req_id_lock:
         v = _req_id_state["next"]
@@ -1273,7 +1284,7 @@ class ServingEngine:
         # `state` flows as a traced argument (matching generate) so the
         # weights are not baked into the program as constants
         jitted = jax.jit(impl, donate_argnums=(1,))
-        fn = lambda *a: jitted(self._state, *a)   # noqa: E731
+        fn = _program_handle(jitted, lambda: (self._state,))
         self._jit_cache[key] = fn
         return fn, False
 
@@ -1387,7 +1398,7 @@ class ServingEngine:
 
         donate = (1,) if has_pool else ()
         jitted = jax.jit(impl, donate_argnums=donate)
-        fn = lambda *a: jitted(self._state, *a)   # noqa: E731
+        fn = _program_handle(jitted, lambda: (self._state,))
         self._jit_cache[key] = fn
         return fn, False
 
@@ -2097,7 +2108,8 @@ class ServingEngine:
         # on TPU the Pallas kernel aliases the pool and donation skips
         # the defensive copy
         jitted = jax.jit(impl, donate_argnums=(2,))
-        return lambda *a: jitted(self._state, self._stacked, *a)
+        return _program_handle(jitted,
+                               lambda: (self._state, self._stacked))
 
     # ------------------------------------------------- speculative decode
     def _prop_zero(self, K: int):
@@ -2319,8 +2331,16 @@ class ServingEngine:
             return (g, acc, pool, pos2, tok2, counts2, hist2, prop2,
                     jnp.minimum(nprop2, cap))
 
-        jitted = jax.jit(impl, donate_argnums=(2,))
-        return lambda *a: jitted(self._state, self._stacked, *a)
+        # donate the history buffer alongside the pool: the ngram path
+        # RMWs it every verify tick (hist2 = history.at[...].set) and
+        # the caller rebinds self._dev_hist from the output, so the old
+        # buffer is dead at dispatch — undonated it cost one full
+        # (max_slots, max_seq_len) copy per speculative tick (the
+        # donation lint rule's first catch; donation_report pins it)
+        jitted = jax.jit(impl,
+                         donate_argnums=(2,) + ((12,) if ngram else ()))
+        return _program_handle(jitted,
+                               lambda: (self._state, self._stacked))
 
     def _build_draft_fn(self, K: int):
         """Draft-proposer round: ONE scanned program runs k+1 greedy
@@ -2373,8 +2393,8 @@ class ServingEngine:
             return props[:K].T.astype(jnp.int32), pool
 
         jitted = jax.jit(impl, donate_argnums=(2,))
-        return lambda *a: jitted(self._draft_state, self._draft_stacked,
-                                 *a)
+        return _program_handle(
+            jitted, lambda: (self._draft_state, self._draft_stacked))
 
     def _draft_prefill_fn(self, s_pad):
         """Draft prefill program (keyed by padded feed length, like the
@@ -2408,7 +2428,7 @@ class ServingEngine:
             return pool.at[:, new_bids].set(blk.astype(pool.dtype))
 
         jitted = jax.jit(impl, donate_argnums=(1,))
-        fn = lambda *a: jitted(self._draft_state, *a)   # noqa: E731
+        fn = _program_handle(jitted, lambda: (self._draft_state,))
         self._jit_cache[key] = fn
         return fn, False
 

@@ -336,6 +336,268 @@ def test_new_rules_in_all_and_filterable():
     assert res.ok, res.findings
 
 
+# --------------------------------- mesh/donation rules (this PR)
+
+def test_known_axes_registry_parses_and_matches_import():
+    """The statically-parsed registry equals the importable one, and
+    the multichip-validated axes carry their dryrun degrees."""
+    from paddle_tpu.parallel.topology import KNOWN_AXES
+    with open(os.path.join(ROOT, "paddle_tpu", "parallel",
+                           "topology.py"), encoding="utf-8") as fh:
+        parsed = rules_mod.known_mesh_axes(fh.read())
+    assert parsed == KNOWN_AXES
+    assert {"dp", "pp", "sharding", "sep", "mp"} <= set(parsed)
+    assert parsed["mp"] == 2 and parsed["dp"] == 2
+
+
+def test_collective_axis_rule():
+    """Axis-name literals on named-axis collectives pin against
+    KNOWN_AXES — resolved through parameter defaults, locals and
+    module constants; dynamic axes are the documented blind spot."""
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "PIPE = 'pp'\n"
+        "def good(x):\n"
+        "    return jax.lax.psum(x, 'mp')\n"
+        "def const(x):\n"
+        "    return lax.pmean(x, PIPE)\n"
+        "def typo(x):\n"
+        "    return lax.psum(x, 'modelp')\n"              # flagged
+        "def via_default(x, axis_name='sharding'):\n"
+        "    return lax.ppermute(x, axis_name, [(0, 1)])\n"
+        "def bad_default(x, axis_name='shard'):\n"
+        "    return lax.all_gather(x, axis_name)\n"       # flagged
+        "def tupled(x):\n"
+        "    return jax.lax.pcast(x, ('pp', 'bogus'), to='varying')\n"
+        "def kw_form(x):\n"
+        "    return lax.pmax(x, axis_name='dq')\n"        # flagged
+        "def dynamic(x, axis_name):\n"
+        "    return lax.pmax(x, axis_name)\n"              # blind spot
+        "def shadowed(x, axis_name):\n"
+        "    def inner():\n"
+        "        axis_name = 'bogus'\n"        # inner scope must NOT
+        "        return axis_name\n"           # leak into outer's pmax
+        "    return lax.pmax(x, axis_name), inner\n")
+    res = _lint(_files(**{"parallel.mod": src}),
+                rules=("collective-axis",))
+    assert sorted(f.line for f in res.findings) == [9, 13, 15, 17], \
+        res.findings
+
+
+def test_collective_axis_resolves_import_aliases():
+    """`from jax.lax import psum as ps` resolves to the canonical
+    collective (and must not crash the run), and a reassigned axis
+    local resolves to the assignment in TEXT order (last write wins)."""
+    src = (
+        "from jax.lax import psum as ps, pmean\n"
+        "def good(x):\n"
+        "    return ps(x, 'mp')\n"
+        "def bad(x):\n"
+        "    return ps(x, 'mpp') + pmean(x, 'dq')\n")      # 2 findings
+    res = _lint(_files(**{"parallel.mod": src}),
+                rules=("collective-axis",))
+    assert [f.line for f in res.findings] == [5, 5], res.findings
+    src2 = (
+        "import jax\n"
+        "def rebound(x):\n"
+        "    ax = 'tmp_not_an_axis'\n"
+        "    ax = 'mp'\n"
+        "    return jax.lax.psum(x, ax)\n")                # clean: 'mp'
+    assert not _lint(_files(**{"parallel.mod": src2}),
+                     rules=("collective-axis",)).findings
+
+
+def test_collective_axis_sees_curried_axis_name_kwargs():
+    """axis_name= keywords at currying sites (partial(local_fn,
+    axis_name=...)) are checked even though the collective itself is
+    inside the curried function — the shard_map composition sites."""
+    src = (
+        "from functools import partial\n"
+        "def local_fn(x, axis_name):\n"
+        "    import jax\n"
+        "    return jax.lax.psum(x, axis_name)\n"
+        "def compose(x):\n"
+        "    good = partial(local_fn, axis_name='sep')\n"
+        "    bad = partial(local_fn, axis_name='sepp')\n"  # flagged
+        "    return good, bad\n")
+    res = _lint(_files(**{"parallel.mod": src}),
+                rules=("collective-axis",))
+    assert [f.line for f in res.findings] == [7], res.findings
+
+
+def test_pspec_axis_rule_and_divisibility():
+    """PartitionSpec literals pin against KNOWN_AXES; a spec attached
+    to a statically-known shape additionally checks sharded-dim
+    divisibility by the axis's validated degree."""
+    src = (
+        "import jax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "AX = 'mp'\n"
+        "def specs(axis='dp'):\n"
+        "    good = P(None, axis)\n"
+        "    alias = P(AX)\n"
+        "    bad = P('rows')\n"                            # flagged
+        "    multi = P(('dp', 'cols'), None)\n"            # flagged
+        "    return good, alias, bad, multi\n"
+        "def divis(mesh):\n"
+        "    ok = jax.ShapeDtypeStruct((4, 6), 'f4',\n"
+        "        sharding=NamedSharding(mesh, P('dp', None)))\n"
+        "    bad = jax.ShapeDtypeStruct((5, 6), 'f4',\n"
+        "        sharding=NamedSharding(mesh, P('dp', None)))\n"
+        "    return ok, bad\n")
+    res = _lint(_files(**{"parallel.mod": src}), rules=("pspec-axis",))
+    lines = sorted(f.line for f in res.findings)
+    assert lines == [7, 8, 14], res.findings
+    assert "divisible" in [f for f in res.findings
+                           if f.line == 14][0].message
+
+
+def test_donation_rule_rmw_carry():
+    """A jitted function whose argument flows through an RMW chain —
+    here via a lax.scan carry component — must donate that argnum; the
+    carry_donate_argnums helper spelling is sanctioned; a donated site
+    is clean; non-RMW'd carry components never flag."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def carry_donate_argnums(*a):\n"
+        "    return tuple(a)\n"
+        "def make(n):\n"
+        "    def impl(state, carry, steps):\n"
+        "        def body(c, i):\n"
+        "            tok, kv = c\n"
+        "            kv = kv.at[i].set(tok)\n"
+        "            return (tok, kv), tok\n"
+        "        c, toks = lax.scan(body, carry, jnp.arange(steps))\n"
+        "        return c, toks\n"
+        "    bad = jax.jit(impl)\n"                        # flagged
+        "    good = jax.jit(impl, donate_argnums=(1,))\n"
+        "    blessed = jax.jit(impl,\n"
+        "        donate_argnums=carry_donate_argnums(1))\n"
+        "    return bad, good, blessed\n")
+    res = _lint(_files(mod=src), rules=("donation",))
+    assert [f.line for f in res.findings] == [14], res.findings
+    assert "argnum 1" in res.findings[0].message
+
+
+def test_donation_rule_vararg_and_dus():
+    """dynamic_update_slice counts as RMW, and a const-indexed vararg
+    (the verify program's *hist pattern) maps to its argnum."""
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def impl(x, *hist):\n"
+        "    h = hist[0]\n"
+        "    h2 = lax.dynamic_update_slice(h, x, (0,))\n"
+        "    return h2\n"
+        "bad = jax.jit(impl)\n"                            # flagged
+        "good = jax.jit(impl, donate_argnums=(1,))\n")
+    res = _lint(_files(mod=src), rules=("donation",))
+    assert [f.line for f in res.findings] == [7], res.findings
+    assert "*hist[0]" in res.findings[0].message
+
+
+def test_donation_rule_method_receiver_and_argnames():
+    """A bound-method RMW callee (self.scatter) maps caller args past
+    the receiver — a correctly-donated site must stay clean; and a
+    jit site donating BY NAME (donate_argnames=) is skipped, not
+    flagged as undonated."""
+    src = (
+        "import jax\n"
+        "class Pool:\n"
+        "    def scatter(self, kv, idx):\n"
+        "        return kv.at[idx].set(0)\n"
+        "    def build(self):\n"
+        "        def impl(pool, idx):\n"
+        "            return self.scatter(pool, idx)\n"
+        "        ok = jax.jit(impl, donate_argnums=(0,))\n"
+        "        named = jax.jit(impl, donate_argnames='pool')\n"
+        "        leaky = jax.jit(impl)\n"              # flagged: pool
+        "        return ok, named, leaky\n")
+    res = _lint(_files(mod=src), rules=("donation",))
+    assert [f.line for f in res.findings] == [10], res.findings
+    assert "pool (argnum 0)" in res.findings[0].message
+
+
+def test_donation_rule_cross_module_and_decorator():
+    """RMW facts propagate through package calls (the
+    fused_decode_step seam), and decorator-form jit sites are checked
+    like call-form ones."""
+    kernel = (
+        "def rmw_step(x, cache, pos):\n"
+        "    return cache.at[pos].set(x)\n")
+    mod = (
+        "import jax\n"
+        "import functools\n"
+        "from paddle_tpu.kernel import rmw_step\n"
+        "@jax.jit\n"
+        "def leaky(x, cache):\n"
+        "    return rmw_step(x, cache, 0)\n"               # flagged @4
+        "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+        "def clean(x, cache):\n"
+        "    return rmw_step(x, cache, 0)\n")
+    res = _lint(_files(kernel=kernel, mod=mod), rules=("donation",))
+    assert [(f.path, f.line) for f in res.findings] == [
+        ("paddle_tpu/mod.py", 4)], res.findings
+
+
+def test_donation_rule_donated_then_reused():
+    """The reverse hazard: a donated argument read by the caller after
+    the dispatch is flagged (use-after-free wherever donation is
+    honored); a rebind before the read clears it."""
+    src = (
+        "import jax\n"
+        "def impl(kv, x):\n"
+        "    return kv.at[0].set(x)\n"
+        "def driver(kv, xs):\n"
+        "    j = jax.jit(impl, donate_argnums=(0,))\n"
+        "    out = j(kv, xs)\n"
+        "    total = kv.sum()\n"                           # flagged
+        "    kv = out\n"
+        "    out2 = j(kv, xs)\n"
+        "    return out2, total\n")
+    res = _lint(_files(mod=src), rules=("donation",))
+    assert [f.line for f in res.findings] == [7], res.findings
+    assert "use-after-free" in res.findings[0].message
+    # a module-level jitted handle dispatched inside a function is
+    # still a donation site, and a same-line store must not mask its
+    # own RHS read (`kv = kv + 1` reads the donated buffer first)
+    src2 = (
+        "import jax\n"
+        "def impl(kv, x):\n"
+        "    return kv.at[0].set(x)\n"
+        "j = jax.jit(impl, donate_argnums=(0,))\n"
+        "def driver(kv, xs):\n"
+        "    out = j(kv, xs)\n"
+        "    kv = kv + 1\n"                                # flagged
+        "    return out, kv\n"
+        "def canonical(kv, xs):\n"
+        "    kv = j(kv, xs)\n"        # same-line rebind: NOT reuse
+        "    return kv\n")
+    res2 = _lint(_files(mod=src2), rules=("donation",))
+    assert [f.line for f in res2.findings] == [7], res2.findings
+
+
+def test_callgraph_shim_aliases_and_partial_peeling():
+    """The jaxcompat spellings reach the traced set: a from-import
+    alias of shard_map marks entries, and partial(f, ...) operands
+    are peeled — so reachability-scoped rules resolve the same sites
+    on 0.4.x and 0.9."""
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "from jax.experimental.shard_map import shard_map as _esm\n"
+        "def local_fn(x):\n"
+        "    return float(x.sum())\n"          # flagged iff reachable
+        "def outer(x, mesh):\n"
+        "    return _esm(partial(local_fn), mesh=mesh)(x)\n")
+    res = _lint(_files(mod=src), rules=("host-sync",))
+    assert [(f.path, f.line) for f in res.findings] == [
+        ("paddle_tpu/mod.py", 5)], res.findings
+
+
 # ------------------------------------------- suppressions and baseline
 
 def test_inline_and_statement_suppressions():
@@ -451,13 +713,26 @@ def test_package_lints_clean_under_budget():
 def test_burned_down_dirs_have_no_baseline_entries():
     """The hot-path dirs are at ZERO baseline debt: every host-sync
     site in serving/, ops/ and inference/ is either fixed or carries a
-    classified `# tpu-lint: allow(...)` annotation."""
+    classified `# tpu-lint: allow(...)` annotation — and the mesh/
+    donation rules hold parallel/ (plus those dirs) at zero debt too:
+    a new unregistered axis, rotten PartitionSpec or undonated RMW
+    carry in the hybrid-parallel layer fails --check outright."""
     with open(baseline_mod.baseline_path(ROOT)) as fh:
         entries = json.load(fh)["findings"]
     hot = [e for e in entries if e["path"].startswith(
         ("paddle_tpu/serving/", "paddle_tpu/ops/",
          "paddle_tpu/inference/"))]
     assert not hot, hot
+    mesh_rules = {"collective-axis", "pspec-axis", "donation"}
+    mesh_debt = [e for e in entries if e["rule"] in mesh_rules
+                 and e["path"].startswith(
+                     ("paddle_tpu/parallel/", "paddle_tpu/serving/",
+                      "paddle_tpu/ops/", "paddle_tpu/inference/"))]
+    assert not mesh_debt, mesh_debt
+    res = lint.run_lint(ROOT, rules=tuple(mesh_rules),
+                        paths=["paddle_tpu/parallel", "paddle_tpu/ops",
+                               "paddle_tpu/inference"])
+    assert res.ok, res.findings
 
 
 def test_update_baseline_deterministic_and_committed():
@@ -546,6 +821,31 @@ def test_no_transfer_blocks_h2d():
     with pytest.raises(rt.TransferError):
         with rt.no_transfer():
             jnp.asarray(host)               # explicit upload
+
+
+def test_donation_report_first_principles():
+    """donation_report proves input->output aliasing: a donated RMW
+    carry shows every leaf wired into the compiled module's
+    input_output_alias table; the undonated twin shows the copy."""
+    def impl(state, carry, n):
+        kv = carry[1]
+        return carry[0] + 1.0, kv.at[0].set(state.sum())
+
+    args = (jnp.ones(3), (jnp.zeros(2), jnp.zeros((2, 4))), 4)
+    j = jax.jit(impl, static_argnums=(2,), donate_argnums=(1,))
+    rep = rt.donation_report(j, *args, static_argnums=(2,),
+                             what="donated carry")
+    assert rep.donated_argnums == [1]
+    assert rep.args[1] == {"leaves": 2, "donated": 2, "aliased": 2}
+    rep.expect_aliased(1)
+    with pytest.raises(rt.DonationError, match="argnum 0"):
+        rep.expect_aliased(0)
+    # the undonated twin: same program, no aliasing — the per-dispatch
+    # copy donation exists to remove, made visible
+    j2 = jax.jit(impl, static_argnums=(2,))
+    rep2 = rt.donation_report(j2, *args, static_argnums=(2,),
+                              what="undonated carry")
+    assert rep2.donated_argnums == [] and rep2.aliased_argnums == []
 
 
 # ------------------------------- the repo's invariants, as properties
@@ -774,6 +1074,108 @@ def test_router_steady_state_zero_h2d_zero_recompiles():
         assert guarded == 6
         assert router.stats["sanitized_steps"] >= 2 * guarded
         router.drain(max_steps=200)
+
+
+def test_donation_report_serving_pool_step_and_chunk_programs():
+    """THE donation pins: the serving pool-step program aliases its KV
+    pool input into the pool output (every leaf), and the chunked-
+    prefill programs alias the pool the same way — 'the TPU path
+    aliases it away' as a checked property instead of a prose caveat
+    (SCALE.md). The engine program handles carry .jitted/.bound so the
+    report lowers the REAL programs with their bound state."""
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(7)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=256, chunk_tokens=32,
+                               prefix_caching=False) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (40,)),
+                                   max_new_tokens=6))
+        for _ in range(4):          # chunks + adopt + first decode
+            eng.step()
+        assert eng._step_fn is not None
+        rep = rt.donation_report(eng._step_fn, eng.kv_pool, *eng._dev,
+                                 what="serving pool step")
+        # argnums are lowered-call positions: state=0, stacked=1, pool=2
+        assert rep.donated_argnums == [2]
+        rep.expect_aliased(2)
+        assert rep.args[2]["leaves"] == 1
+        # chunked-prefill: the first mid-chunk program (start=0)
+        # donates and aliases the pool; its bf16 KV carry is a fresh,
+        # LARGER output by construction (the O(prompt²/chunk) shape
+        # growth) — pool aliasing is what keeps chunking affordable
+        chunk_fn = eng._jit_cache.get(("chunk", "mid", False, 0, 0))
+        assert chunk_fn is not None
+        ids = jnp.zeros((1, 32), jnp.int32)
+        new_bids = jnp.zeros((1, 1), jnp.int32)     # (rows, CT//BT)
+        crep = rt.donation_report(chunk_fn, eng.kv_pool, ids, new_bids,
+                                  what="mid chunk program")
+        assert crep.donated_argnums == [1]
+        crep.expect_aliased(1)
+        eng.drain(max_steps=200)
+
+
+def test_donation_report_spec_verify_history():
+    """The speculative verify program donates BOTH RMW'd inputs: the
+    KV pool and the ngram history buffer — the donation lint rule's
+    first real catch (undonated, the history cost one full
+    (max_slots, max_seq_len) copy per speculative tick)."""
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(8)
+    with serving.ServingEngine(
+            m, max_slots=2, block_tokens=32, max_seq_len=128,
+            prefix_caching=False,
+            speculate=serving.SpecConfig(k=2)) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=8))
+        steps = 0
+        while not eng._verify_fns and steps < 10:
+            eng.step()
+            steps += 1
+        assert eng._verify_fns, "verify program never built"
+        K = next(iter(eng._verify_fns))
+        vfn = eng._verify_fns[K]
+        props, nprop = eng._dev_prop
+        args = (eng.kv_pool, *eng._dev, props, nprop, eng._dev_cap,
+                eng._dev_hist)
+        rep = rt.donation_report(vfn, *args, what="spec verify step")
+        # state=0, stacked=1, pool=2, ..., history=12 (+2 bound)
+        assert rep.donated_argnums == [2, 12], rep
+        rep.expect_aliased(2, 12)
+        eng.drain(max_steps=200)
+
+
+def test_donation_report_inference_chunk_carry():
+    """The traced chunk-decode program's KV-carry donation follows
+    carry_donate_argnums — donated and fully aliased on accelerators,
+    explicitly gated OFF on the CPU backend (the BENCH_r06 capacity
+    caveat, now visible in the report instead of prose)."""
+    from paddle_tpu.inference import carry_donate_argnums, generate
+    m = _tiny_llama()
+    state = m.state_dict(include_buffers=False)
+    rng = np.random.RandomState(9)
+    ids = jnp.asarray(rng.randint(3, 500, (2, 16)))
+    seeds = jnp.asarray(np.asarray([5, 6], np.uint32))
+    generate(m, ids, max_new_tokens=8, state=state, deadline_s=60.0,
+             request_seeds=seeds)
+    traced = [v for k, v in m._generate_jit_cache.items()
+              if isinstance(k, tuple) and k and k[-1] == "traced"]
+    assert traced, "traced chunk programs not built"
+    pf, dc = traced[0]
+    carry, aux = pf(state, ids, seeds)
+    rep = rt.donation_report(dc, state, carry, aux, 1, 4,
+                             static_argnums=(4,),
+                             what="chunk-carry decode program")
+    expected = carry_donate_argnums(1)
+    if expected:
+        assert rep.donated_argnums == [1]
+        rep.expect_aliased(1)       # the carry aliases away on-device
+    else:
+        # CPU gate: the helper declares nothing, and the report shows
+        # the per-chunk carry copy the TPU re-measure removes
+        assert jax.default_backend() == "cpu"
+        assert rep.donated_argnums == []
 
 
 @pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
