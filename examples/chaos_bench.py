@@ -52,7 +52,7 @@ from serving_bench import build_model, build_speculate
 
 
 def engine_kwargs(ns, flight_dump, speculate=None):
-    return dict(
+    kw = dict(
         max_slots=ns.slots, block_tokens=ns.block_tokens,
         max_seq_len=ns.max_seq_len,
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
@@ -60,6 +60,14 @@ def engine_kwargs(ns, flight_dump, speculate=None):
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=speculate,
         max_queue=ns.max_queue, shed_infeasible=True)
+    if getattr(ns, "chunk_autotune", False):
+        # crash/restore through AUTOTUNED fused chunk ticks: the chunk
+        # size is re-chosen per admission, so a restore mid-prefill may
+        # resume at a different bucket — the zero-loss contract must
+        # not care (tokens are the state, the cursor is volatile)
+        kw.update(chunk_autotune=True,
+                  slo_tpot_s=getattr(ns, "slo_tpot_s", 0.25))
+    return kw
 
 
 def build_engine(model, ns, flight_dump, speculate=None):
@@ -220,6 +228,13 @@ def main():
                     "also covers crashes landing MID-PREFILL — a "
                     "chunked slot snapshots as a resumable request "
                     "with its chunk cursor and re-prefills losslessly")
+    ap.add_argument("--chunk_autotune", action="store_true",
+                    help="autotune the chunk size per admission "
+                    "against --slo_tpot_s (chaos coverage: crash/"
+                    "restore with the tuner mid-flight)")
+    ap.add_argument("--slo_tpot_s", type=float, default=0.25,
+                    help="TPOT budget the chunk autotuner fits fused "
+                    "ticks under")
     ap.add_argument("--speculate", type=int, default=0,
                     help="arm speculative decoding (k proposals per "
                     "slot per tick): the zero-loss + token-parity exit "

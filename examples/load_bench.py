@@ -193,23 +193,37 @@ def drive_open_loop(eng, reqs, arrivals):
     return time.perf_counter() - t0, rejected
 
 
-def calibrate(eng, reqs):
+def calibrate(eng, reqs, reps=1):
     """Closed-loop saturated pass: submit everything at t=0, drain.
     Doubles as compile warmup (prefill shapes + the step program) and
-    yields the capacity estimate the load multiples are scaled by."""
+    yields the capacity estimate the load multiples are scaled by.
+
+    ``reps`` > 1 keeps the BEST pass (highest tokens/s) — the same
+    best-of-reps convention serving_bench uses for its interleaved A/B
+    pairs. The box's CPU budget swings ~2x over tens of seconds, and
+    the chunked-vs-monolithic A/B runs its arms as back-to-back
+    processes: a single calibration pass landing in a slow window
+    would deflate that arm's re-measured capacity (and inflate its
+    absolute offered rates) by pure scheduling noise. Best-of filters
+    the contention the way adjacent interleaved passes do."""
     from paddle_tpu import serving
 
-    t0 = time.perf_counter()
-    for r in reqs:
-        eng.submit(serving.Request(r["prompt"],
-                                   max_new_tokens=r["budget"]))
-        eng.step()          # staggered submits compile small-wave shapes
-    eng.drain()
-    wall = time.perf_counter() - t0
-    st = eng.stats
-    tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall
+    best_tok_s = 0.0
     mean_budget = sum(r["budget"] for r in reqs) / len(reqs)
-    return tok_s, tok_s / mean_budget       # tokens/s, requests/s
+    for _ in range(max(1, reps)):
+        eng.reset_stats()
+        eng.results.clear()
+        t0 = time.perf_counter()
+        for r in reqs:
+            eng.submit(serving.Request(r["prompt"],
+                                       max_new_tokens=r["budget"]))
+            eng.step()      # staggered submits compile small-wave shapes
+        eng.drain()
+        wall = time.perf_counter() - t0
+        st = eng.stats
+        tok_s = (st["decode_tokens"] + st["requests_finished"]) / wall
+        best_tok_s = max(best_tok_s, tok_s)
+    return best_tok_s, best_tok_s / mean_budget     # tokens/s, requests/s
 
 
 def step_breakdown(stats):
@@ -302,6 +316,17 @@ def main():
                     "over N engine replicas, prefix-affinity + least-"
                     "loaded placement) instead of one engine — the "
                     "tier's latency/throughput curve")
+    ap.add_argument("--calib_reps", type=int, default=3,
+                    help="warm calibration passes (best tokens/s kept) "
+                    "— best-of-reps filters CPU-contention noise out of "
+                    "the capacity estimate, matching serving_bench's "
+                    "interleaved-pair convention")
+    ap.add_argument("--chunk_autotune", action="store_true",
+                    help="autotune the chunk size per admission: the "
+                    "engine picks the largest power-of-two chunk bucket "
+                    "whose predicted fused-tick time fits under "
+                    "--slo_tpot_s (requires --chunk_tokens as the cold "
+                    "default)")
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -330,6 +355,8 @@ def main():
         decode_per_chunk=ns.decode_per_chunk,
         speculate=build_speculate(ns),
         sanitize=ns.sanitize)
+    if ns.chunk_autotune:
+        ekw.update(chunk_autotune=True, slo_tpot_s=ns.slo_tpot_s)
     if ns.replicas > 1:
         eng = serving.Router(model, replicas=ns.replicas,
                              snapshot_every=None, **ekw)
@@ -339,9 +366,9 @@ def main():
     rng = np.random.RandomState(ns.seed)
     reqs = make_requests(ns, rng)
     calibrate(eng, reqs)                # cold pass: compiles dominate
-    eng.reset_stats()
-    eng.results.clear()
-    cap_tok_s, cap_rps = calibrate(eng, reqs)   # warm pass: the estimate
+    # warm passes, best-of-reps: the capacity estimate (and the chunked
+    # A/B's re-measured absolute capacity) filters CPU-contention noise
+    cap_tok_s, cap_rps = calibrate(eng, reqs, reps=ns.calib_reps)
     print(f"# calibrated capacity: {cap_tok_s:.1f} tokens/s "
           f"~ {cap_rps:.2f} req/s", file=sys.stderr)
     # shedding arms AFTER calibration (the saturated closed-loop pass

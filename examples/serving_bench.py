@@ -219,6 +219,9 @@ def run_continuous(model, reqs, ns):
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=build_speculate(ns),
         sanitize=getattr(ns, "sanitize", False))
+    if getattr(ns, "chunk_autotune", False):
+        ekw.update(chunk_autotune=True,
+                   slo_tpot_s=getattr(ns, "slo_tpot_s", None) or 0.25)
     if getattr(ns, "replicas", 1) > 1:
         eng = serving.Router(model, replicas=ns.replicas,
                              snapshot_every=None, **ekw)
@@ -274,6 +277,11 @@ def main():
                     "prompts prefill this many tokens per program "
                     "interleaved with decode (multiple of "
                     "--block_tokens; None = monolithic wave prefill)")
+    ap.add_argument("--chunk_autotune", action="store_true",
+                    help="autotune the chunk size per admission: the "
+                    "largest power-of-two bucket whose predicted "
+                    "fused-tick time fits under --slo_tpot_s "
+                    "(defaults to 0.25s when no SLO is given)")
     ap.add_argument("--load", type=float, default=3.0,
                     help="offered load as a multiple of slot capacity")
     ap.add_argument("--long_frac", type=float, default=0.25,

@@ -119,6 +119,30 @@ def carry_donate_argnums(*argnums):
     return tuple(argnums) if jax.default_backend() != "cpu" else ()
 
 
+def resident_carry_donate_argnums(*argnums):
+    """``donate_argnums`` for a RESIDENT fixed-shape carry — the
+    serving engine's fused-tick buffers (the paged KV pool, the
+    chunked-prefill KV carry, the ngram history): donated on EVERY
+    backend, unlike :func:`carry_donate_argnums`.
+
+    The distinction is shape growth vs shape identity. `generate`'s
+    traced chunk carry GROWS per chunk (input and output shapes
+    differ), so CPU donation buys nothing and jax-0.4 warns per
+    program — hence the conditional helper above. A resident carry is
+    RMW'd in place (``dynamic_update_slice`` at a static cursor; input
+    shape == output shape), the caller rebinds it from the program
+    output every tick, and the compiled module's ``input_output_alias``
+    table records the aliasing on every backend —
+    ``analysis.runtime.donation_report`` pins it
+    (tests/test_analysis.py), and the ``donation`` lint rule reads
+    argnums through this spelling like any ``*_donate_argnums``
+    helper. jax-0.4 CPU still executes the alias as a copy (the
+    SCALE.md §Donation aliasing caveat; the v5e re-measure removes
+    it), but the declaration is what makes the TPU path — and the
+    pin — real."""
+    return tuple(argnums)
+
+
 def _request_seeds(request_seeds, seed, b):
     """(b,) uint32 per-request seeds — explicit streams, or the default
     ``seed + row`` convention. ONE definition: `generate`, the stacked

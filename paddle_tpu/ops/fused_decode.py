@@ -2241,6 +2241,72 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
 
 
 # ---------------------------------------------------------------------------
+# Coscheduled tick (fused Sarathi): prefill-chunk append + decode step
+# ---------------------------------------------------------------------------
+#
+# The chunked serving tick used to dispatch TWO programs — a chunk
+# program (prefill rows) and the fused paged decode (decode rows) —
+# with the bf16 KV carry staged between them. Coscheduling folds both
+# into ONE program: the chunk rows' freshly computed block-aligned KV
+# scatters into the pool on the way into the decode step's chunk walk,
+# so the pool crosses exactly one program boundary per tick (one
+# donated buffer, one future `shard_map` seam for tensor-parallel
+# serving instead of two — ROADMAP "One-program tick").
+#
+# Pallas-side story: the pool is donated by the caller, so on TPU the
+# block scatter lowers to an in-place dynamic-update ahead of the
+# kernel's table-resolved KV chunk walk — same HBM buffer, zero copy,
+# and the decode walk never reads the chunk rows' blocks (a prefilling
+# slot's block-table row points at scratch until adoption), so the
+# scheduler may overlap the scatter DMA with the decode kernel's
+# weight streaming. On the jnp reference path the win is one pool
+# traversal per tick instead of two (jax-0.4 CPU materializes each
+# program's pool output — BENCH_r06's chunked-capacity caveat;
+# BENCH_r09 measures the recovery).
+
+
+def paged_chunk_scatter(kv_pool, chunk_bids, chunk_kv):
+    """Scatter prefill-chunk KV blocks into the paged pool.
+
+    ``chunk_bids`` (n, nb) int32 physical block ids per prefilling row
+    (entries past a row's allocated table target the scratch block);
+    ``chunk_kv`` (L, n, nb, BT, 2*nkv*hd) the rows' block-aligned KV
+    (bf16 chunk appends, or a whole quantized prompt on an int8 last
+    chunk). One combined scatter for all layers — the per-layer form
+    costs a full pool copy per LAYER on backends without in-place
+    scatter (the `fused_paged_decode_reference` lesson)."""
+    return kv_pool.at[:, chunk_bids].set(chunk_kv.astype(kv_pool.dtype))
+
+
+def fused_paged_tick_step(x, params, kv_pool, block_tables, positions,
+                          cos, sin, *, num_heads: int, num_kv_heads: int,
+                          eps: float = 1e-5, rope_base: float = 10000.0,
+                          arch: str = "llama",
+                          blocks: Optional[Dict] = None, kv_scales=None,
+                          chunk_bids=None, chunk_kv=None):
+    """One fused Sarathi tick: coschedule a prefill-chunk append with
+    the fused paged decode step — ONE program, the pool threaded
+    through both updates (donate it at the jit boundary; the serving
+    engine pins the aliasing via ``analysis.runtime.donation_report``).
+
+    ``chunk_bids``/``chunk_kv`` (see :func:`paged_chunk_scatter`) may
+    be ``None``, in which case this is exactly
+    :func:`fused_paged_decode_step` — chunkless ticks share the body.
+    The chunk rows' blocks and the decode rows' append blocks are
+    disjoint by construction (prefilling slots idle against scratch
+    until adoption), so the scatter/decode order is value-irrelevant;
+    scatter-first matches the two-program tick it replaces."""
+    if chunk_bids is not None:
+        with jax.named_scope("fused_decode.chunk_scatter"):
+            kv_pool = paged_chunk_scatter(kv_pool, chunk_bids, chunk_kv)
+    return fused_paged_decode_step(
+        x, params, kv_pool, block_tables, positions, cos, sin,
+        num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
+        rope_base=rope_base, arch=arch, blocks=blocks,
+        kv_scales=kv_scales)
+
+
+# ---------------------------------------------------------------------------
 # Paged verify (speculative decoding): score a k-token tail per slot
 # ---------------------------------------------------------------------------
 #

@@ -58,15 +58,31 @@ the wave prefill is one blocking program per prompt shape, so a single
 long prompt stalls every active decode slot for its whole prefill — a
 ``serving.step_prefill_s`` outlier and a TPOT p99 spike under a
 long-prompt mix. With ``chunk_tokens`` set, an admitted prompt is
-processed ``chunk_tokens`` tokens at a time (Sarathi-style): each tick
-runs at most ONE chunk program for the front prefilling slot, then the
-normal fused paged dispatch serves every decode-ready slot, so decode
-TPOT is bounded by one chunk instead of one whole prompt.
-``decode_per_chunk`` is the interleave budget — every active decode
-slot is guaranteed at least that many tokens between consecutive
-chunks. Chunked prefill is a *scheduling* change only: tokens are
-pinned identical to the monolithic wave (greedy+sampled × bf16+int8,
-prefix-hit and preempt-resume cases — tests/test_serving_chunked.py).
+processed ``chunk_tokens`` tokens at a time (Sarathi-style), and each
+chunk tick is ONE fused program — true coscheduling: the front
+group's next chunk AND every decode-ready slot's next token (or
+speculative verify tail) dispatch together, with the chunk's block
+scatter folded into the decode step's pool pass
+(``ops.fused_decode.fused_paged_tick_step``). The per-chunk KV
+staging round trip is gone: bf16 mid chunks gather their processed
+prefix straight from pool blocks (no carry buffer at all), and int8
+prefills thread ONE fixed-shape resident bf16 carry, donated and
+RMW'd in place across ticks. Decode TPOT is bounded
+by one fused tick instead of one whole prompt, and the pool crosses
+one program boundary per tick instead of two (one future ``shard_map``
+seam). Same-bucket same-tick admissions form batched chunk ROWS — n
+slots advance one chunk each in the same program (wave batching,
+recovered). ``decode_per_chunk`` is the interleave budget — at least
+that many decode dispatches separate consecutive chunk programs, and
+the fused tick's own decode half (which advances every active slot)
+counts as the first, so ``decode_per_chunk - 1`` chunkless ticks run
+in between (the two-program tick's pacing, preserved).
+``chunk_autotune=True`` (with ``slo_tpot_s``) picks the largest chunk
+bucket whose predicted fused-tick time fits under the TPOT SLO,
+re-evaluated per admission so the compile set stays finite. Chunked
+prefill is a *scheduling* change only: tokens are pinned identical to
+the monolithic wave (greedy+sampled × bf16+int8, prefix-hit and
+preempt-resume cases — tests/test_serving_chunked.py).
 
 Speculative decoding (``speculate=SpecConfig(...)``; docs/SERVING.md
 §Speculative decoding): after batched heads, int8 KV, paging and
@@ -113,6 +129,21 @@ ENGINE_SNAPSHOT_SCHEMA = "paddle_tpu.engine_snapshot/v1"
 # token-count buckets for the serving.chunk_tokens histogram (chunk
 # sizes are powers-of-two-ish token counts, not latencies)
 _CHUNK_SIZE_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# rows-per-chunk-dispatch buckets for serving.chunk_rows (small integer
+# counts — n same-bucket prefilling slots advancing in one fused tick)
+_CHUNK_ROWS_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
+
+# chunk-autotune probing cadence: every this many tuned admissions with
+# an unmeasured next-larger bucket, pick it once so its tick-time EWMA
+# gets a real observation (see _autotune_chunk)
+_CHUNK_PROBE_EVERY = 8
+# per-bucket probe budget: a probe's own ticks are COLD (fresh
+# programs), and cold ticks never feed the EWMAs — only a repeat of
+# the same shape dispatches warm and records. Two tries buys that
+# repeat; a bucket whose shapes never recur stops costing compile
+# chains after the budget instead of re-probing forever
+_CHUNK_PROBE_TRIES = 2
 
 # accepted-proposal-length buckets for serving.spec_accepted_len (small
 # integer counts, not latencies — k rarely exceeds 8)
@@ -307,7 +338,7 @@ class _Slot:
     __slots__ = ("req", "tok", "pos", "count", "tokens", "blocks", "ntab",
                  "worst_blocks", "t_first", "deadline_at",
                  "prefix_hit_blocks", "feed", "resume",
-                 "prefilling", "filled", "R", "carry", "hits", "dblocks")
+                 "prefilling", "filled", "R", "hits", "dblocks")
 
     def __init__(self, req: Request, worst_blocks: int,
                  prefix_hit_blocks: int, feed: np.ndarray,
@@ -344,10 +375,10 @@ class _Slot:
         self.resume = resume            # generated-so-far tokens, or None
         # chunked-prefill cursor state (chunk_tokens engines): while
         # `prefilling`, `filled` counts the feed tokens whose KV is
-        # already written (starts at the prefix depth R), `carry` holds
-        # the bf16 KV of [0, filled) as a device buffer between chunk
-        # programs, and `hits` keeps the prefix-cache entries chunk 0
-        # adopts. A prefilling slot stays OUT of the decode batch (its
+        # already written (starts at the prefix depth R), and `hits`
+        # keeps the prefix-cache entries chunk 0 adopts (the int8
+        # resident KV carry lives on the slot's _ChunkGroup, not
+        # here). A prefilling slot stays OUT of the decode batch (its
         # mirror table row points at scratch) until its last chunk
         # samples the first token.
         # tpu-lint: volatile(restore re-prefills from tokens; the
@@ -357,14 +388,78 @@ class _Slot:
         self.filled = 0
         # tpu-lint: volatile(prefix depth; re-probed at re-admission)
         self.R = 0                      # prefix-hit depth in tokens
-        # tpu-lint: volatile(device KV carry between chunk programs)
-        self.carry = None
         # tpu-lint: volatile(prefix-cache refs; re-probed at re-admission)
         self.hits = None
         # draft-proposer block table rows (speculative engines with a
         # draft model: the draft's KV pages for this slot)
         # tpu-lint: volatile(draft pages rebuilt at resume adoption)
         self.dblocks: List[int] = []
+
+
+class _ChunkGroup:
+    """A batch of same-bucket prefilling slots advancing ONE chunk per
+    fused tick (the batched-chunk-rows half of the one-program tick):
+    every row shares the prefix depth ``R``, the chunk size ``chunk``
+    (the autotuner's per-admission pick) and the padded feed bucket
+    ``C_pad = R + ceil((P-R)/chunk)*chunk``, so the whole group's
+    cursors advance in lockstep and one fused-tick program serves all
+    ``n`` rows — same-tick same-shape admissions recover the wave
+    batching the n=1 chunk FIFO serialized.
+
+    The group's inputs are DEVICE-RESIDENT from creation (feed ids,
+    block-id table, last-token indices, seeds, int8 valid lengths and
+    prefix copies), so steady mid-prefill fused ticks re-dispatch with
+    zero H2D uploads. On int8 pools ``carry`` is the resident bf16 KV
+    buffer (L, n, C_pad, 2dkv) the chunk programs RMW in place
+    (donated — ``analysis.runtime.donation_report`` pins the
+    aliasing); bf16 pools need NO carry at all — every completed
+    chunk's blocks are already in the pool, so the next chunk gathers
+    its processed prefix straight from pool blocks."""
+
+    __slots__ = ("rows", "R", "chunk", "C_pad", "int8", "carry",
+                 "dev_ids", "dev_bids", "dev_last", "dev_seeds",
+                 "dev_valid", "dev_prefix")
+
+    def __init__(self, rows, R, chunk, C_pad, int8):
+        self.rows = rows            # [(slot_idx, slot)]
+        self.R = int(R)
+        self.chunk = int(chunk)
+        self.C_pad = int(C_pad)
+        self.int8 = int8
+        # tpu-lint: volatile(device KV carry; restore re-prefills)
+        self.carry = None
+        self.dev_ids = self.dev_bids = None
+        self.dev_last = self.dev_seeds = None
+        self.dev_valid = self.dev_prefix = None
+
+    @property
+    def n(self) -> int:
+        return len(self.rows)
+
+    @property
+    def start(self) -> int:
+        """The group's chunk cursor (rows advance in lockstep)."""
+        return self.rows[0][1].filled
+
+    @property
+    def kind(self) -> str:
+        return "last" if self.start + self.chunk >= self.C_pad else "mid"
+
+    def args(self):
+        """The chunk half's traced arguments at the current cursor —
+        every one device-resident (the steady-tick 0-H2D invariant)."""
+        start, last = self.start, self.kind == "last"
+        a = []
+        if self.int8 and start > self.R:
+            a.append(self.carry)
+        a += [self.dev_ids, self.dev_bids]
+        if self.dev_prefix is not None and start == self.R:
+            a.append(self.dev_prefix)
+        if last:
+            a += [self.dev_last, self.dev_seeds]
+            if self.int8:
+                a.append(self.dev_valid)
+        return a
 
 
 class _PriorityQueue:
@@ -486,15 +581,24 @@ class ServingEngine:
     ``chunk_tokens`` (None = monolithic wave prefill, the PR 5
     behavior) arms chunked prefill: prompts are prefilled
     ``chunk_tokens`` tokens per program (must be a multiple of
-    ``block_tokens``), at most one chunk per tick, interleaved with the
-    decode dispatch so a long prompt never stalls active decode slots
-    for more than one chunk. ``decode_per_chunk`` decode dispatches are
-    guaranteed between consecutive chunks while decode-ready slots
-    exist. Chunk programs are keyed by (kind, KV-cursor) — fixed bucket
-    sizes, so the compile set stays small and exactly pinned
-    (tests/test_analysis.py). Tradeoff: chunked admissions prefill one
-    request at a time (no same-tick wave batching) — bounded per-tick
-    prefill work is the point.
+    ``block_tokens``), at most one chunk per tick. A chunk tick is ONE
+    fused program — the chunk AND the decode step for every
+    decode-ready slot coscheduled, bf16 mid chunks gathering their
+    processed prefix from the pool and int8 prefills threading a
+    resident bf16 carry (donated, aliased in-place) — so a long
+    prompt never stalls active decode slots for more than one fused
+    tick, and same-bucket
+    same-tick admissions advance as batched chunk rows in the same
+    program. ``decode_per_chunk`` decode dispatches are guaranteed
+    between consecutive chunk programs while decode-ready slots exist
+    — the fused tick's own decode half counts as the first, so
+    ``decode_per_chunk - 1`` chunkless ticks separate chunk ticks. Fused-tick programs are keyed by the chunk bucket
+    (kind, cursor, rows, feed bucket, chunk size) — fixed buckets, so
+    the compile set stays small and exactly pinned
+    (tests/test_analysis.py). ``chunk_autotune=True`` (requires
+    ``slo_tpot_s``) picks each admission's chunk size: the largest
+    power-of-two bucket (anchored at ``chunk_tokens``) whose predicted
+    fused-tick time fits under the TPOT SLO.
 
     ``speculate=SpecConfig(...)`` (None = plain per-token decode) arms
     speculative decoding: every decode tick verifies k proposed tokens
@@ -528,6 +632,8 @@ class ServingEngine:
                  shed_infeasible: bool = False,
                  chunk_tokens: Optional[int] = None,
                  decode_per_chunk: int = 1,
+                 chunk_autotune: bool = False,
+                 slo_tpot_s: Optional[float] = None,
                  speculate: Optional[SpecConfig] = None,
                  sanitize: bool = False,
                  state: Optional[Dict] = None):
@@ -614,6 +720,29 @@ class ServingEngine:
             raise ValueError(f"decode_per_chunk must be >= 1, got "
                              f"{decode_per_chunk}")
         self.decode_per_chunk = int(decode_per_chunk)
+        if slo_tpot_s is not None and not slo_tpot_s > 0:
+            raise ValueError(f"slo_tpot_s must be > 0 or None, got "
+                             f"{slo_tpot_s}")
+        self.slo_tpot_s = None if slo_tpot_s is None else float(slo_tpot_s)
+        self.chunk_autotune = bool(chunk_autotune)
+        if self.chunk_autotune and (chunk_tokens is None
+                                    or self.slo_tpot_s is None):
+            raise ValueError(
+                "chunk_autotune needs both chunk_tokens (the cold "
+                "default / ladder anchor) and slo_tpot_s (the TPOT-SLO "
+                "headroom the tuner fits chunks under)")
+        # the autotuner's current pick (== chunk_tokens until a warm
+        # EWMA moves it); what estimated_ttft_s prices chunks at
+        # tpu-lint: volatile(autotuner re-learns; config carries knobs)
+        self._chunk_choice = chunk_tokens
+        # per-bucket fused-tick wall-time EWMAs (the measured refinement
+        # over the per-token linear prediction)
+        # tpu-lint: volatile(capacity estimator re-learns)
+        self._chunk_time_ewma: Dict[int, _Ewma] = {}
+        # tpu-lint: volatile(probe cadence counter)
+        self._chunk_probe_wait = 0
+        # tpu-lint: volatile(probe budget re-learns after restore)
+        self._chunk_probe_tries: Dict[int, int] = {}
         self._closed = False
 
         from paddle_tpu.ops import rope as rope_ops
@@ -845,20 +974,28 @@ class ServingEngine:
         self._ewma_prefill_tok = _Ewma()
         # tpu-lint: volatile(capacity estimator re-learns)
         self._ewma_chunk = _Ewma()      # per chunk-program wall time
-        # chunked-prefill scheduler state: FIFO of (slot_idx, slot)
-        # still mid-prefill (stale entries lazily dropped by identity
+        # chunked-prefill scheduler state: FIFO of _ChunkGroup batches
+        # still mid-prefill (dead rows lazily compacted by identity
         # check), chunk events this tick, and decode dispatches since
         # the last chunk (the decode_per_chunk interleave budget;
         # initialized satisfied so the first chunk runs immediately)
         # tpu-lint: volatile(mid-prefill slots snapshot as resumable
         # requests; restore re-admits them through the queue)
-        self._prefill_fifo: List = []
+        self._prefill_fifo: List[_ChunkGroup] = []
         # tpu-lint: volatile(per-tick flight marker)
         self._tick_chunks: List = []    # (request_id, start, ntok)
         # tpu-lint: volatile(interleave budget restarts satisfied)
         self._decode_since_chunk = self.decode_per_chunk
         # tpu-lint: volatile(a restored engine re-pays the compile)
         self._step_fn_warm = False      # first dispatch pays the compile
+        # tpu-lint: volatile(a restored engine re-pays the compile)
+        # the PLAIN decode program's own first-dispatch guard: in a
+        # chunked engine the first dispatch is a fused chunk tick, so
+        # _step_fn_warm flips long before the chunkless step program
+        # first compiles — gating the _ewma_step feed on _step_fn_warm
+        # alone would ingest that compile spike and over-shed
+        # deadline-carrying submits for dozens of ticks
+        self._ewma_step_warm = False
         # sanitizer tiers (paddle_tpu.analysis.runtime,
         # docs/ANALYSIS.md): "dispatch" (== True, the PR 9 behavior)
         # wraps every STEADY-STATE fused dispatch — warm step program,
@@ -1050,8 +1187,11 @@ class ServingEngine:
                         and s.req.rank >= request.rank)
         P = len(request.prompt)
         if self.chunk_tokens is not None:
-            n_chunks = -(-P // self.chunk_tokens)
-            own = (n_chunks * self.chunk_tokens * tok_s
+            # priced at the autotuner's CURRENT bucket (== chunk_tokens
+            # until a warm EWMA moves it)
+            CT = self._chunk_choice or self.chunk_tokens
+            n_chunks = -(-P // CT)
+            own = (n_chunks * CT * tok_s
                    + (n_chunks - 1) * self.decode_per_chunk * step_s)
         else:
             own = P * tok_s
@@ -1288,89 +1428,282 @@ class ServingEngine:
         self._jit_cache[key] = fn
         return fn, False
 
-    def _chunk_fn(self, kind, start, gather):
-        """One prefill-chunk program: forward ``chunk_tokens`` prompt
-        tokens over the KV of the ``start`` tokens already processed,
-        and append the chunk's KV into the slot's pool blocks. Programs
-        are keyed by (kind, start, gather) — ``start`` only ever takes
-        values ``R + i*chunk_tokens``, so the compile set is one
-        program per chunk bucket (pinned in tests/test_analysis.py).
+    def _autotune_chunk(self, s_pad: int) -> int:
+        """The chunk size for a freshly admitted prefill: with
+        ``chunk_autotune`` off, the configured ``chunk_tokens``; with
+        it on, the LARGEST bucket on the power-of-two ladder anchored
+        at ``chunk_tokens`` whose predicted fused-tick time fits under
+        the ``slo_tpot_s`` headroom — on a fused engine a chunk tick IS
+        a decode latency for every active slot, so the chunk budget is
+        the TPOT SLO minus nothing (the decode half rides inside the
+        same program). Predictions use the per-bucket tick-time EWMA
+        where one exists, else the per-token prefill EWMA times the
+        bucket (plus the decode-step EWMA the fused tick carries).
+        Re-evaluated at bucket boundaries only — once per admission
+        group, never mid-prefill — so the cursor lattice (and with it
+        the compile set) stays finite and pinnable: a bucket transition
+        compiles exactly its new (start, chunk, C_pad) programs and
+        nothing twice (tests/test_analysis.py). Returns the
+        PER-ADMISSION pick — clamped at the first bucket covering
+        ``s_pad``, possibly probe-overridden; the un-clamped SLO pick
+        is what persists in ``_chunk_choice`` for
+        :meth:`estimated_ttft_s` pricing (a short admission's clamp,
+        or a probe's unmeasured bucket, must not re-price every other
+        queued prompt)."""
+        from paddle_tpu.observability import registry
 
-        ``kind='mid'``: carries the running bf16 KV forward (the lm
-        head is traced but unused, so XLA dead-codes it away); bf16
-        pools additionally scatter the chunk's blocks. ``kind='last'``:
-        samples the first token at the feed's last valid position; int8
-        pools compute the per-slot calibration scales over the ORIGINAL
-        prompt positions of the carried bf16 KV and quantize+scatter
-        every prompt block in one go — deferring quantization to the
-        last chunk is what keeps the scales (and therefore the tokens)
-        identical to a monolithic prefill. ``gather`` > 0 = bf16
-        chunk 0 over a CoW prefix: the program gathers the shared
-        blocks from the pool itself, so the prefix gather costs one
-        dispatch on chunk 0 only.
+        base = self.chunk_tokens
+        if not self.chunk_autotune:
+            return base
+        tok = self._ewma_prefill_tok.value
+        if tok is None:
+            pick = pricing = base   # cold: no evidence, no tuning
+        else:
+            step = self._ewma_step.value or 0.0
 
-        Returns ``(fn, cached)`` — ``cached=False`` means this call
-        pays the trace+compile, which the EWMA estimators must not
-        ingest."""
+            def largest_fit(cs):
+                best = None
+                for c in cs:        # ascending: keep the largest
+                    ew = self._chunk_time_ewma.get(c)
+                    pred = (ew.value if ew is not None
+                            and ew.value is not None
+                            else tok * c + step)
+                    if pred <= self.slo_tpot_s:
+                        best = c
+                return cs[0] if best is None else best
+
+            cands = [base]
+            c = base // 2           # ladder: power-of-two multiples of
+            while c >= self.block_tokens and c % self.block_tokens == 0:
+                cands.insert(0, c)  # the configured anchor, down to
+                c //= 2             # one block and up to the slot cap
+            c = base * 2
+            while c <= self.max_seq_len:
+                cands.append(c)
+                c *= 2
+            # the PRICING pick is evaluated on the FULL ladder — it is
+            # what estimated_ttft_s charges every queued prompt, so the
+            # per-admission clamp/probe below must not leak into it (a
+            # 16-token admission's clamped bucket would over-price a
+            # long deadline-carrying submit severalfold and over-shed)
+            pricing = largest_fit(cands)
+            # clamp at the FIRST bucket covering this admission's feed
+            # bucket, in both directions — a chunk wider than s_pad is
+            # pure padding (it forwards, and compiles programs for,
+            # positions the prompt doesn't have), including when the
+            # covering bucket sits below the configured anchor
+            cover = next((i for i, cc in enumerate(cands)
+                          if cc >= s_pad), len(cands) - 1)
+            del cands[cover + 1:]
+            pick = largest_fit(cands)
+            # one-step-up probing (the spec k=0 recovery-probe
+            # pattern): the linear per-token prediction is badly
+            # pessimistic on weight-stream-dominated backends — a 4x
+            # chunk costs nowhere near 4x a tick — so an UNMEASURED
+            # next bucket would never be chosen on prediction alone
+            # and its per-bucket EWMA could never observe. Every
+            # _CHUNK_PROBE_EVERY tuned admissions, pick the next
+            # bucket up ONCE so it gets measured; evidence (not the
+            # prediction) then decides whether the pick climbs.
+            # the wait counter advances ONLY on probe-eligible
+            # admissions and is frozen (not reset) by ineligible ones
+            # — a short prompt whose clamped ladder tops out at the
+            # current pick must not starve the long prompts' probe
+            # under an interleaved length mix
+            nxt = next((c for c in cands if c > pick), None)
+            if (nxt is not None and nxt not in self._chunk_time_ewma
+                    and self._chunk_probe_tries.get(nxt, 0)
+                    < _CHUNK_PROBE_TRIES):
+                self._chunk_probe_wait += 1
+                if self._chunk_probe_wait >= _CHUNK_PROBE_EVERY:
+                    self._chunk_probe_wait = 0
+                    self._chunk_probe_tries[nxt] = (
+                        self._chunk_probe_tries.get(nxt, 0) + 1)
+                    pick = nxt
+        self._chunk_choice = pricing
+        registry().gauge("serving.chunk_autotune").set(pricing)
+        return pick
+
+    def _make_chunk_groups(self, wave):
+        """Group this tick's chunked admissions by prefill bucket
+        ``(R, s_pad)`` and push one :class:`_ChunkGroup` per bucket —
+        n same-shape rows advance one chunk each per fused tick (the
+        wave batching the n=1 chunk FIFO lost). Every group input is
+        uploaded to the device HERE, once per admission (the tick is a
+        join event anyway), so subsequent mid-prefill fused ticks
+        re-dispatch with zero H2D."""
+        BT = self.block_tokens
+        L = self._num_layers
+        buckets: Dict = {}
+        for slot_idx, slot, hits, R, s_pad in wave:
+            buckets.setdefault((R, s_pad), []).append((slot_idx, slot))
+        for (R, s_pad), rows in buckets.items():
+            CT = self._autotune_chunk(s_pad)
+            C_pad = R + -(-s_pad // CT) * CT
+            g = _ChunkGroup(rows, R, CT, C_pad, self.kv_int8)
+            n = len(rows)
+            NB = C_pad // BT
+            ids = np.zeros((n, C_pad), np.int32)
+            bids = np.full((n, NB), SCRATCH_BLOCK, np.int32)
+            last_idx = np.zeros(n, np.int32)
+            seeds = np.zeros(n, np.uint32)
+            valid = np.zeros(n, np.int32)
+            last_start = C_pad - CT
+            for r, (slot_idx, s) in enumerate(rows):
+                P = len(s.feed)
+                ids[r, :P] = s.feed
+                bids[r, :s.ntab] = s.blocks
+                last_idx[r] = P - 1 - last_start
+                seeds[r] = np.uint32(s.req.seed)
+                valid[r] = len(s.req.prompt)
+            g.dev_ids = jnp.asarray(ids)
+            g.dev_bids = jnp.asarray(bids)
+            g.dev_last = jnp.asarray(last_idx)
+            g.dev_seeds = jnp.asarray(seeds)
+            if self.kv_int8:
+                g.dev_valid = jnp.asarray(valid)
+                if R:
+                    # int8 chunk 0 over prefix hits rides the cache's
+                    # exact bf16 host copies (quantized blocks are
+                    # per-slot-scaled, never shareable) — uploaded once
+                    hit_rows = [s.hits for _, s in rows]
+                    g.dev_prefix = jnp.asarray(np.stack(
+                        [np.concatenate([e.kv_host for e in hs], axis=1)
+                         for hs in hit_rows], axis=1))   # (L, n, R, 2dkv)
+                    assert g.dev_prefix.shape == (L, n, R, 2 * self._dkv)
+            for _, s in rows:
+                s.hits = None       # consumed; drop the cache refs
+            self._prefill_fifo.append(g)
+        if buckets:
+            self._dirty = True      # join event: mirrors re-upload
+
+    def _compact_group(self, g: "_ChunkGroup"):
+        """Drop rows whose slot retired/preempted/unwound mid-prefill
+        (identity check — the index may since hold a different slot)
+        and slice the group's device inputs (and resident carry) down
+        to the survivors. A shrink is an EVENT tick: the n in the
+        program key changes, so the next chunk recompiles — preemption
+        and deadline sweeps are rare paths, never the steady state."""
+        keep = [r for r, (i, s) in enumerate(g.rows)
+                if self._slots[i] is s and s.prefilling]
+        if len(keep) == len(g.rows):
+            return
+        g.rows = [g.rows[r] for r in keep]
+        if not g.rows:
+            return
+        # tpu-lint: allow(host-sync): host row-index list, not a device
+        # value — the gather below runs on device
+        sel = np.asarray(keep, np.int32)
+        g.dev_ids = g.dev_ids[sel]
+        g.dev_bids = g.dev_bids[sel]
+        g.dev_last = g.dev_last[sel]
+        g.dev_seeds = g.dev_seeds[sel]
+        if g.dev_valid is not None:
+            g.dev_valid = g.dev_valid[sel]
+        if g.dev_prefix is not None:
+            g.dev_prefix = g.dev_prefix[:, sel]
+        if g.carry is not None:
+            g.carry = g.carry[:, sel]
+        self._dirty = True
+
+    def _front_prefill(self) -> Optional["_ChunkGroup"]:
+        """The group at the head of the prefill FIFO (compacted to its
+        live rows), or None."""
+        while self._prefill_fifo:
+            g = self._prefill_fifo[0]
+            self._compact_group(g)
+            if g.rows:
+                return g
+            self._prefill_fifo.pop(0)
+        return None
+
+    def _chunk_body(self, kind, start, n, C_pad, CT, R):
+        """Trace-time CHUNK half of the fused tick: forward ``CT``
+        prompt tokens for ``n`` same-bucket rows over the KV of the
+        ``start`` tokens already processed, advance the RESIDENT carry
+        in place, and hand the block-aligned pool payload to the decode
+        half (ONE combined scatter inside the same program —
+        ``ops.fused_decode.paged_chunk_scatter``).
+
+        ``kind='mid'``: bf16 pools GATHER the processed prefix
+        [0, start) straight from pool blocks (every completed chunk
+        already scattered; no carry buffer exists at all — the
+        O(prompt²/chunk) staging round trip BENCH_r06 caveated is
+        simply gone); int8 pools thread the resident bf16 carry
+        (L, n, C_pad, 2dkv), RMW'd via a static
+        ``dynamic_update_slice`` — the caller donates it, so the
+        buffer aliases in place (donation_report pins it). Chunk 0 of
+        a multi-chunk int8 prefill CREATES the carry in-program
+        (zeros + prefix + chunk — no eager zeros program, no upload).
+        ``kind='last'``: samples each row's first token; int8 pools
+        calibrate per-slot scales over the ORIGINAL prompt positions
+        and quantize+scatter every prompt block in one go (the scale
+        deferral that keeps chunked int8 bit-identical to monolithic).
+
+        Returns ``(chunk_bids, chunk_kv, carry2, tok, lanes, kvfull)``
+        — any of which may be None depending on kind/dtype."""
         from paddle_tpu.inference import (_fold_rows, _row_keys,
                                           _sample_logits)
         from paddle_tpu.nn.layer import functional_call
 
-        key = ("chunk", kind, self.kv_int8, start, gather)
-        fn = self._jit_cache.get(key)
-        if fn is not None:
-            return fn, True
         nkv, hd = self.meta["num_kv_heads"], self.meta["head_dim"]
         dkv = self._dkv
         BT = self.block_tokens
-        CT = self.chunk_tokens
         cache_len = start + CT
         model = self.model
         int8 = self.kv_int8
         last = kind == "last"
-        has_pool = not int8 or last     # int8 mid chunks never touch it
+        keep_kv = self.prefix_cache is not None
+        temperature, top_k, top_p = (self.temperature, self.top_k,
+                                     self.top_p)
 
-        def impl(*args):
-            args = list(args)
-            state = args.pop(0)
-            pool = args.pop(0) if has_pool else None
-            prev = args.pop(0) if start else None
-            ids = args.pop(0)
-            new_bids = args.pop(0) if has_pool else None
-            if last:
-                last_idx = args.pop(0)
-                seeds = args.pop(0)
-                valid = args.pop(0) if int8 else None
-            cache = model.init_cache(1, cache_len, dtype=jnp.bfloat16)
+        def body(state, pool, carry, ids, bids, prefix, last_idx,
+                 cseeds, valid):
+            cache = model.init_cache(n, cache_len, dtype=jnp.bfloat16)
+            pk = None
             if start:
-                pk = (pool[:, prev].reshape(len(cache), 1, start, 2 * dkv)
-                      if gather else prev)
+                if not int8:
+                    # bf16: every completed chunk already scattered its
+                    # blocks into the pool, so the processed prefix
+                    # GATHERS straight from pool blocks — no carry
+                    # buffer at all (the chunk-0 CoW gather generalized
+                    # to every cursor; bit-exact, the pool stores the
+                    # same bf16 the carry would). Only int8 pools need
+                    # the resident bf16 carry (quantized blocks cannot
+                    # re-feed the forward).
+                    pk = pool[:, bids[:, :start // BT]].reshape(
+                        len(cache), n, start, 2 * dkv)
+                elif start == R:    # int8 chunk 0 over a prefix hit
+                    pk = prefix
+                else:               # int8 mid/last: the resident carry
+                    pk = jax.lax.slice_in_dim(carry, 0, start, axis=2)
                 for l in range(len(cache)):
-                    kl = pk[l, :, :, :dkv].reshape(1, start, nkv, hd)
-                    vl = pk[l, :, :, dkv:].reshape(1, start, nkv, hd)
+                    kl = pk[l, :, :, :dkv].reshape(n, start, nkv, hd)
+                    vl = pk[l, :, :, dkv:].reshape(n, start, nkv, hd)
                     cache[l] = {
                         "k": cache[l]["k"].at[:, :start].set(
                             kl.astype(cache[l]["k"].dtype)),
                         "v": cache[l]["v"].at[:, :start].set(
                             vl.astype(cache[l]["v"].dtype))}
             with jax.named_scope("decode.prefill"):
-                out, cache = functional_call(model, state, ids,
-                                             cache=cache, start_pos=start)
+                out, cache = functional_call(
+                    model, state, jax.lax.slice_in_dim(
+                        ids, start, cache_len, axis=1),
+                    cache=cache, start_pos=start)
             kv_flat = jnp.stack([jnp.concatenate(
-                [c["k"].reshape(1, cache_len, dkv),
-                 c["v"].reshape(1, cache_len, dkv)], axis=-1)
-                for c in cache])             # (L, 1, cache_len, 2dkv)
+                [c["k"].reshape(n, cache_len, dkv),
+                 c["v"].reshape(n, cache_len, dkv)], axis=-1)
+                for c in cache])             # (L, n, cache_len, 2dkv)
+            tok = lanes = kvfull = carry2 = None
+            chunk_bids = chunk_kv = None
             if last:
                 logits = jnp.take_along_axis(
                     out, last_idx[:, None, None], axis=1)[:, 0]
                 with jax.named_scope("decode.sample"):
                     tok = _sample_logits(logits,
-                                         _fold_rows(_row_keys(seeds), 0),
-                                         self.temperature, self.top_k,
-                                         self.top_p)
-            if int8:
-                if not last:
-                    return kv_flat
+                                         _fold_rows(_row_keys(cseeds), 0),
+                                         temperature, top_k, top_p)
+            if int8 and last:
                 # calibration over the original prompt positions only
                 # (resume appends beyond the prompt were quantized with
                 # prompt-only scales in the uninterrupted run too);
@@ -1378,157 +1711,196 @@ class ServingEngine:
                 mask = (jnp.arange(cache_len)[None]
                         < valid[:, None])[None, :, :, None]
                 a = jnp.where(mask, jnp.abs(kv_flat.astype(jnp.float32)),
-                              0.0).max(axis=2)          # (L, 1, 2dkv)
-                a = a.reshape(-1, 1, 2 * nkv, hd).max(axis=-1)
+                              0.0).max(axis=2)          # (L, n, 2dkv)
+                a = a.reshape(-1, n, 2 * nkv, hd).max(axis=-1)
                 lanes = jnp.repeat(jnp.maximum(a / 127.0, 1e-8), hd,
                                    axis=-1)
                 q = jnp.clip(jnp.round(
                     kv_flat.astype(jnp.float32) / lanes[:, :, None, :]),
                     -127, 127).astype(jnp.int8)
-                # new_bids covers every cache_len//BT block; entries
-                # past the feed's last allocated block are SCRATCH, so
-                # padded-tail garbage lands in the masked scratch block
-                pool = pool.at[:, new_bids].set(
-                    q.reshape(-1, 1, cache_len // BT, BT, 2 * dkv))
-                return tok, pool, lanes, kv_flat
-            blk = kv_flat[:, :, start:].reshape(-1, 1, CT // BT, BT,
-                                                2 * dkv)
-            pool = pool.at[:, new_bids].set(blk.astype(pool.dtype))
-            return (tok, pool) if last else (kv_flat, pool)
+                # bids covers every C_pad//BT block; entries past the
+                # feed's last allocated block are SCRATCH, so padded-
+                # tail garbage lands in the masked scratch block
+                chunk_bids = bids
+                chunk_kv = q.reshape(-1, n, cache_len // BT, BT, 2 * dkv)
+                if keep_kv:
+                    kvfull = kv_flat    # host bf16 prefix-cache copies
+            elif not int8:
+                # bf16: this chunk's blocks scatter as they complete
+                chunk_bids = jax.lax.slice_in_dim(
+                    bids, start // BT, cache_len // BT, axis=1)
+                chunk_kv = kv_flat[:, :, start:].reshape(
+                    -1, n, CT // BT, BT, 2 * dkv)
+            if int8 and not last:
+                new_kv = kv_flat[:, :, start:].astype(jnp.bfloat16)
+                if start == R:      # first chunk builds the carry
+                    carry2 = jnp.zeros((len(cache), n, C_pad, 2 * dkv),
+                                       jnp.bfloat16)
+                    if R:
+                        carry2 = carry2.at[:, :, :R].set(
+                            pk.astype(jnp.bfloat16))
+                    carry2 = carry2.at[:, :, R:cache_len].set(new_kv)
+                else:               # RMW in place: donated + aliased
+                    carry2 = jax.lax.dynamic_update_slice_in_dim(
+                        carry, new_kv, start, axis=2)
+            return chunk_bids, chunk_kv, carry2, tok, lanes, kvfull
 
-        donate = (1,) if has_pool else ()
-        jitted = jax.jit(impl, donate_argnums=donate)
-        fn = _program_handle(jitted, lambda: (self._state,))
+        return body
+
+    def _tick_fn(self, kind, start, n, C_pad, CT, R, K):
+        """ONE program per tick: the fused Sarathi coscheduled tick —
+        the front group's next prefill chunk AND every decode-ready
+        slot's next token (K=0) or k-token verify tail (K>0) dispatch
+        together, the pool and resident carry donated and aliased
+        in-place. Keyed by the chunk bucket (kind, start, n, C_pad,
+        CT, R) × the decode tail K, so the compile set is one program
+        per chunk bucket — exactly as pinnable as the two-program
+        tick's chunk set was (tests/test_analysis.py).
+
+        Returns ``(fn, cached)`` — ``cached=False`` means this call
+        pays the trace+compile, which the EWMA estimators must not
+        ingest."""
+        from paddle_tpu.inference import resident_carry_donate_argnums
+
+        key = ("tick", kind, self.kv_int8, start, n, C_pad, CT, R, K)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn, True
+        chunk_body = self._chunk_body(kind, start, n, C_pad, CT, R)
+        spec = K > 0
+        ngram = spec and self.speculate.proposer == "ngram"
+        dec_body = self._verify_body(K) if spec else self._decode_body()
+        int8 = self.kv_int8
+        last = kind == "last"
+        # only int8 pools thread the resident bf16 carry — bf16 mid
+        # chunks gather their processed prefix from the pool itself
+        has_carry = int8 and start > R
+        has_prefix = int8 and R > 0 and start == R
+        keep_kv = self.prefix_cache is not None
+
+        def impl(state, stacked, pool, *rest):
+            rest = list(rest)
+            carry = rest.pop(0) if has_carry else None
+            ids = rest.pop(0)
+            bids = rest.pop(0)
+            prefix = rest.pop(0) if has_prefix else None
+            last_idx = rest.pop(0) if last else None
+            cseeds = rest.pop(0) if last else None
+            valid = rest.pop(0) if (last and int8) else None
+            (tables, positions, toks, seeds, counts,
+             kv_scales) = rest[:6]
+            srest = rest[6:]
+            chunk_bids, chunk_kv, carry2, ctok, lanes, kvfull = \
+                chunk_body(state, pool, carry, ids, bids, prefix,
+                           last_idx, cseeds, valid)
+            if spec:
+                proposals, nprop, cap = srest[0], srest[1], srest[2]
+                hist = srest[3] if ngram else None
+                dec = dec_body(state, stacked, pool, tables, positions,
+                               toks, seeds, counts, kv_scales,
+                               proposals, nprop, cap, hist,
+                               chunk_bids, chunk_kv)
+            else:
+                dec = dec_body(state, stacked, pool, tables, positions,
+                               toks, seeds, counts, kv_scales,
+                               chunk_bids, chunk_kv)
+            outs = tuple(o for o in (carry2, ctok, lanes,
+                                     kvfull if keep_kv else None)
+                         if o is not None)
+            return (*dec, *outs)
+
+        donate = [2]                # the pool, as every decode program
+        if has_carry and not last:
+            # the resident carry: RMW'd in place on MID chunks (input
+            # shape == output shape — the donation_report pin). A LAST
+            # chunk consumes the carry with no matching output, so
+            # donating it is declared-but-unusable (jax warns per
+            # program and frees the buffer mid-execution on some
+            # backends) — the buffer dies with the group right after
+            # the tick anyway
+            donate.append(3)
+        if ngram:
+            # the carried ngram history (the _build_verify_fn donation,
+            # at its shifted position behind the chunk args)
+            donate.append(3 + int(has_carry) + 2 + int(has_prefix)
+                          + (2 if last else 0)
+                          + (1 if (last and int8) else 0) + 6 + 3)
+        jitted = jax.jit(
+            impl, donate_argnums=resident_carry_donate_argnums(*donate))
+        fn = _program_handle(jitted,
+                             lambda: (self._state, self._stacked))
         self._jit_cache[key] = fn
         return fn, False
 
-    def _front_prefill(self):
-        """The (slot_idx, slot) at the head of the prefill FIFO, or
-        None. Entries whose slot retired/preempted/unwound mid-prefill
-        are dropped lazily (identity check — the index may since hold a
-        different slot)."""
-        while self._prefill_fifo:
-            slot_idx, slot = self._prefill_fifo[0]
-            if self._slots[slot_idx] is slot and slot.prefilling:
-                return slot_idx, slot
-            self._prefill_fifo.pop(0)
-        return None
-
-    def _run_prefill_chunk(self, slot_idx: int, s: "_Slot"):
-        """Run ONE chunk program for slot ``s``: a mid chunk advances
-        the cursor and carry; the last chunk samples the first token
-        and adopts the slot into the decode batch (:meth:`_adopt_slot`).
-        Timed into the step's prefill segment; each chunk counts
-        ``serving.prefill_chunks`` and observes the chunk-size
-        histogram, and a chunk overrunning 4x the EWMA chunk time
-        queues a flight-recorder dump (``chunk_stall``)."""
+    def _commit_chunk(self, g: "_ChunkGroup", start, kind, ctok_np,
+                      lanes_np, kvfull_np, t_wall, warm):
+        """Host-side tail of a fused tick's chunk half: advance every
+        row's cursor (mid) or adopt it into the decode batch (last —
+        :meth:`_adopt_slot`, the one join path), then the chunk
+        telemetry: ``serving.prefill_chunks`` / chunk-size and
+        chunk-rows histograms / the prefill-chunk span, the
+        warm-tick EWMA feeds (global + per-bucket for the autotuner,
+        per COMPUTED token for the estimator), and the chunk-stall
+        auto-dump trigger."""
         from paddle_tpu import observability as obs
         from paddle_tpu.observability import registry
 
-        t0 = time.perf_counter()
-        CT, BT = self.chunk_tokens, self.block_tokens
-        start = s.filled
-        P = len(s.feed)
-        ntok = min(CT, P - start)
-        last = start + CT >= P
-        hb = s.R // BT
-        gather = hb if (not self.kv_int8 and start == s.R and hb) else 0
-        ids = np.zeros((1, CT), np.int32)
-        ids[0, :ntok] = s.feed[start:start + ntok]
-        fn, warm = self._chunk_fn("last" if last else "mid", start, gather)
-        args = [self.kv_pool] if (not self.kv_int8 or last) else []
-        if start:
-            if gather:
-                args.append(jnp.asarray(
-                    np.asarray([s.blocks[:hb]], np.int32)))
-            elif start == s.R and self.kv_int8 and hb:
-                # int8 chunk 0 over a prefix hit: the carry IS the
-                # cache's exact bf16 host copies (quantized blocks are
-                # per-slot-scaled, never shareable)
-                args.append(jnp.asarray(np.concatenate(
-                    [e.kv_host for e in s.hits], axis=1)[:, None]))
-            else:
-                args.append(s.carry)
-        args.append(jnp.asarray(ids))
-        n0 = s.ntab                     # blocks covering the whole feed
-        if not self.kv_int8:
-            lo = start // BT
-            bids = [s.blocks[c] if c < n0 else SCRATCH_BLOCK
-                    for c in range(lo, (start + CT) // BT)]
-            args.append(jnp.asarray(np.asarray([bids], np.int32)))
-        elif last:
-            bids = [s.blocks[c] if c < n0 else SCRATCH_BLOCK
-                    for c in range((start + CT) // BT)]
-            args.append(jnp.asarray(np.asarray([bids], np.int32)))
-        if last:
-            args.append(jnp.asarray(np.asarray([P - 1 - start], np.int32)))
-            args.append(jnp.asarray(np.asarray([s.req.seed], np.uint32)))
-            if self.kv_int8:
-                args.append(jnp.asarray(
-                    np.asarray([len(s.req.prompt)], np.int32)))
-        if not last:
-            if self.kv_int8:
-                s.carry = fn(*args)
-            else:
-                s.carry, self.kv_pool = fn(*args)
+        CT = g.chunk
+        n = g.n
+        last = kind == "last"
+        for r, (slot_idx, s) in enumerate(g.rows):
+            ntok = min(CT, len(s.feed) - start)
+            self._tick_chunks.append((s.req.request_id, start, ntok))
+            registry().histogram(
+                "serving.chunk_tokens",
+                buckets=_CHUNK_SIZE_BUCKETS).observe(ntok)
             s.filled = start + CT
-            # a mid chunk has no D2H pull to fence it: without this the
-            # wall time below measures dispatch only (~µs on async
-            # backends) and the chunk's real compute is silently
-            # absorbed into the NEXT decode step's sync segment — the
-            # per-token prefill EWMA would under-price long prompts and
-            # the chunk-stall trigger could never fire on a stalled mid
-            # chunk. One sync per chunk tick matches the engine's
-            # one-sync-per-tick design.
-            # tpu-lint: allow(host-sync): the mid-chunk completion fence
-            s.carry.block_until_ready()
-        elif self.kv_int8:
-            tok, self.kv_pool, lanes, kv_flat = fn(*args)
-            # tpu-lint: allow(host-sync): once-per-prefill D2H — scales
-            lanes_np = np.asarray(lanes)
-            # tpu-lint: allow(host-sync): once-per-prefill D2H — the
-            # prefix cache keeps exact bf16 host copies of int8 blocks
-            kv_np = (np.asarray(kv_flat)
-                     if self.prefix_cache is not None else None)
-            # tpu-lint: allow(host-sync): once-per-prefill D2H — token
-            self._adopt_slot(slot_idx, s, int(np.asarray(tok)[0]),
-                             lanes_np[:, 0],
-                             None if kv_np is None else kv_np[:, 0])
-        else:
-            tok, self.kv_pool = fn(*args)
-            # tpu-lint: allow(host-sync): once-per-prefill D2H — token
-            self._adopt_slot(slot_idx, s, int(np.asarray(tok)[0]),
-                             None, None)
-        t = time.perf_counter() - t0
-        self._tick_prefill_s += t
-        self._tick_chunks.append((s.req.request_id, start, ntok))
+            if last:
+                self._adopt_slot(
+                    slot_idx, s, int(ctok_np[r]),
+                    None if lanes_np is None else lanes_np[:, r],
+                    None if kvfull_np is None else kvfull_np[:, r])
+        if not last and g.dev_prefix is not None and start == g.R:
+            # the int8 prefix-hit bf16 copy is consumed by chunk 0
+            # only (args() appends it at the R cursor alone) — drop it
+            # now rather than hold an (L, n, R, 2dkv) buffer alongside
+            # the carry for the rest of a long prefill
+            g.dev_prefix = None
         self.stats["prefill_chunks"] += 1
         r = registry()
         r.counter("serving.prefill_chunks").inc()
-        r.histogram("serving.chunk_tokens",
-                    buckets=_CHUNK_SIZE_BUCKETS).observe(ntok)
+        r.histogram("serving.chunk_rows",
+                    buckets=_CHUNK_ROWS_BUCKETS).observe(n)
         tr = obs.active_tracer()
-        if tr is not None:
-            tr.record("serving.prefill_chunk", ts=time.time() - t,
-                      dur_s=t, request_id=s.req.request_id,
-                      start=int(start), tokens=int(ntok),
-                      last=bool(last))
+        if tr is not None and g.rows:
+            s0 = g.rows[0][1]
+            tr.record("serving.prefill_chunk", ts=time.time() - t_wall,
+                      dur_s=t_wall, request_id=s0.req.request_id,
+                      start=int(start),
+                      tokens=int(min(CT, len(s0.feed) - start)),
+                      rows=int(n), last=bool(last))
         if warm:    # compile spikes must not poison estimator/stall EWMAs
             ew = self._ewma_chunk.value
-            if ew is not None and t > 4.0 * ew \
+            if ew is not None and t_wall > 4.0 * ew \
                     and self._dump_pending is None:
-                # a warm chunk overrunning 4x its EWMA is the
+                # a warm fused tick overrunning 4x its EWMA is the
                 # chunked-prefill analog of a step_prefill_s outlier —
                 # snapshot the ring for the postmortem
                 self._dump_pending = "chunk_stall"
-            self._ewma_chunk.update(t)
+            self._ewma_chunk.update(t_wall)
+            self._chunk_time_ewma.setdefault(CT, _Ewma()).update(t_wall)
             # per COMPUTED token, not per valid token: the program
-            # always forwards the full CT-wide chunk (the tail is
+            # always forwards the full CT-wide chunk (tails are
             # padded), and estimated_ttft_s prices a prompt as
             # ceil(P/CT) * CT * tok_s — dividing a short last chunk's
             # wall time by its few valid tokens would inflate the EWMA
-            # up to CT-fold and over-shed feasible deadlines
-            self._ewma_prefill_tok.update(t / CT)
+            # up to CT-fold and over-shed feasible deadlines. NOT
+            # amortized by the row count either: weight streaming
+            # dominates a chunk tick, so an n-row tick costs ~one
+            # n=1 tick — dividing by n would teach the autotuner a
+            # per-token cost it cannot reproduce on n=1 groups and
+            # blow the TPOT SLO exactly when load thins out
+            self._ewma_prefill_tok.update(t_wall / CT)
 
     def _release_slot(self, slot_idx: int):
         """Free a slot's blocks and reservation and zero its block
@@ -1538,8 +1910,8 @@ class ServingEngine:
         s = self._slots[slot_idx]
         for bid in s.blocks:
             self.pool.free(bid)
-        s.carry = None          # slot objects linger on the prefill
-        s.hits = None           # FIFO; drop the device buffer now
+        s.hits = None           # slot objects linger on the prefill
+                                # FIFO; drop the cache refs now
         if s.dblocks:           # draft proposer pages
             for bid in s.dblocks:
                 self._draft_pool_blocks.free(bid)
@@ -1643,6 +2015,9 @@ class ServingEngine:
             wave_idx = set()
             try:
                 self._collect_wave(wave, wave_idx)
+                # same-bucket admissions form one _ChunkGroup — n rows
+                # advance one chunk each per fused tick (wave batching)
+                self._make_chunk_groups(wave)
             except BaseException:
                 self._unwind_wave(wave)
                 raise
@@ -1819,8 +2194,9 @@ class ServingEngine:
                 # chunked: the mirror table row STAYS at scratch until
                 # the last chunk lands — a decode append into a
                 # half-written prompt block would corrupt it. Blocks
-                # are handed to the chunk programs directly; _adopt_slot
+                # ride the group's device block-id table; _adopt_slot
                 # publishes the row when the slot joins decode.
+                # (_make_chunk_groups batches this wave into groups.)
                 slot.prefilling = True
                 slot.filled = R
                 slot.hits = hits
@@ -1828,7 +2204,6 @@ class ServingEngine:
                     # mid-prefill expiry must sweep chunked slots (a
                     # monolithic slot prefills the tick it is admitted)
                     slot.deadline_at = req._t_submit + req.deadline_s
-                self._prefill_fifo.append((slot_idx, slot))
             else:
                 row[:n0] = slot.blocks
             self._reserved += worst - n0
@@ -1936,15 +2311,21 @@ class ServingEngine:
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()
         ms = self.max_slots
-        tables = np.full((ms, self.max_blocks_per_slot), SCRATCH_BLOCK,
-                         np.int32)
-        positions = np.zeros(ms, np.int32)
-        toks = np.zeros(ms, np.int32)
-        seeds = np.zeros(ms, np.uint32)
-        counts = np.zeros(ms, np.int32)
-        seeds[slot_idx] = np.uint32(s.req.seed)
         for j, tok in enumerate(s.resume[:-1]):
             self._ensure_blocks(slot_idx)   # append position = s.pos
+            # FRESH host arrays per dispatch — never mutate a numpy
+            # buffer a previous jnp.asarray may still be transferring
+            # (PJRT CPU uploads are ImmutableUntilTransferCompletes;
+            # reusing-and-mutating one raced with the fused tick still
+            # executing and fed a later iteration's token/position into
+            # an earlier dispatch — a once-in-a-few-runs parity flip)
+            tables = np.full((ms, self.max_blocks_per_slot),
+                             SCRATCH_BLOCK, np.int32)
+            positions = np.zeros(ms, np.int32)
+            toks = np.zeros(ms, np.int32)
+            seeds = np.zeros(ms, np.uint32)
+            counts = np.zeros(ms, np.int32)
+            seeds[slot_idx] = np.uint32(s.req.seed)
             tables[slot_idx, :s.ntab] = s.blocks
             positions[slot_idx] = s.pos
             toks[slot_idx] = int(tok)
@@ -1979,7 +2360,6 @@ class ServingEngine:
         P = len(s.feed)
         BT = self.block_tokens
         s.prefilling = False
-        s.carry = None          # free the chunk carry buffer promptly
         s.hits = None
         # publish the block-table row (the chunked path deferred it so
         # decode appends could not touch half-written prompt blocks)
@@ -2061,17 +2441,21 @@ class ServingEngine:
                          and s.tok == int(eos) else "length")
 
     # -------------------------------------------------------------- decode
-    def _build_step_fn(self):
+    def _decode_body(self):
+        """Trace-time DECODE half shared by the plain step program and
+        the fused tick: one paged decode step for every slot, with an
+        optional coscheduled prefill-chunk scatter folded into the same
+        pool pass (``ops.fused_decode.fused_paged_tick_step``)."""
         from paddle_tpu.inference import _row_keys, _sample_logits
-        from paddle_tpu.ops.fused_decode import fused_paged_decode_step
+        from paddle_tpu.ops.fused_decode import fused_paged_tick_step
 
         meta, arch, int8 = self.meta, self.arch, self.kv_int8
         model, cos_tab, sin_tab = self.model, self._cos_tab, self._sin_tab
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         pos_cap = self.max_seq_len - 1
 
-        def impl(state, stacked, pool, tables, positions, toks, seeds,
-                 counts, kv_scales):
+        def body(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales, chunk_bids=None, chunk_kv=None):
             # embed/head come from the traced state (cheap gathers); the
             # stacked layer weights arrive prebuilt via `stacked`, so the
             # plan's own build_fused_params output is unused and XLA
@@ -2083,12 +2467,13 @@ class ServingEngine:
             x = plan_t["embed"](toks, positions)
             cos = jnp.take(cos_tab, positions, axis=0)
             sin = jnp.take(sin_tab, positions, axis=0)
-            x, pool = fused_paged_decode_step(
+            x, pool = fused_paged_tick_step(
                 x, stacked, pool, tables, positions, cos, sin,
                 num_heads=meta["num_heads"],
                 num_kv_heads=meta["num_kv_heads"], eps=meta["eps"],
                 rope_base=meta["rope_base"], arch=arch, blocks=blocks,
-                kv_scales=kv_scales if int8 else None)
+                kv_scales=kv_scales if int8 else None,
+                chunk_bids=chunk_bids, chunk_kv=chunk_kv)
             with jax.named_scope("decode.sample"):
                 keys = _row_keys(seeds)
                 ki = jax.vmap(jax.random.fold_in)(keys, counts)
@@ -2101,6 +2486,16 @@ class ServingEngine:
             # table lookups in range while they idle against scratch
             pos2 = jnp.minimum(positions + 1, pos_cap)
             return nxt, pool, pos2, counts + 1
+
+        return body
+
+    def _build_step_fn(self):
+        body = self._decode_body()
+
+        def impl(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales):
+            return body(state, stacked, pool, tables, positions, toks,
+                        seeds, counts, kv_scales)
 
         # donate the pool: the reference path batches every layer's
         # append into ONE scatter (jax-0.4 CPU ignores donation, so each
@@ -2244,20 +2639,14 @@ class ServingEngine:
         if changed:
             self._dirty = True
 
-    def _build_verify_fn(self, K: int):
-        """ONE program per speculative tick: embed the K+1-token tail
-        (last sampled token + K proposals) per slot, score it through
-        ``fused_paged_verify_step`` (KV appended through the multi-token
-        path), sample each position's TARGET token off the slot's own
-        ``fold_in(seed, count + j)`` stream, and accept the longest
-        proposal prefix that matches — token-exact, so committed tokens
-        are bitwise the non-speculative engine's. Per-slot state
-        (positions/counts/last token) advances on device, and for the
-        n-gram proposer the committed-token history and the NEXT tick's
-        proposals are produced in the same program — a steady
-        speculative tick re-dispatches with zero H2D uploads."""
+    def _verify_body(self, K: int):
+        """Trace-time VERIFY half shared by the speculative step
+        program and the fused tick (see :meth:`_build_verify_fn` for
+        the acceptance contract): an optional coscheduled prefill-chunk
+        scatter folds into the same pool pass before the verify walk."""
         from paddle_tpu.inference import _row_keys, _sample_logits
-        from paddle_tpu.ops.fused_decode import fused_paged_verify_step
+        from paddle_tpu.ops.fused_decode import (fused_paged_verify_step,
+                                                 paged_chunk_scatter)
         from paddle_tpu.serving.spec import ngram_propose
 
         meta, arch, int8 = self.meta, self.arch, self.kv_int8
@@ -2270,9 +2659,12 @@ class ServingEngine:
         nmax = self.speculate.ngram_max
         nmin = self.speculate.ngram_min
 
-        def impl(state, stacked, pool, tables, positions, toks, seeds,
-                 counts, kv_scales, proposals, nprop, cap, *hist):
-            history = hist[0] if ngram else None
+        def body(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales, proposals, nprop, cap, history=None,
+                 chunk_bids=None, chunk_kv=None):
+            if chunk_bids is not None:
+                with jax.named_scope("fused_decode.chunk_scatter"):
+                    pool = paged_chunk_scatter(pool, chunk_bids, chunk_kv)
             plan_t = model.fused_decode_plan(state)
             blocks = plan_t.get("blocks")
             if int8 and blocks is not None:
@@ -2330,6 +2722,29 @@ class ServingEngine:
             prop2, nprop2 = ngram_propose(hist2, pos2 + 1, K, nmax, nmin)
             return (g, acc, pool, pos2, tok2, counts2, hist2, prop2,
                     jnp.minimum(nprop2, cap))
+
+        return body
+
+    def _build_verify_fn(self, K: int):
+        """ONE program per speculative tick: embed the K+1-token tail
+        (last sampled token + K proposals) per slot, score it through
+        ``fused_paged_verify_step`` (KV appended through the multi-token
+        path), sample each position's TARGET token off the slot's own
+        ``fold_in(seed, count + j)`` stream, and accept the longest
+        proposal prefix that matches — token-exact, so committed tokens
+        are bitwise the non-speculative engine's. Per-slot state
+        (positions/counts/last token) advances on device, and for the
+        n-gram proposer the committed-token history and the NEXT tick's
+        proposals are produced in the same program — a steady
+        speculative tick re-dispatches with zero H2D uploads."""
+        body = self._verify_body(K)
+        ngram = self.speculate.proposer == "ngram"
+
+        def impl(state, stacked, pool, tables, positions, toks, seeds,
+                 counts, kv_scales, proposals, nprop, cap, *hist):
+            return body(state, stacked, pool, tables, positions, toks,
+                        seeds, counts, kv_scales, proposals, nprop, cap,
+                        hist[0] if ngram else None)
 
         # donate the history buffer alongside the pool: the ngram path
         # RMWs it every verify tick (hist2 = history.at[...].set) and
@@ -2622,12 +3037,14 @@ class ServingEngine:
                     and now > s.deadline_at:
                 record_event("deadline_exceeded")
                 self._retire(i, "deadline")
-        # chunked-prefill interleave: at most ONE chunk program per
-        # tick, and only once every `decode_per_chunk` decode
-        # dispatches while decode-ready slots exist — the decode TPOT
-        # bound is one chunk, whatever the prompt length. With nothing
-        # decode-ready the chunk runs unconditionally (nothing to
-        # starve; prefill should not idle either).
+        # chunked-prefill interleave (the ONE-PROGRAM tick): when a
+        # chunk is due — every `decode_per_chunk` decode dispatches
+        # while decode-ready slots exist, unconditionally otherwise —
+        # the tick dispatches ONE fused program computing the front
+        # group's next chunk AND every decode-ready slot's next token
+        # (or verify tail), so the decode TPOT bound is one fused tick
+        # and the pool/carry cross exactly one program boundary.
+        grp = None
         if self.chunk_tokens is not None:
             front = self._front_prefill()
             if front is not None:
@@ -2636,17 +3053,17 @@ class ServingEngine:
                 if (not decode_ready
                         or self._decode_since_chunk
                         >= self.decode_per_chunk):
-                    self._run_prefill_chunk(*front)
-                    self._decode_since_chunk = 0
+                    grp = front
         dispatch_s = sync_s = None
         spec = self.speculate is not None
         spec_tick = False
+        K_eff = 0
         # prefilling slots stay OUT of the decode batch: their mirror
         # rows idle against scratch until the last chunk adopts them
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and not s.prefilling]
-        if active:
-            if spec:
+        if active or grp is not None:
+            if spec and active:
                 if self.speculate.adaptive:
                     self._maybe_probe(active)
                 self._spec_k_eff = K_eff = self._current_spec_k(active)
@@ -2663,7 +3080,7 @@ class ServingEngine:
                     if self.speculate.proposer == "draft":
                         self._draft_fns[K_eff] = self._build_draft_fn(
                             K_eff)
-            elif self._step_fn is None:
+            elif self._step_fn is None and grp is None:
                 # non-speculative engines AND adaptive ticks whose every
                 # active slot sits at k=0 ride the plain per-token
                 # dispatch — the "stops paying the verify tail" case
@@ -2673,11 +3090,25 @@ class ServingEngine:
                 if self._draft_tables is not None:
                     self._ensure_draft_blocks(i)
             _faults.maybe_fire("decode.dispatch")
+            # the fused tick program for this chunk bucket (cursor +
+            # tail width); built before the steady/dirty decision so a
+            # compile never counts as a steady dispatch
+            tick_fn = None
+            tick_warm = True
+            g_start = g_kind = None
+            if grp is not None:
+                g_start, g_kind = grp.start, grp.kind
+                tick_fn, tick_warm = self._tick_fn(
+                    g_kind, g_start, grp.n, grp.C_pad, grp.chunk, grp.R,
+                    K_eff if spec_tick else 0)
             # steady state = the warm program re-dispatches with NO
             # host->device upload: no join/leave/lazy-block event made
             # the mirrors dirty. This is the tick the "no steady-state
             # H2D" claim is about — and what sanitize mode guards.
-            steady = self._step_fn_warm and not self._dirty
+            # Steady FUSED ticks (mid-prefill chunks of a covered
+            # bucket) hold the same invariant: every chunk input is
+            # device-resident from admission.
+            steady = self._step_fn_warm and not self._dirty and tick_warm
             if self._dirty:
                 self._dev = (jnp.asarray(self._tables),
                              jnp.asarray(self._positions),
@@ -2701,29 +3132,114 @@ class ServingEngine:
         # everything up to the dispatch call is the admit segment
         # (minus the prefill programs, which _run_prefill_group timed)
         admit_s = max(0.0, time.perf_counter() - t0 - self._tick_prefill_s)
-        if active and spec_tick:
-            dispatch_s, sync_s = self._spec_decode(active, steady)
-        elif active:
-            t_d0 = time.perf_counter()
-            if self._sanitize and steady:
-                from paddle_tpu.analysis import runtime as _sanitizer
-                with _sanitizer.sanitize(
-                        what="steady-state ServingEngine.step dispatch"):
-                    d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
-                        self.kv_pool, *self._dev)
-                self.stats["sanitized_steps"] += 1
-            else:
-                d_nxt, self.kv_pool, d_pos, d_cnt = self._step_fn(
-                    self.kv_pool, *self._dev)
-            # toks <- sampled ids; tables/seeds/scales are event-driven
-            self._dev = (self._dev[0], d_pos, d_nxt, self._dev[3], d_cnt,
-                         self._dev[5])
-            t_s0 = time.perf_counter()
-            dispatch_s = t_s0 - t_d0
-            # tpu-lint: allow(host-sync): THE one per-step D2H — the
-            # sampled-token pull is the step's completion fence
-            nxt = np.asarray(d_nxt)
-            sync_s = time.perf_counter() - t_s0
+        if spec_tick:
+            dispatch_s, sync_s = self._spec_decode(
+                active, steady, grp, tick_fn, tick_warm, g_start, g_kind)
+        elif active or grp is not None:
+            dispatch_s, sync_s = self._plain_decode(
+                active, steady, grp, tick_fn, tick_warm, g_start, g_kind)
+        self._record_segments(admit_s, dispatch_s, sync_s)
+        self._record_flight(admit_s, dispatch_s, sync_s)
+        self._after_flight()
+        return dict(active=self.active_slots, queued=len(self._queue),
+                    finished=self._finished_tick)
+
+    def _select_chunk_outs(self, grp, g_kind, chunk_outs):
+        """Split the chunk half's outputs off a fused-tick result —
+        the ONE place that re-implements ``_tick_fn``'s output
+        ordering (carry2 | ctok [, lanes [, kvfull]], each present
+        only when the bucket produces it). Mid int8 ticks rebind the
+        group's resident carry in place; returns ``(ctok, lanes,
+        kvfull)`` as device arrays still to fence/pull (``None`` where
+        absent)."""
+        ctok = lanes = kvfull = None
+        if grp is not None:
+            if g_kind == "last":
+                ctok = chunk_outs[0]
+                if self.kv_int8:
+                    lanes = chunk_outs[1]
+                    if self.prefix_cache is not None:
+                        kvfull = chunk_outs[2]
+            elif self.kv_int8:
+                grp.carry = chunk_outs[0]   # the resident carry, RMW'd
+        return ctok, lanes, kvfull
+
+    def _fence_chunk_pulls(self, grp, g_kind, chunk_outs, head):
+        """THE tick's one per-step D2H completion fence plus the chunk
+        half's host-pull choreography, shared by the plain and
+        speculative paths: select the chunk outputs off the fused
+        result (:meth:`_select_chunk_outs`), pull ``head`` (the decode
+        half's host-needed arrays; ``None`` entries skipped) and any
+        chunk outputs in ONE batched ``device_get`` — not N round
+        trips on the sync segment the TPOT bound measures — and reset
+        the interleave budget (the fused tick IS this window's chunk;
+        its own decode half counts toward the budget through the
+        caller's increment — reset-then-increment, the two-program
+        tick's order). A chunk-only mid tick has no host-needed
+        output: fence on the carry (int8) or the pool (bf16 — the
+        chunk scattered into it) instead, so the wall time the caller
+        reads still measures completion, not dispatch (the chunk
+        EWMAs/stall trigger would otherwise go blind). Returns
+        ``(head_np, ctok_np, lanes_np, kvfull_np)``, ``head_np``
+        mirroring ``head`` entry for entry."""
+        ctok, lanes, kvfull = self._select_chunk_outs(grp, g_kind,
+                                                      chunk_outs)
+        pulls = [x for x in (*head, ctok, lanes, kvfull)
+                 if x is not None]
+        if pulls:
+            # tpu-lint: allow(host-sync): the per-step D2H completion
+            # fence (one batched device_get, not N round trips)
+            pulled = list(jax.device_get(tuple(pulls)))
+        else:
+            fence = (grp.carry if grp is not None
+                     and grp.carry is not None else self.kv_pool)
+            # tpu-lint: allow(host-sync): the mid-chunk completion
+            # fence
+            fence.block_until_ready()
+            pulled = []
+        head_np = [pulled.pop(0) if h is not None else None
+                   for h in head]
+        ctok_np = pulled.pop(0) if ctok is not None else None
+        lanes_np = pulled.pop(0) if lanes is not None else None
+        kvfull_np = pulled.pop(0) if kvfull is not None else None
+        if grp is not None:
+            self._decode_since_chunk = 0
+        return head_np, ctok_np, lanes_np, kvfull_np
+
+    def _plain_decode(self, active, steady, grp=None, tick_fn=None,
+                      tick_warm=True, g_start=None, g_kind=None):
+        """One plain (non-speculative) tick's dispatch + host commit:
+        the fused tick program when a chunk is due (``grp``), else the
+        per-token step program. Returns (dispatch_s, sync_s)."""
+        from paddle_tpu.observability import registry
+
+        t_d0 = time.perf_counter()
+        if grp is not None:
+            fn = tick_fn
+            args = (self.kv_pool, *grp.args(), *self._dev)
+        else:
+            fn = self._step_fn
+            args = (self.kv_pool, *self._dev)
+        if self._sanitize and steady:
+            from paddle_tpu.analysis import runtime as _sanitizer
+            with _sanitizer.sanitize(
+                    what="steady-state ServingEngine.step dispatch"):
+                out = fn(*args)
+            self.stats["sanitized_steps"] += 1
+        else:
+            out = fn(*args)
+        d_nxt, self.kv_pool, d_pos, d_cnt = out[:4]
+        chunk_outs = out[4:]
+        # toks <- sampled ids; tables/seeds/scales are event-driven
+        self._dev = (self._dev[0], d_pos, d_nxt, self._dev[3], d_cnt,
+                     self._dev[5])
+        t_s0 = time.perf_counter()
+        dispatch_s = t_s0 - t_d0
+        head_np, ctok_np, lanes_np, kvfull_np = self._fence_chunk_pulls(
+            grp, g_kind, chunk_outs, [d_nxt if active else None])
+        nxt = head_np[0]
+        sync_s = time.perf_counter() - t_s0
+        if active:
             self._decode_since_chunk += 1
             self.stats["steps"] += 1
             self.stats["decode_tokens"] += len(active)
@@ -2737,7 +3253,7 @@ class ServingEngine:
             r.counter("serving.tokens_generated").inc(len(active))
             r.counter("serving.idle_slot_steps").inc(
                 self.max_slots - len(active))
-            if spec:
+            if self.speculate is not None:
                 # adaptive tick with every active slot at k=0: surface
                 # the degraded tail width (the verify path never runs
                 # here, so _spec_decode's gauge set cannot)
@@ -2763,16 +3279,18 @@ class ServingEngine:
                     self._retire(i, "eos")
                 elif s.count >= s.req.max_new_tokens:
                     self._retire(i, "length")
-        self._record_segments(admit_s, dispatch_s, sync_s)
-        self._record_flight(admit_s, dispatch_s, sync_s)
-        self._after_flight()
-        return dict(active=self.active_slots, queued=len(self._queue),
-                    finished=self._finished_tick)
+        if grp is not None:
+            self._commit_chunk(grp, g_start, g_kind, ctok_np, lanes_np,
+                               kvfull_np, dispatch_s + sync_s, tick_warm)
+        return dispatch_s, sync_s
 
-    def _spec_decode(self, active, steady):
+    def _spec_decode(self, active, steady, grp=None, tick_fn=None,
+                     tick_warm=True, g_start=None, g_kind=None):
         """One speculative tick's decode: the (optional) draft round
-        plus ONE batched verify dispatch, then the host commit of each
-        slot's accepted prefix + corrected/bonus token. Returns
+        plus ONE batched verify dispatch — the fused tick program when
+        a chunk is due (``grp``), carrying the front group's chunk in
+        the same program — then the host commit of each slot's
+        accepted prefix + corrected/bonus token. Returns
         (dispatch_s, sync_s) for the step-segment telemetry. Mirrors
         stay in lockstep with the device state for surviving slots; a
         retirement inside the commit loop marks the mirrors dirty like
@@ -2782,7 +3300,7 @@ class ServingEngine:
 
         ngram = self._history is not None
         K_eff = self._spec_k_eff
-        verify_fn = self._verify_fns[K_eff]
+        verify_fn = tick_fn if grp is not None else self._verify_fns[K_eff]
         draft_fn = self._draft_fns.get(K_eff)
         t_d0 = time.perf_counter()
 
@@ -2794,8 +3312,9 @@ class ServingEngine:
                 nprop = self._nprop_full(K_eff)
             else:
                 props, nprop = self._dev_prop
-            args = (self.kv_pool, *self._dev, props, nprop,
-                    self._dev_cap)
+            args = (self.kv_pool,
+                    *(grp.args() if grp is not None else ()),
+                    *self._dev, props, nprop, self._dev_cap)
             if ngram:
                 args += (self._dev_hist,)
             return props, nprop, verify_fn(*args)
@@ -2811,22 +3330,20 @@ class ServingEngine:
             props_dev, nprop_dev, out = dispatch()
         if ngram:
             (g, acc, self.kv_pool, d_pos, d_tok, d_cnt, hist2, prop2,
-             nprop2) = out
+             nprop2) = out[:9]
+            chunk_outs = out[9:]
             self._dev_hist = hist2
             self._dev_prop = (prop2, nprop2)
         else:
-            g, acc, self.kv_pool, d_pos, d_tok, d_cnt = out
+            g, acc, self.kv_pool, d_pos, d_tok, d_cnt = out[:6]
+            chunk_outs = out[6:]
         self._dev = (self._dev[0], d_pos, d_tok, self._dev[3], d_cnt,
                      self._dev[5])
         t_s0 = time.perf_counter()
         dispatch_s = t_s0 - t_d0
-        # THE one per-step D2H: accepted counts + target tokens + the
-        # verified proposals together are the step's completion fence —
-        # ONE batched device_get, not four round trips on the sync
-        # segment the TPOT bound measures
-        # tpu-lint: allow(host-sync): the per-step D2H completion fence
-        g_np, acc_np, prop_np, nprop_np = jax.device_get(
-            (g, acc, props_dev, nprop_dev))
+        head_np, ctok_np, lanes_np, kvfull_np = self._fence_chunk_pulls(
+            grp, g_kind, chunk_outs, [g, acc, props_dev, nprop_dev])
+        g_np, acc_np, prop_np, nprop_np = head_np
         sync_s = time.perf_counter() - t_s0
 
         self._decode_since_chunk += 1
@@ -2898,6 +3415,9 @@ class ServingEngine:
                       dur_s=dur, slots=len(active),
                       proposed=proposed_total, accepted=accepted_total,
                       committed=committed_total)
+        if grp is not None:
+            self._commit_chunk(grp, g_start, g_kind, ctok_np, lanes_np,
+                               kvfull_np, dispatch_s + sync_s, tick_warm)
         return dispatch_s, sync_s
 
     def _after_flight(self):
@@ -2930,13 +3450,22 @@ class ServingEngine:
             r.histogram("serving.step_sync_s").observe(sync_s)
             # capacity-estimator feed: the same decode-step cost the
             # histograms just observed (shed_infeasible prices deadlines
-            # against this EWMA) — except the first dispatch, whose
-            # trace+compile would poison the estimate for dozens of
-            # steps and shed feasible deadlines right after startup
-            if self._step_fn_warm:
-                self._ewma_step.update(dispatch_s + sync_s)
-            else:
-                self._step_fn_warm = True
+            # against this EWMA) — except fused CHUNK ticks, whose wall
+            # is chunk-dominated (the chunk EWMAs in _commit_chunk own
+            # those; feeding them here would inflate the decode-step
+            # estimate and over-shed), and except the plain program's
+            # FIRST dispatch, whose trace+compile would poison the
+            # estimate for dozens of steps and shed feasible deadlines
+            # right after startup. The two warm flags are distinct on
+            # purpose: _step_fn_warm (the steady/sanitize gate) flips
+            # on ANY first dispatch, including a fused chunk tick —
+            # the plain step program may not have compiled yet.
+            self._step_fn_warm = True
+            if not self._tick_chunks:
+                if self._ewma_step_warm:
+                    self._ewma_step.update(dispatch_s + sync_s)
+                else:
+                    self._ewma_step_warm = True
 
     def _record_flight(self, admit_s, dispatch_s, sync_s, err=None):
         """One compact JSON-ready event per tick into the flight ring."""
@@ -2952,7 +3481,8 @@ class ServingEngine:
                "prefills": [[R, s_pad, n]
                             for R, s_pad, n in self._tick_prefills],
                "chunk_tokens": self.chunk_tokens,
-               "prefill_chunks": len(self._tick_chunks),
+               "prefill_chunks": min(len(self._tick_chunks), 1),
+               "chunk_rows": len(self._tick_chunks),
                "chunks": [[rid, st, nt]
                           for rid, st, nt in self._tick_chunks],
                "spec_k": (self._spec_k if self.speculate is not None
@@ -3149,6 +3679,8 @@ class ServingEngine:
                   "shed_infeasible": self.shed_infeasible,
                   "chunk_tokens": self.chunk_tokens,
                   "decode_per_chunk": self.decode_per_chunk,
+                  "chunk_autotune": self.chunk_autotune,
+                  "slo_tpot_s": self.slo_tpot_s,
                   "speculate": (self.speculate.to_config()
                                 if self.speculate is not None else None),
                   "sanitize": self._sanitize_mode}

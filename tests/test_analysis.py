@@ -924,13 +924,19 @@ def test_join_leave_compile_set_is_exactly_prefill_shapes():
 
 
 def test_chunked_compile_set_is_exactly_chunk_buckets():
-    """Chunked prefill keeps the compile set small and EXACTLY pinned:
-    chunk programs are keyed by (kind, KV-cursor) buckets — the first
-    admission pays one program per chunk bucket plus the step program;
-    any prompt whose buckets are covered pays ZERO compiles; a longer
-    prompt pays exactly its NEW buckets. Steady-state chunked ticks
-    stay 0 H2D + 0 compiles under the same guards as the monolithic
-    engine (the sanitize=True invariant)."""
+    """The one-program tick keeps the compile set small and EXACTLY
+    pinned: each chunk tick dispatches ONE fused program (chunk half +
+    decode half — no separate chunk+step programs), keyed by the chunk
+    bucket (kind, cursor, rows, feed bucket, chunk size). First
+    admission pays one fused-tick program per chunk bucket plus the
+    step program (chunkless decode ticks); any prompt whose buckets
+    are covered pays ZERO compiles; a longer prompt pays exactly its
+    NEW buckets (the resident carry's feed bucket rides the key, so a
+    new feed bucket recompiles its whole chain). Steady chunked
+    decode ticks AND steady mid-prefill fused ticks stay 0 H2D + 0
+    compiles under the same guards as the monolithic engine (the
+    sanitize=True invariant — every chunk input is device-resident
+    from admission)."""
     if not rt.compile_events_supported():
         pytest.skip("jax.monitoring compile events unavailable")
     from paddle_tpu import serving
@@ -940,7 +946,8 @@ def test_chunked_compile_set_is_exactly_chunk_buckets():
                                max_seq_len=256, chunk_tokens=32,
                                prefix_caching=False,
                                sanitize=True) as eng:
-        # 70 tokens @ chunk 32 -> mid(0) + mid(32) + last(64), + step fn
+        # 70 tokens @ chunk 32 (feed bucket 96) -> fused ticks mid(0)
+        # + mid(32) + last(64), + the chunkless step fn
         eng.submit(serving.Request(rng.randint(3, 500, (70,)),
                                    max_new_tokens=4))
         with rt.count_compiles() as c:
@@ -953,17 +960,19 @@ def test_chunked_compile_set_is_exactly_chunk_buckets():
             with rt.count_compiles() as c:
                 eng.drain(max_steps=60)
             assert c.count == 0, (n, c.events)
-        # 100 tokens -> exactly the two new buckets: mid(64) + last(96)
+        # 100 tokens -> feed bucket 128: exactly its four fused-tick
+        # buckets mid(0)+mid(32)+mid(64)+last(96) (the resident carry
+        # is shaped by the feed bucket, so none are shared with 96)
         eng.submit(serving.Request(rng.randint(3, 500, (100,)),
                                    max_new_tokens=4))
         with rt.count_compiles() as c:
             eng.drain(max_steps=60)
-        assert c.count == 2, c.events
+        assert c.count == 4, c.events
         # steady-state chunked decode ticks: 0 H2D + 0 compiles
         eng.submit(serving.Request(rng.randint(3, 500, (40,)),
-                                   max_new_tokens=12))
-        eng.step()                  # admit + chunk 0
-        eng.step()                  # last chunk + adopt (dirty upload)
+                                   max_new_tokens=24))
+        eng.step()                  # admit + chunk 0 (+2 compiles:
+        eng.step()                  # bucket 64) ... last chunk + adopt
         eng.step()                  # first steady re-dispatch
         guarded = 0
         while eng.active_slots and guarded < 6:
@@ -974,6 +983,22 @@ def test_chunked_compile_set_is_exactly_chunk_buckets():
             guarded += 1
         assert guarded == 6
         assert eng.stats["sanitized_steps"] >= guarded
+        # steady FUSED ticks: a covered-bucket prompt admitted while
+        # the 40-token slot still decodes — after the admission tick
+        # (group creation = a join event), every mid-prefill chunk
+        # tick re-dispatches warm fused programs with NO H2D upload
+        assert eng.active_slots == 1
+        eng.submit(serving.Request(rng.randint(3, 500, (70,)),
+                                   max_new_tokens=4))
+        eng.step()                  # admit + fused chunk 0 (dirty)
+        fused_guarded = 0
+        while any(s is not None and s.prefilling for s in eng._slots):
+            with rt.no_transfer(what="steady fused chunk tick"), \
+                    rt.count_compiles() as c:
+                eng.step()          # fused mid/last chunk tick
+            assert c.count == 0, c.events
+            fused_guarded += 1
+        assert fused_guarded >= 2   # mid(32) + last(64) at least
         eng.drain()
 
 
@@ -1078,20 +1103,23 @@ def test_router_steady_state_zero_h2d_zero_recompiles():
 
 def test_donation_report_serving_pool_step_and_chunk_programs():
     """THE donation pins: the serving pool-step program aliases its KV
-    pool input into the pool output (every leaf), and the chunked-
-    prefill programs alias the pool the same way — 'the TPU path
-    aliases it away' as a checked property instead of a prose caveat
-    (SCALE.md). The engine program handles carry .jitted/.bound so the
-    report lowers the REAL programs with their bound state."""
+    pool input into the pool output (every leaf); the bf16 fused chunk
+    tick aliases the pool (its carry-free mid chunks gather the
+    processed prefix FROM the pool); and the int8 fused mid-chunk tick
+    aliases the pool AND the resident bf16 KV carry in-place — 'the
+    TPU path aliases it away' as a checked property instead of a prose
+    caveat (SCALE.md §Donation aliasing). The engine program handles
+    carry .jitted/.bound so the report lowers the REAL programs with
+    their bound state."""
     from paddle_tpu import serving
     m = _tiny_llama()
     rng = np.random.RandomState(7)
     with serving.ServingEngine(m, max_slots=2, block_tokens=32,
                                max_seq_len=256, chunk_tokens=32,
                                prefix_caching=False) as eng:
-        eng.submit(serving.Request(rng.randint(3, 500, (40,)),
+        eng.submit(serving.Request(rng.randint(3, 500, (70,)),
                                    max_new_tokens=6))
-        for _ in range(4):          # chunks + adopt + first decode
+        for _ in range(5):          # chunks + adopt + first decode
             eng.step()
         assert eng._step_fn is not None
         rep = rt.donation_report(eng._step_fn, eng.kv_pool, *eng._dev,
@@ -1100,19 +1128,92 @@ def test_donation_report_serving_pool_step_and_chunk_programs():
         assert rep.donated_argnums == [2]
         rep.expect_aliased(2)
         assert rep.args[2]["leaves"] == 1
-        # chunked-prefill: the first mid-chunk program (start=0)
-        # donates and aliases the pool; its bf16 KV carry is a fresh,
-        # LARGER output by construction (the O(prompt²/chunk) shape
-        # growth) — pool aliasing is what keeps chunking affordable
-        chunk_fn = eng._jit_cache.get(("chunk", "mid", False, 0, 0))
-        assert chunk_fn is not None
-        ids = jnp.zeros((1, 32), jnp.int32)
-        new_bids = jnp.zeros((1, 1), jnp.int32)     # (rows, CT//BT)
-        crep = rt.donation_report(chunk_fn, eng.kv_pool, ids, new_bids,
-                                  what="mid chunk program")
-        assert crep.donated_argnums == [1]
-        crep.expect_aliased(1)
+        # bf16 fused mid tick: ("tick", kind, int8, start, n, C_pad,
+        # CT, R, K) — carry-free (pool gather), pool donated + aliased
+        tick_fn = eng._jit_cache.get(
+            ("tick", "mid", False, 32, 1, 96, 32, 0, 0))
+        assert tick_fn is not None, list(eng._jit_cache)
+        ids = jnp.zeros((1, 96), jnp.int32)
+        bids = jnp.zeros((1, 3), jnp.int32)
+        crep = rt.donation_report(tick_fn, eng.kv_pool, ids, bids,
+                                  *eng._dev,
+                                  what="fused mid-chunk tick (bf16)")
+        assert crep.donated_argnums == [2], crep
+        crep.expect_aliased(2)
         eng.drain(max_steps=200)
+    # int8: the resident carry rides the fused tick as a donated
+    # in-place buffer — pool (2) AND carry (3) aliased in the compiled
+    # module (the staging-buffer round trip BENCH_r06 caveated, gone)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=256, chunk_tokens=32,
+                               cache_dtype=jnp.int8,
+                               prefix_caching=False) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (70,)),
+                                   max_new_tokens=6))
+        for _ in range(5):
+            eng.step()
+        tick_fn = eng._jit_cache.get(
+            ("tick", "mid", True, 32, 1, 96, 32, 0, 0))
+        assert tick_fn is not None, list(eng._jit_cache)
+        L, dkv2 = eng._num_layers, 2 * eng._dkv
+        carry = jnp.zeros((L, 1, 96, dkv2), jnp.bfloat16)
+        ids = jnp.zeros((1, 96), jnp.int32)
+        bids = jnp.zeros((1, 3), jnp.int32)
+        crep = rt.donation_report(tick_fn, eng.kv_pool, carry, ids,
+                                  bids, *eng._dev,
+                                  what="fused mid-chunk tick (int8)")
+        assert crep.donated_argnums == [2, 3], crep
+        crep.expect_aliased(2, 3)
+        eng.drain(max_steps=200)
+
+
+def test_chunk_autotune_transitions_compile_exactly_new_buckets():
+    """The chunk autotuner re-evaluates ONLY at admission boundaries,
+    so the compile set stays pinnable: a stable pick reuses its
+    fused-tick programs (0 compiles), and a bucket transition compiles
+    exactly the NEW bucket's programs — here one, because the larger
+    chunk covers the prompt in a single fused tick."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(11)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=256, chunk_tokens=32,
+                               chunk_autotune=True, slo_tpot_s=0.04,
+                               prefix_caching=False) as eng:
+        # cold: no per-token EWMA -> the configured 32-token bucket.
+        # 60 tokens @ 32 -> mid(0) + last(32) fused ticks + step fn
+        p = rng.randint(3, 500, (60,))
+        eng.submit(serving.Request(p, max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=60)
+        assert c.count == 3, c.events
+        assert eng._chunk_choice == 32
+        # warm but stable: pred(32)=0.032 fits 0.04, pred(64)=0.064
+        # does not -> the pick holds and the covered bucket compiles
+        # nothing
+        eng._ewma_prefill_tok.value = 1e-3
+        eng._ewma_step.value = 0.0
+        eng.submit(serving.Request(rng.randint(3, 500, (60,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=60)
+        assert c.count == 0, c.events
+        assert eng._chunk_choice == 32
+        # faster EWMA -> pred(64)=0.032 fits, pred(128)=0.064 doesn't:
+        # the tuner steps up one bucket, which covers the 60-token
+        # prompt in ONE fused last(0) tick = exactly one new compile
+        eng._ewma_prefill_tok.value = 5e-4
+        eng._ewma_step.value = 0.0
+        eng.submit(serving.Request(rng.randint(3, 500, (60,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=60)
+        assert c.count == 1, c.events
+        assert eng._chunk_choice == 64
+        from paddle_tpu.observability import registry
+        assert registry().gauge("serving.chunk_autotune").value == 64
 
 
 def test_donation_report_spec_verify_history():

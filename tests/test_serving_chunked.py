@@ -176,6 +176,197 @@ def test_chunked_prefix_int8_requantize_parity():
     eng.close()
 
 
+# --------------------------------------------------- batched chunk rows
+
+def test_batched_chunk_rows_parity():
+    """Same-tick same-shape admissions form ONE chunk group: n rows
+    advance one chunk each per fused tick (wave batching recovered),
+    tokens pinned identical to isolated generate, and the dispatch
+    accounting shows n rows riding one program per chunk bucket."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(40)
+    prompts = [rng.randint(3, 512, (70,)), rng.randint(3, 512, (70,))]
+    seeds = [11, 22]
+    iso = [np.asarray(generate(m, p[None], max_new_tokens=6,
+                               request_seeds=[s],
+                               temperature=0.0))[0, len(p):]
+           for p, s in zip(prompts, seeds)]
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=32,
+                                prefix_caching=False)
+    rids = [eng.submit(serving.Request(p, max_new_tokens=6, seed=s))
+            for p, s in zip(prompts, seeds)]
+    eng.step()          # both admitted in one wave -> one group
+    assert len(eng._prefill_fifo) == 1
+    assert eng._prefill_fifo[0].n == 2
+    eng.drain(max_steps=200)
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    # 70 tokens @ chunk 32 = 3 buckets: THREE fused dispatches served
+    # both rows (the n=1 FIFO would have paid six)
+    assert eng.stats["prefill_chunks"] == 3
+    eng.close()
+
+
+@pytest.mark.slow
+def test_batched_chunk_rows_int8_sampled_parity():
+    """Batched rows through the int8 pool: per-row deferred
+    calibration scales come out of the one fused last-chunk tick
+    (lanes sliced per row) — sampled tokens still match isolated
+    int8 generate."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(41)
+    prompts = [rng.randint(3, 512, (45,)), rng.randint(3, 512, (45,))]
+    seeds = [33, 44]
+    kw = dict(temperature=0.8, top_k=40, top_p=0.9)
+    iso = [np.asarray(generate(m, p[None], max_new_tokens=6,
+                               cache_dtype=jnp.int8,
+                               request_seeds=[s], **kw))[0, len(p):]
+           for p, s in zip(prompts, seeds)]
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, cache_dtype=jnp.int8,
+                                chunk_tokens=16, prefix_caching=False,
+                                **kw)
+    rids = [eng.submit(serving.Request(p, max_new_tokens=6, seed=s))
+            for p, s in zip(prompts, seeds)]
+    eng.step()
+    assert eng._prefill_fifo and eng._prefill_fifo[0].n == 2
+    eng.drain(max_steps=200)
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    eng.close()
+
+
+def test_group_compaction_on_mid_prefill_preemption():
+    """Preempting ONE row of an n=2 chunk group mid-prefill compacts
+    the group (device inputs — and on int8 pools the resident carry —
+    sliced to the survivor): the survivor finishes in place and the
+    victim resumes token-exact. Runs on the int8 pool so the carry
+    slicing path is exercised."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(42)
+    prompts = [rng.randint(3, 512, (70,)), rng.randint(3, 512, (70,))]
+    hp = rng.randint(3, 512, (9,))
+    iso = [np.asarray(generate(m, p[None], max_new_tokens=4,
+                               cache_dtype=jnp.int8, request_seeds=[s],
+                               temperature=0.0))[0, len(p):]
+           for p, s in zip(prompts, [1, 2])]
+    iso_h = np.asarray(generate(m, hp[None], max_new_tokens=4,
+                                cache_dtype=jnp.int8, request_seeds=[9],
+                                temperature=0.0))[0, len(hp):]
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, chunk_tokens=16,
+                                cache_dtype=jnp.int8,
+                                prefix_caching=False)
+    rids = [eng.submit(serving.Request(p, max_new_tokens=4, seed=s,
+                                       priority="low"))
+            for p, s in zip(prompts, [1, 2])]
+    eng.step()          # one n=2 group, chunk 0 done
+    eng.step()          # chunk 1: carry exists (start > R)
+    g = eng._prefill_fifo[0]
+    assert g.n == 2 and g.carry is not None
+    rh = eng.submit(serving.Request(hp, max_new_tokens=4, seed=9,
+                                    priority="high"))
+    eng.drain(max_steps=400)
+    assert eng.stats["preemptions"] == 1
+    for rid, ref in zip(rids, iso):
+        assert eng.results[rid].tokens.tolist() == ref.tolist()
+    assert eng.results[rh].tokens.tolist() == iso_h.tolist()
+    eng.close()
+
+
+def test_chunk_autotune_validation_and_pricing():
+    """chunk_autotune needs chunk_tokens + slo_tpot_s; the TTFT
+    estimator prices chunked prefill at the autotuner's CURRENT
+    bucket."""
+    cfg, m = tiny_llama()
+    with pytest.raises(ValueError, match="chunk_autotune"):
+        serving.ServingEngine(m, block_tokens=16, chunk_tokens=16,
+                              chunk_autotune=True)
+    with pytest.raises(ValueError, match="slo_tpot_s"):
+        serving.ServingEngine(m, block_tokens=16, chunk_tokens=16,
+                              chunk_autotune=True, slo_tpot_s=0.0)
+    rng = np.random.RandomState(43)
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=1024, chunk_tokens=64,
+                                chunk_autotune=True, slo_tpot_s=0.5,
+                                decode_per_chunk=2,
+                                shed_infeasible=True)
+    eng._ewma_step.value = 0.01
+    eng._ewma_prefill_tok.value = 1e-3
+    eng._chunk_choice = 128         # as if the tuner stepped up
+    req = serving.Request(rng.randint(3, 512, (200,)), max_new_tokens=4)
+    est = eng.estimated_ttft_s(req)
+    n_chunks = -(-200 // 128)       # 2 at the CURRENT bucket
+    expect = n_chunks * 128 * 1e-3 + (n_chunks - 1) * 2 * 0.01
+    assert est is not None and abs(est - expect) < 1e-6
+    eng.close()
+
+
+def test_chunk_autotune_ladder_clamped_and_probe_budgeted():
+    """Two autotuner guards: (1) the candidate ladder stops at the
+    first bucket covering the admission's padded prompt — a wider
+    chunk only forwards (and compiles programs for) positions the
+    prompt doesn't have, so a generous SLO must not pad an 80-token
+    prefill out to a 2048-wide tick; (2) the one-step-up probe has a
+    per-bucket budget — probe ticks are cold and cold ticks never
+    feed the EWMAs, so an unmeasured bucket whose shapes never recur
+    would otherwise re-probe (and recompile) every
+    _CHUNK_PROBE_EVERY admissions forever."""
+    from paddle_tpu.serving.engine import (_CHUNK_PROBE_EVERY,
+                                           _CHUNK_PROBE_TRIES)
+    cfg, m = tiny_llama()
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=512, chunk_tokens=16,
+                                chunk_autotune=True, slo_tpot_s=10.0)
+    # warm EWMAs so generous every bucket "fits": without the clamp
+    # the pick would run to max_seq_len
+    eng._ewma_prefill_tok.value = 1e-6
+    eng._ewma_step.value = 0.0
+    assert eng._autotune_chunk(96) == 128    # first cover of 96
+    assert eng._autotune_chunk(512) == 512
+    assert eng._autotune_chunk(16) == 16     # base already covers
+    # the clamp works BELOW the anchor too: a 16-token admission on a
+    # 64-anchored tuner must not pad out to a 64-wide tick
+    eng64 = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                  max_seq_len=512, chunk_tokens=64,
+                                  chunk_autotune=True, slo_tpot_s=10.0)
+    eng64._ewma_prefill_tok.value = 1e-6
+    eng64._ewma_step.value = 0.0
+    assert eng64._autotune_chunk(16) == 16
+    # ...and the clamp must NOT leak into the persistent pricing pick
+    # estimated_ttft_s charges other queued prompts (a 16-token
+    # admission would over-price a long deadline submit severalfold)
+    assert eng64._chunk_choice == 512
+    eng64.close()
+    # probe budget: s_pad far above the SLO-fitting pick would probe
+    # the next bucket up; after _CHUNK_PROBE_TRIES fired probes with
+    # no EWMA recorded (shapes never repeated), probing stops
+    eng._ewma_prefill_tok.value = 1.0        # nothing fits: pick =
+    fired = 0                                # smallest, probe upward
+    for _ in range(_CHUNK_PROBE_EVERY * (_CHUNK_PROBE_TRIES + 2)):
+        if eng._autotune_chunk(512) != 16:
+            fired += 1
+    assert fired == _CHUNK_PROBE_TRIES
+    eng.close()
+    # (3) probe-ineligible admissions FREEZE the wait counter rather
+    # than reset it: under an interleaved long/short length mix the
+    # short prompts' clamped ladder (nxt=None) used to zero the
+    # counter every other admission and the probe never fired at all
+    eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                max_seq_len=512, chunk_tokens=16,
+                                chunk_autotune=True, slo_tpot_s=10.0)
+    eng._ewma_prefill_tok.value = 1.0
+    eng._ewma_step.value = 0.0
+    fired = 0
+    for _ in range(_CHUNK_PROBE_EVERY):
+        assert eng._autotune_chunk(16) == 16     # ineligible: frozen
+        if eng._autotune_chunk(512) != 16:       # eligible: advances
+            fired += 1
+    assert fired == 1
+    eng.close()
+
+
 # ----------------------------------------- preemption through the chunks
 
 def test_preempt_resume_through_chunks():
@@ -360,6 +551,36 @@ def test_short_last_chunk_does_not_inflate_token_ewma():
     # a full chunk's worth of per-token cost stays commensurate with
     # the chunk EWMA (t/1 sampling would blow this up ~32x)
     assert tok * eng.chunk_tokens <= chunk * 4
+    eng.close()
+
+
+def test_first_plain_step_compile_not_fed_to_step_ewma():
+    """A chunked engine's FIRST dispatch is a fused chunk tick, which
+    flips the generic first-dispatch warm flag long before the
+    chunkless step program ever compiles — the capacity estimator must
+    still skip THAT program's own first (trace+compile) dispatch, or
+    ``shed_infeasible`` prices decode steps off a compile spike and
+    sheds feasible deadlines right after startup (regression: the
+    fused tick flipped ``_step_fn_warm`` and the step-fn compile was
+    EWMA'd)."""
+    cfg, m = tiny_llama()
+    rng = np.random.RandomState(3)
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, temperature=0.0,
+                                chunk_tokens=32)
+    eng.submit(serving.Request(rng.randint(3, 512, (70,)),
+                               max_new_tokens=4, seed=5))
+    eng.step()                      # admit + fused chunk 0 dispatches
+    assert eng._step_fn_warm and not eng._ewma_step_warm
+    while any(s is not None and s.prefilling for s in eng._slots):
+        eng.step()                  # mid/last fused chunk ticks
+    assert eng._ewma_step.value is None      # chunk ticks never feed
+    eng.step()                      # first chunkless dispatch: the
+    assert eng._ewma_step_warm               # step-fn compile, skipped
+    assert eng._ewma_step.value is None
+    eng.step()                      # second plain dispatch: fed
+    assert eng._ewma_step.value is not None
+    eng.drain(max_steps=50)
     eng.close()
 
 
