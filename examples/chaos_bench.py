@@ -48,7 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from load_bench import calibrate, gen_arrivals, make_requests
-from serving_bench import build_model, build_speculate
+from serving_bench import (add_mesh_args, build_engine_mesh, build_model,
+                           build_speculate, mesh_fields)
 
 
 def engine_kwargs(ns, flight_dump, speculate=None):
@@ -59,6 +60,7 @@ def engine_kwargs(ns, flight_dump, speculate=None):
         flight_dump_path=flight_dump,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=speculate,
+        mesh=build_engine_mesh(ns),
         max_queue=ns.max_queue, shed_infeasible=True)
     if getattr(ns, "chunk_autotune", False):
         # crash/restore through AUTOTUNED fused chunk ticks: the chunk
@@ -117,8 +119,14 @@ def drive_chaos(model, eng, ns, reqs, arrivals, snap_root,
             eng.close()
             # the draft proposer's model doesn't serialize — hand the
             # SAME SpecConfig back as a restore override (a no-op for
-            # ngram/None, which restore rebuilds from the snapshot)
+            # ngram/None, which restore rebuilds from the snapshot);
+            # snapshots are likewise mesh-free, so a sharded soak hands
+            # its mesh/layout back or the restored engine would come
+            # back single-device
             ovr = {"speculate": speculate} if speculate is not None else {}
+            if getattr(eng, "mesh", None) is not None:
+                ovr["mesh"] = eng.mesh
+                ovr["layout"] = eng.layout
             eng = type(eng).restore(model, snap_root, **ovr)
             restores += 1
         tick += 1
@@ -271,6 +279,7 @@ def main():
                     "against isolated generate (greedy only)")
     ap.add_argument("--snapshot_dir", default=None)
     ap.add_argument("--flight_dump", default=None)
+    add_mesh_args(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -439,6 +448,7 @@ def main():
             "serving.snapshot_roundtrips"),
         lost_requests=len(lost), finishes=finishes,
         flight_markers=markers, parity_checked=parity_checked,
+        **mesh_fields(ns, build_engine_mesh(ns)),
         wall_s=round(wall, 3))
     print(json.dumps(rec))
     eng.close()

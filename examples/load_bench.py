@@ -75,7 +75,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serving_bench import (build_model, build_speculate, spec_fields,
+from serving_bench import (add_mesh_args, build_engine_mesh, build_model,
+                           build_speculate, mesh_fields, spec_fields,
                            spec_hist_base)
 
 
@@ -327,6 +328,7 @@ def main():
                     "whose predicted fused-tick time fits under "
                     "--slo_tpot_s (requires --chunk_tokens as the cold "
                     "default)")
+    add_mesh_args(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -354,6 +356,7 @@ def main():
         chunk_tokens=ns.chunk_tokens,
         decode_per_chunk=ns.decode_per_chunk,
         speculate=build_speculate(ns),
+        mesh=build_engine_mesh(ns),
         sanitize=ns.sanitize)
     if ns.chunk_autotune:
         ekw.update(chunk_autotune=True, slo_tpot_s=ns.slo_tpot_s)
@@ -425,7 +428,8 @@ def main():
             dispatches_per_token=round(
                 st["decode_slot_dispatches"]
                 / max(st["decode_tokens"], 1), 4),
-            **spec_fields(eng, ns, hist_base), **rep.bench_fields())
+            **spec_fields(eng, ns, hist_base),
+            **mesh_fields(ns, ekw["mesh"]), **rep.bench_fields())
         print(json.dumps(rec))
         curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),
                           tokens_per_s=round(tok_s, 1),

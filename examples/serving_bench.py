@@ -170,6 +170,47 @@ def build_speculate(ns):
     return serving.SpecConfig(k=k, proposer=proposer, draft_model=draft)
 
 
+def add_mesh_args(ap):
+    """--mp/--fsdp flags shared by serving_bench/load_bench/chaos_bench:
+    shard EACH engine replica over a {fsdp, mp} submesh
+    (serving.ServingLayout; docs/SERVING.md §Tensor-parallel
+    replicas)."""
+    ap.add_argument("--mp", type=int, default=1,
+                    help="tensor-parallel shards per replica: attention "
+                    "heads + ffn columns + the paged KV pool split "
+                    "over the mp mesh axis (1 = unsharded; tokens are "
+                    "bit-identical at every degree)")
+    ap.add_argument("--fsdp", type=int, default=1,
+                    help="layer-dim weight shards per replica (gathered "
+                    "at use; must divide num_layers)")
+
+
+def build_engine_mesh(ns):
+    """Mesh from --mp/--fsdp (None when both are 1 — the engine then
+    takes the exact unsharded program path)."""
+    mp = getattr(ns, "mp", 1) or 1
+    fsdp = getattr(ns, "fsdp", 1) or 1
+    if mp <= 1 and fsdp <= 1:
+        return None
+    from paddle_tpu.parallel import topology
+    dims = {}
+    if fsdp > 1:
+        dims["fsdp"] = fsdp
+    if mp > 1:
+        dims["mp"] = mp
+    return topology.build_mesh(dims)
+
+
+def mesh_fields(ns, mesh):
+    """Typed-optional tensor-parallel BENCH fields (schema.py)."""
+    if mesh is None:
+        return {}
+    return dict(mp_degree=getattr(ns, "mp", 1) or 1,
+                fsdp_degree=getattr(ns, "fsdp", 1) or 1,
+                mesh_shape={str(k): int(v)
+                            for k, v in mesh.shape.items()})
+
+
 def spec_hist_base(ns):
     """Snapshot of the serving.spec_accepted_len bucket counts, taken
     BEFORE a measured pass so ``spec_fields(hist_base=...)`` can report
@@ -218,6 +259,7 @@ def run_continuous(model, reqs, ns):
         cache_dtype=jnp.int8 if ns.cache_int8 else jnp.bfloat16,
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=build_speculate(ns),
+        mesh=build_engine_mesh(ns),
         sanitize=getattr(ns, "sanitize", False))
     if getattr(ns, "chunk_autotune", False):
         ekw.update(chunk_autotune=True,
@@ -316,6 +358,7 @@ def main():
                     help="drive the continuous arm through the "
                     "replicated tier (serving.Router over N engine "
                     "replicas) instead of one engine")
+    add_mesh_args(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -422,6 +465,7 @@ def main():
         pool_blocks=(eng.pool_blocks_total if ns.replicas > 1
                      else eng.pool.num_blocks - 1),
         block_tokens=ns.block_tokens, **spec_fields(eng, ns),
+        **mesh_fields(ns, build_engine_mesh(ns)),
         **slo.bench_fields(), **common)))
     eng.close()         # free the KV pool (back-to-back bench runs)
 

@@ -350,30 +350,56 @@ def donation_report(fn, *args, static_argnums=(), what="program",
             t = param_types[idx]
             aliased_types[t] = aliased_types.get(t, 0) + 1
 
-    def _leaf_type(leaf) -> Optional[str]:
+    # a SHARDED module's entry layout lists per-shard parameter shapes,
+    # so each leaf's matching type is its LOCAL shape under the actual
+    # argument's sharding (shard_shape) — matching global avals instead
+    # would make every sharded donated buffer look copied. The real
+    # argument leaves align with args_info's dynamic trees; unsharded
+    # arrays degrade to the global shape (SingleDeviceSharding's
+    # shard_shape is the identity).
+    all_pos = list(bound) + list(args)
+    value_leaves = []
+    for i in dyn_argnums:
+        value_leaves.extend(jax.tree_util.tree_leaves(all_pos[i]))
+
+    def _leaf_type(leaf, flat_i: int) -> Optional[str]:
         aval = getattr(leaf, "_aval", None) or getattr(leaf, "aval",
                                                        None)
-        return None if aval is None else _aval_type(aval)
+        if aval is None:
+            return None
+        shape = tuple(aval.shape)
+        if flat_i < len(value_leaves):
+            sh = getattr(value_leaves[flat_i], "sharding", None)
+            if sh is not None:
+                try:
+                    shape = tuple(sh.shard_shape(shape))
+                except Exception:   # noqa: BLE001 — keep global shape
+                    pass
+        dt = _HLO_DTYPES.get(str(aval.dtype), str(aval.dtype))
+        return f"{dt}[{','.join(str(d) for d in shape)}]"
 
     donated_demand: Dict[str, int] = {}
+    flat_i = 0
     for tree in info_args:
         for leaf in jax.tree_util.tree_leaves(tree):
             if getattr(leaf, "donated", False):
-                t = _leaf_type(leaf)
+                t = _leaf_type(leaf, flat_i)
                 if t is not None:
                     donated_demand[t] = donated_demand.get(t, 0) + 1
+            flat_i += 1
 
+    flat_i = 0
     for argnum, tree in zip(dyn_argnums, info_args):
         leaves = jax.tree_util.tree_leaves(tree)
         donated = aliased = 0
         for leaf in leaves:
-            if not getattr(leaf, "donated", False):
-                continue
-            donated += 1
-            t = _leaf_type(leaf)
-            if t is not None and aliased_types.get(t, 0) \
-                    >= donated_demand.get(t, 0):
-                aliased += 1
+            if getattr(leaf, "donated", False):
+                donated += 1
+                t = _leaf_type(leaf, flat_i)
+                if t is not None and aliased_types.get(t, 0) \
+                        >= donated_demand.get(t, 0):
+                    aliased += 1
+            flat_i += 1
         report.args[argnum] = {"leaves": len(leaves),
                                "donated": donated, "aliased": aliased}
     return report
@@ -467,6 +493,12 @@ def snapshot_roundtrip(engine, snap: Optional[Dict] = None):
     overrides = dict(sanitize=False, flight_dump_path=None)
     if getattr(engine, "speculate", None) is not None:
         overrides["speculate"] = engine.speculate
+    # snapshots are mesh-free: the twin must be re-handed the live
+    # engine's mesh/layout or it would restore single-device and the
+    # roundtrip would "pass" without exercising the sharded paths
+    if getattr(engine, "mesh", None) is not None:
+        overrides["mesh"] = engine.mesh
+        overrides["layout"] = engine.layout
     eng2 = type(engine).restore(engine.model, snap1,
                                 state=engine._state, **overrides)
     try:

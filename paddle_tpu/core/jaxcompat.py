@@ -56,13 +56,20 @@ def _install_shard_map():
         # rejects. Run FULLY manual instead: specs already name every
         # axis the body's collectives use, and unnamed axes degrade to
         # manual replication — correct, merely forgoing auto-axis
-        # parallelism on old-jax installs. check_rep=True engages 0.4.x's
-        # replication-tracking rewrite, which grad-through-shard_map
-        # needs (with check_rep=False, device-varying SCALAR residuals of
-        # the backward have no concatenable out_spec and trace fails).
+        # parallelism on old-jax installs. check_rep defaults to True —
+        # 0.4.x's replication-tracking rewrite, which grad-through-
+        # shard_map needs (with check_rep=False, device-varying SCALAR
+        # residuals of the backward have no concatenable out_spec and
+        # trace fails). Callers that return all_gather results under a
+        # replicated out_spec (the serving engine's tensor-parallel
+        # programs — no grad involved) pass an EXPLICIT check_rep=False
+        # (0.9 spelling: check_vma=False): 0.4.x's checker cannot infer
+        # that an all_gather output is replicated and rejects the spec.
         del axis_names
+        if check_rep is None:
+            check_rep = check_vma if check_vma is not None else True
         return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                    check_rep=True)
+                    check_rep=bool(check_rep))
 
     jax.shard_map = shard_map
 

@@ -92,6 +92,14 @@ _BENCH_OPTIONAL = {
     "replicas": numbers.Integral,
     "replica_kills": numbers.Integral,
     "failovers": numbers.Integral,
+    # tensor-parallel replica fields (serving_bench/load_bench/
+    # chaos_bench --mp/--fsdp): mp_degree = model-parallel shards per
+    # replica (null/1 = unsharded), fsdp_degree = layer-dim weight
+    # shards, mesh_shape = {axis: size} of the replica submesh actually
+    # built (e.g. {"mp": 2} or {"fsdp": 2, "mp": 4})
+    "mp_degree": numbers.Integral,
+    "fsdp_degree": numbers.Integral,
+    "mesh_shape": dict,
 }
 
 

@@ -38,6 +38,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NEG_INF = -1e30
@@ -1640,10 +1641,109 @@ def paged_pool_shape(num_layers: int, num_blocks: int, block_tokens: int,
             2 * num_kv_heads * head_dim)
 
 
+# ---------------------------------------------------------------------------
+# Tensor-parallel (mp) shard layouts for the paged serving path
+# ---------------------------------------------------------------------------
+#
+# The serving engine shards ONE replica over the `mp` mesh axis by
+# splitting attention heads (KV groups) and ffn columns across shards —
+# column-parallel qkv/gate/up, with the o-proj and down-proj matmuls
+# kept FULL on every shard behind one `all_gather` each. That flavor
+# (gather the (b, cols) activation instead of psum-ing the (b, h)
+# partial outputs) is what makes the sharded engine BIT-IDENTICAL to
+# the single-chip engine: an all_gather is pure data movement, so the
+# wo/wd matmuls see exactly the mp=1 operand and reduce in exactly the
+# mp=1 order, while a psum would re-associate the h-dim reduction.
+#
+# Shard-major column permutations: the fused canonical layouts
+# interleave regions ([q|k|v] for wqkv, [k|v] for the pool's last dim),
+# so a plain contiguous split of the canonical columns would hand each
+# shard a slice CROSSING region boundaries. The device twins are
+# permuted SHARD-MAJOR instead — shard s's slice is itself a valid
+# canonical layout at the local head counts — while host mirrors stay
+# canonical (snapshots and parity pins never see the permutation).
+# Because the reference q-head order is group-major (q.reshape(b, nkv,
+# rep, hd)), sharding KV groups contiguously gives each shard a
+# contiguous q-head range, so the tiled all_gather below reproduces the
+# exact reference (b, dq) column order.
+
+def mp_qkv_permutation(num_heads: int, num_kv_heads: int, head_dim: int,
+                       mp: int):
+    """Column permutation (len (nh+2*nkv)*hd, numpy int32) taking the
+    canonical fused ``[q|k|v]`` wqkv/bqkv column layout to shard-major:
+    ``w[:, perm]`` puts shard s's columns at ``[s*csz, (s+1)*csz)`` as
+    ``[q_s|k_s|v_s]`` — exactly the canonical fused layout at the local
+    head counts ``nh/mp``/``nkv/mp``. Requires mp | num_kv_heads (and
+    mp | num_heads via the GQA rep structure)."""
+    nh, nkv, hd = int(num_heads), int(num_kv_heads), int(head_dim)
+    if nkv % mp or nh % mp:
+        raise ValueError(
+            f"mp={mp} must divide num_heads={nh} and num_kv_heads={nkv}")
+    dq, dkv = nh * hd, nkv * hd
+    q = np.arange(dq, dtype=np.int32).reshape(mp, dq // mp)
+    k = dq + np.arange(dkv, dtype=np.int32).reshape(mp, dkv // mp)
+    v = dq + dkv + np.arange(dkv, dtype=np.int32).reshape(mp, dkv // mp)
+    return np.concatenate([np.concatenate([q[s], k[s], v[s]])
+                           for s in range(mp)]).astype(np.int32)
+
+
+def mp_kv_permutation(num_kv_heads: int, head_dim: int, mp: int):
+    """Column permutation (len 2*nkv*hd) taking the pool/scale
+    canonical ``[k|v]`` last-dim layout to shard-major
+    ``[k_0|v_0|k_1|v_1|...]`` so a plain contiguous mp-split hands
+    shard s the canonical ``[k_s|v_s]`` local layout."""
+    nkv, hd = int(num_kv_heads), int(head_dim)
+    if nkv % mp:
+        raise ValueError(f"mp={mp} must divide num_kv_heads={nkv}")
+    dkv = nkv * hd
+    k = np.arange(dkv, dtype=np.int32).reshape(mp, dkv // mp)
+    v = dkv + np.arange(dkv, dtype=np.int32).reshape(mp, dkv // mp)
+    return np.concatenate([np.concatenate([k[s], v[s]])
+                           for s in range(mp)]).astype(np.int32)
+
+
+def mp_gather_kv_lastdim(x, mp_axis: str):
+    """Inside a shard_map body: all-gather a LOCAL canonical ``[k|v]``
+    last dim (2*nkv_loc*hd) back to the FULL canonical ``[k|v]`` layout
+    (2*nkv*hd). Pure layout movement — bitwise, no arithmetic."""
+    g = jax.lax.all_gather(x, mp_axis, axis=x.ndim - 1, tiled=True)
+    m = jax.lax.axis_size(mp_axis)
+    loc = g.shape[-1] // (2 * m)
+    # tiled gather is shard-major [k0|v0|k1|v1|...]; swap to [k|v]
+    parts = g.reshape(g.shape[:-1] + (m, 2, loc))
+    return jnp.swapaxes(parts, -3, -2).reshape(g.shape)
+
+
+def mp_local_kv_lastdim(x, mp_axis: str):
+    """Inside a shard_map body: slice this shard's canonical
+    ``[k_s|v_s]`` columns out of a FULL canonical ``[k|v]`` last dim —
+    the inverse of :func:`mp_gather_kv_lastdim` (replicated-compute
+    producers like the chunk forward hand the pool scatter its local
+    columns through this)."""
+    r = jax.lax.axis_index(mp_axis)
+    m = jax.lax.axis_size(mp_axis)
+    dkv = x.shape[-1] // 2
+    loc = dkv // m
+    ax = x.ndim - 1
+    k = jax.lax.dynamic_slice_in_dim(x, r * loc, loc, axis=ax)
+    v = jax.lax.dynamic_slice_in_dim(x, dkv + r * loc, loc, axis=ax)
+    return jnp.concatenate([k, v], axis=-1)
+
+
+def _mp_gather_cols(act, mp_axis: str):
+    """all-gather a column-parallel (b, cols_loc) activation to the full
+    (b, cols) operand — shard-contiguous column order, which IS the
+    reference order for both the attention output (contiguous q-head
+    ranges per shard) and the ffn activation (contiguous column split).
+    """
+    return jax.lax.all_gather(act, mp_axis, axis=1, tiled=True)
+
+
 def fused_paged_decode_reference(x, params, kv_pool, block_tables, positions,
                                  cos, sin, *, num_heads: int,
                                  num_kv_heads: int, eps: float = 1e-5,
-                                 arch: str = "llama", kv_scales=None):
+                                 arch: str = "llama", kv_scales=None,
+                                 mp_axis: Optional[str] = None):
     """One decode step against a paged KV pool; pure jnp twin.
 
     x (b, h); kv_pool (L, NB, BT, 2*nkv*hd); block_tables (b, MB) int32;
@@ -1661,6 +1761,16 @@ def fused_paged_decode_reference(x, params, kv_pool, block_tables, positions,
     — the continuous-batching parity contract (tests/test_serving.py).
     Slots whose block-table tail is unallocated must point spare entries
     at a valid (scratch) block: the copies are masked, not skipped.
+
+    Tensor-parallel mode (``mp_axis`` set, inside a full-manual
+    shard_map body): the caller passes the LOCAL head counts, the local
+    shard-major wqkv/wg/wu (+ scale/bias) columns and the local pool /
+    kv_scales last dim; the per-head attention math above runs
+    unchanged over the local heads, and the two column-parallel
+    activations (attention output, ffn activation) are all-gathered
+    back to full width before the FULL wo/wd matmuls — one collective
+    per site, bitwise identical to the mp=1 step (no psum
+    re-association). x stays replicated (b, full h) throughout.
     """
     L, NB, BT, dkv2 = kv_pool.shape
     b, MB = block_tables.shape
@@ -1744,6 +1854,8 @@ def fused_paged_decode_reference(x, params, kv_pool, block_tables, positions,
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
         attn = attn.reshape(b, dq).astype(dtype)
+        if mp_axis is not None:
+            attn = _mp_gather_cols(attn, mp_axis)
         o = wdot(attn, "wo", l)
         if gpt:
             o = o + params["bo"][l]
@@ -1752,12 +1864,16 @@ def fused_paged_decode_reference(x, params, kv_pool, block_tables, positions,
             xn2 = _layernorm(xf, params["ln2"][l], params["ln2_b"][l], eps)
             g = wdot(xn2, "wg", l) + params["bg"][l]
             act = jax.nn.gelu(g, approximate=True).astype(dtype)
+            if mp_axis is not None:
+                act = _mp_gather_cols(act, mp_axis)
             xf = xf + wdot(act, "wd", l) + params["bd"][l]
         else:
             xn2 = _rms(xf, params["ln2"][l], eps)
             g = wdot(xn2, "wg", l)
             u = wdot(xn2, "wu", l)
             act = (jax.nn.silu(g) * u).astype(dtype)
+            if mp_axis is not None:
+                act = _mp_gather_cols(act, mp_axis)
             xf = xf + wdot(act, "wd", l)
     # ONE combined append for all layers (indices collide for no two
     # rows: append blocks are never shared)
@@ -2185,7 +2301,8 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
                             cos, sin, *, num_heads: int, num_kv_heads: int,
                             eps: float = 1e-5, rope_base: float = 10000.0,
                             arch: str = "llama",
-                            blocks: Optional[Dict] = None, kv_scales=None):
+                            blocks: Optional[Dict] = None, kv_scales=None,
+                            mp_axis: Optional[str] = None):
     """Dispatch one PAGED decode step: Pallas kernel on TPU (or under
     FLAGS_pallas_interpret), jnp paged reference elsewhere.
 
@@ -2196,6 +2313,11 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
     `blocks` is a `decode_block_plan` dict; the paged kernel rejects
     q-split plans and consistency-checks `cache_wbytes` against the pool
     dtype. `kv_scales` (L, b, 2*nkv*hd) enables the per-slot int8 pool.
+    ``mp_axis`` (inside a shard_map body, local heads/pool columns)
+    routes the jnp reference unconditionally — the per-shard problem is
+    1/mp of the single-chip one and the collective sits OUTSIDE the
+    per-head math, so the XLA path shards cleanly today; teaching the
+    Pallas kernel a local-shard mode is a later PR.
     """
     from paddle_tpu.core.flags import flag
     from paddle_tpu.ops import use_pallas
@@ -2206,7 +2328,8 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
     BT = kv_pool.shape[2]
     # tpu-lint: allow(host-sync): flag() is a host-side config read
     interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
-    if (use_pallas() or interp) and dkv % 128 == 0 and BT % 8 == 0:
+    if mp_axis is None and (use_pallas() or interp) and dkv % 128 == 0 \
+            and BT % 8 == 0:
         cb = jnp.dtype(kv_pool.dtype).itemsize
         if blocks is not None and blocks.get("cache_wbytes", cb) != cb:
             raise ValueError(
@@ -2237,7 +2360,7 @@ def fused_paged_decode_step(x, params, kv_pool, block_tables, positions,
         return fused_paged_decode_reference(
             x, params, kv_pool, block_tables, positions, cos, sin,
             num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
-            arch=arch, kv_scales=kv_scales)
+            arch=arch, kv_scales=kv_scales, mp_axis=mp_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -2283,7 +2406,8 @@ def fused_paged_tick_step(x, params, kv_pool, block_tables, positions,
                           eps: float = 1e-5, rope_base: float = 10000.0,
                           arch: str = "llama",
                           blocks: Optional[Dict] = None, kv_scales=None,
-                          chunk_bids=None, chunk_kv=None):
+                          chunk_bids=None, chunk_kv=None,
+                          mp_axis: Optional[str] = None):
     """One fused Sarathi tick: coschedule a prefill-chunk append with
     the fused paged decode step — ONE program, the pool threaded
     through both updates (donate it at the jit boundary; the serving
@@ -2295,15 +2419,23 @@ def fused_paged_tick_step(x, params, kv_pool, block_tables, positions,
     The chunk rows' blocks and the decode rows' append blocks are
     disjoint by construction (prefilling slots idle against scratch
     until adoption), so the scatter/decode order is value-irrelevant;
-    scatter-first matches the two-program tick it replaces."""
+    scatter-first matches the two-program tick it replaces.
+
+    Under ``mp_axis`` the chunk forward runs REPLICATED (the full-model
+    prefill math), so ``chunk_kv`` arrives in the FULL canonical [k|v]
+    layout; each shard slices its own canonical columns out before the
+    scatter into its local pool shard."""
     if chunk_bids is not None:
+        if mp_axis is not None \
+                and chunk_kv.shape[-1] != kv_pool.shape[-1]:
+            chunk_kv = mp_local_kv_lastdim(chunk_kv, mp_axis)
         with jax.named_scope("fused_decode.chunk_scatter"):
             kv_pool = paged_chunk_scatter(kv_pool, chunk_bids, chunk_kv)
     return fused_paged_decode_step(
         x, params, kv_pool, block_tables, positions, cos, sin,
         num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
         rope_base=rope_base, arch=arch, blocks=blocks,
-        kv_scales=kv_scales)
+        kv_scales=kv_scales, mp_axis=mp_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -2329,7 +2461,8 @@ def fused_paged_tick_step(x, params, kv_pool, block_tables, positions,
 def fused_paged_verify_reference(x, params, kv_pool, block_tables,
                                  positions, cos, sin, *, num_heads: int,
                                  num_kv_heads: int, eps: float = 1e-5,
-                                 arch: str = "llama", kv_scales=None):
+                                 arch: str = "llama", kv_scales=None,
+                                 mp_axis: Optional[str] = None):
     """Score a K1-token tail per slot against the paged pool; pure jnp.
 
     x (b, K1, h): the embedded tail tokens — x[:, j] is token j embedded
@@ -2352,6 +2485,10 @@ def fused_paged_verify_reference(x, params, kv_pool, block_tables,
     over-speculation tail of a slot near its cap) are redirected to the
     scratch block (block 0) — garbage by contract, never attended (a
     query's mask never reaches past its own position).
+
+    ``mp_axis`` arms the same tensor-parallel contract as
+    `fused_paged_decode_reference`: local heads/pool columns in, one
+    all_gather per column-parallel activation, bitwise mp=1 logits out.
     """
     L, NB, BT, dkv2 = kv_pool.shape
     b, MB = block_tables.shape
@@ -2437,6 +2574,8 @@ def fused_paged_verify_reference(x, params, kv_pool, block_tables,
             probs = jax.nn.softmax(scores, axis=-1)
             attn = jnp.einsum("bgrs,bsgd->bgrd", probs, vl)
             attn = attn.reshape(b, dq).astype(dtype)
+            if mp_axis is not None:
+                attn = _mp_gather_cols(attn, mp_axis)
             o = wdot(attn, "wo", l)
             if gpt:
                 o = o + params["bo"][l]
@@ -2446,12 +2585,16 @@ def fused_paged_verify_reference(x, params, kv_pool, block_tables,
                                  eps)
                 g = wdot(xn2, "wg", l) + params["bg"][l]
                 act = jax.nn.gelu(g, approximate=True).astype(dtype)
+                if mp_axis is not None:
+                    act = _mp_gather_cols(act, mp_axis)
                 xf = xf + wdot(act, "wd", l) + params["bd"][l]
             else:
                 xn2 = _rms(xf, params["ln2"][l], eps)
                 g = wdot(xn2, "wg", l)
                 u = wdot(xn2, "wu", l)
                 act = (jax.nn.silu(g) * u).astype(dtype)
+                if mp_axis is not None:
+                    act = _mp_gather_cols(act, mp_axis)
                 xf = xf + wdot(act, "wd", l)
         outs.append(xf.astype(dtype))
         app_news.append(jnp.stack(kv_news))         # (L, b, dkv2)
@@ -2918,7 +3061,8 @@ def fused_paged_verify_step(x, params, kv_pool, block_tables, positions,
                             cos, sin, *, num_heads: int, num_kv_heads: int,
                             eps: float = 1e-5, rope_base: float = 10000.0,
                             arch: str = "llama",
-                            blocks: Optional[Dict] = None, kv_scales=None):
+                            blocks: Optional[Dict] = None, kv_scales=None,
+                            mp_axis: Optional[str] = None):
     """Dispatch one PAGED verify step (speculative decoding's scoring
     pass): Pallas kernel on TPU (or under FLAGS_pallas_interpret), jnp
     verify reference elsewhere.
@@ -2942,7 +3086,8 @@ def fused_paged_verify_step(x, params, kv_pool, block_tables, positions,
     BT = kv_pool.shape[2]
     # tpu-lint: allow(host-sync): flag() is a host-side config read
     interp = bool(flag("FLAGS_pallas_interpret")) and not use_pallas()
-    if (use_pallas() or interp) and dkv % 128 == 0 and BT % 8 == 0:
+    if mp_axis is None and (use_pallas() or interp) and dkv % 128 == 0 \
+            and BT % 8 == 0:
         cb = jnp.dtype(kv_pool.dtype).itemsize
         if blocks is not None and blocks.get("cache_wbytes", cb) != cb:
             raise ValueError(
@@ -2978,4 +3123,4 @@ def fused_paged_verify_step(x, params, kv_pool, block_tables, positions,
         return fused_paged_verify_reference(
             x, params, kv_pool, block_tables, positions, cos, sin,
             num_heads=num_heads, num_kv_heads=num_kv_heads, eps=eps,
-            arch=arch, kv_scales=kv_scales)
+            arch=arch, kv_scales=kv_scales, mp_axis=mp_axis)

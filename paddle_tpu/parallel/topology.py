@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "fsdp", "mp")
 
 #: The sanctioned mesh-axis names, mapped to the degree the multichip
 #: dryrun validates (MULTICHIP_r0x leg(16): {dp: 2, pp: 2, sharding: 2,
@@ -42,6 +42,11 @@ KNOWN_AXES = {
     "pp": 2,
     "sharding": 2,
     "sep": None,
+    # fsdp: the serving engine's weight-sharding axis (ServingLayout
+    # splits stacked per-layer weights on L over it; mp stays the
+    # head/ffn axis). No pinned degree — the serving parity matrix runs
+    # it at 1 on CPU and deployments pick L-divisible degrees.
+    "fsdp": None,
     "mp": 2,
     "ep": None,
     "g": None,

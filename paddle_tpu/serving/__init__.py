@@ -18,6 +18,7 @@ in the PAPERS lineage).
 from paddle_tpu.serving.engine import (  # noqa: F401
     ENGINE_SNAPSHOT_SCHEMA, PRIORITIES, Rejected, Request, RequestResult,
     RestoreError, ServingEngine)
+from paddle_tpu.serving.layout import ServingLayout  # noqa: F401
 from paddle_tpu.serving.pool import (  # noqa: F401
     SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
 from paddle_tpu.serving.router import (  # noqa: F401
@@ -26,7 +27,8 @@ from paddle_tpu.serving.spec import (  # noqa: F401
     PROPOSERS, SpecConfig)
 
 __all__ = [
-    "Request", "RequestResult", "ServingEngine", "SpecConfig",
+    "Request", "RequestResult", "ServingEngine", "ServingLayout",
+    "SpecConfig",
     "PROPOSERS", "BlockPool", "PoolExhausted", "PrefixCache",
     "PrefixEntry", "SCRATCH_BLOCK", "Rejected", "RestoreError",
     "PRIORITIES", "ENGINE_SNAPSHOT_SCHEMA", "Router", "RouterJournal",

@@ -114,6 +114,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.ops.fused_decode import (mp_gather_kv_lastdim,
+                                         mp_local_kv_lastdim)
 from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool, PoolExhausted,
                                      PrefixCache)
 from paddle_tpu.serving.spec import SpecConfig
@@ -616,6 +618,17 @@ class ServingEngine:
     ``analysis.runtime.sanitize()`` — zero H2D transfers, zero
     recompiles, or it RAISES at the offending step.
     ``stats["sanitized_steps"]`` counts the guarded dispatches.
+
+    ``mesh=``/``layout=`` (docs/SERVING.md §Tensor-parallel replicas)
+    shard THIS replica over the ``{mp, fsdp}`` mesh axes: attention
+    heads and FFN lanes column-parallel over ``mp`` with the paged KV
+    pool split on the head dim (``serving.layout.ServingLayout``),
+    stacked weights layer-sharded over ``fsdp`` and gathered at use.
+    Every program runs under full-manual ``jax.shard_map`` through one
+    seam (:meth:`_wrap_program`); sampling and scheduling stay
+    replicated, so tokens are BIT-IDENTICAL to the mp=1 engine and
+    snapshots stay mesh-free. ``mesh=None`` (default) is exactly the
+    single-chip engine.
     """
 
     def __init__(self, model, *, max_slots: int = 4,
@@ -636,6 +649,7 @@ class ServingEngine:
                  slo_tpot_s: Optional[float] = None,
                  speculate: Optional[SpecConfig] = None,
                  sanitize: bool = False,
+                 mesh=None, layout=None,
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -677,6 +691,38 @@ class ServingEngine:
         L = self._num_layers = self._count_layers()
         nkv, hd = meta["num_kv_heads"], meta["head_dim"]
         self._dkv = nkv * hd
+
+        # ---- tensor-parallel replica (docs/SERVING.md §Tensor-parallel
+        # replicas): mesh + ServingLayout shard THIS replica over
+        # {mp, fsdp}. mesh None (or size 1) is the exact pre-mp path:
+        # every program compiles byte-identical to the single-chip
+        # engine (tests/test_serving_mp.py pins the program set).
+        if layout is not None and mesh is None:
+            mesh = layout.mesh
+        if mesh is not None and getattr(mesh, "size", 1) == 1:
+            mesh = None
+            layout = None
+        if mesh is not None:
+            from paddle_tpu.serving.layout import ServingLayout
+            if layout is None:
+                layout = ServingLayout(mesh)
+            elif layout.mesh is not mesh:
+                raise ValueError(
+                    "layout was built for a different mesh; pass "
+                    "matching mesh/layout (or just the layout)")
+            layout.validate(num_heads=meta["num_heads"],
+                            num_kv_heads=nkv, num_layers=L)
+        self.mesh = mesh
+        self.layout = layout
+        self._mp = layout.mp if layout is not None else 1
+        self._mp_axis = layout.mp_axis if layout is not None else None
+        self._fsdp_axis = (layout.fsdp_axis if layout is not None
+                           else None)
+        if layout is not None:
+            # commit the full state replicated so every program input
+            # already lives on the mesh (no implicit transfer at
+            # dispatch — the 0-H2D steady-tick pin holds under mp too)
+            self._state = layout.place_replicated(self._state)
         bpb = self.block_bytes = (
             L * block_tokens * 2 * self._dkv
             * (1 if self.kv_int8 else 2))
@@ -692,6 +738,11 @@ class ServingEngine:
         # — restore re-prefills prompts and replays generated tokens)
         self.kv_pool = jnp.zeros(
             (L, num_blocks, block_tokens, 2 * self._dkv), self.cache_dtype)
+        if layout is not None:
+            # head-dim sharded: each shard's block-table walk reads only
+            # its own heads' [k_s|v_s] lanes (zeros are permutation-
+            # symmetric, so placing the canonical zeros is exact)
+            self.kv_pool = layout.place(self.kv_pool, layout.pool_spec())
         # tpu-lint: volatile(rebuilds from traffic; snapshot keys are
         # postmortem info only)
         self.prefix_cache = (PrefixCache(self.pool, prefix_cache_blocks)
@@ -748,6 +799,11 @@ class ServingEngine:
         from paddle_tpu.ops import rope as rope_ops
         self._cos_tab, self._sin_tab = rope_ops.rope_cos_sin(
             max_seq_len, hd, base=meta["rope_base"])
+        if layout is not None:
+            # closed-over rope tables must be mesh-committed too, or
+            # every program would mix mesh and single-device operands
+            self._cos_tab, self._sin_tab = layout.place_replicated(
+                (self._cos_tab, self._sin_tab))
 
         # host mirrors of the per-slot device state — all volatile:
         # resume admission rebuilds every row from the serialized
@@ -860,6 +916,16 @@ class ServingEngine:
                 self._draft_state = (speculate.draft_state
                                      if speculate.draft_state is not None
                                      else _ist(dm))
+                if speculate.share_embeddings:
+                    # the draft rides the target's embedding table when
+                    # the shapes line up (same vocab × hidden) — one
+                    # buffer instead of two, and via tied_unembed the
+                    # shared table is the draft's unembedding too
+                    # (docs/SERVING.md §Speculative decoding)
+                    shared = self._share_draft_embeddings(
+                        self._draft_state)
+                    if shared is not None:
+                        self._draft_state = shared
                 dmeta = (dm.fused_decode_plan(self._draft_state,
                                               probe=True)
                          if hasattr(dm, "fused_decode_plan") else None)
@@ -891,12 +957,26 @@ class ServingEngine:
                 self.draft_kv_pool = jnp.zeros(
                     (self._draft_layers, dnb, block_tokens,
                      2 * self._draft_dkv), jnp.bfloat16)
+                if layout is not None:
+                    # draft compute stays fully REPLICATED under mp (a
+                    # tiny model — sharding it would trade parity risk
+                    # for nothing); its arrays still commit to the mesh
+                    # so the draft programs' shard_map wrap is uniform
+                    self._draft_state = layout.place_replicated(
+                        self._draft_state)
+                    self.draft_kv_pool = layout.place_replicated(
+                        self.draft_kv_pool)
                 self._draft_stacked = jax.jit(
                     lambda st: dm.fused_decode_plan(st)["params"])(
                         self._draft_state)
                 self._draft_cos, self._draft_sin = rope_ops.rope_cos_sin(
                     max_seq_len, dmeta["head_dim"],
                     base=dmeta["rope_base"])
+                if layout is not None:
+                    (self._draft_stacked, self._draft_cos,
+                     self._draft_sin) = layout.place_replicated(
+                        (self._draft_stacked, self._draft_cos,
+                         self._draft_sin))
                 self._draft_tables = np.full(
                     (ms, self.max_blocks_per_slot), SCRATCH_BLOCK,
                     np.int32)
@@ -919,6 +999,18 @@ class ServingEngine:
         # steps; a serving step would run it once per token)
         self._stacked = jax.jit(
             lambda st: model.fused_decode_plan(st)["params"])(self._state)
+        # tpu-lint: volatile(per-leaf PartitionSpecs, derived from layout)
+        self._stacked_specs = None
+        if layout is not None:
+            ffn_w = self._stacked.get("wg")
+            layout.validate(num_heads=meta["num_heads"],
+                            num_kv_heads=nkv, num_layers=L,
+                            ffn=(int(ffn_w.shape[-1])
+                                 if ffn_w is not None else None))
+            self._stacked_specs = layout.stacked_specs(self._stacked)
+            self._stacked = layout.shard_stacked(
+                self._stacked, num_heads=meta["num_heads"],
+                num_kv_heads=nkv, head_dim=hd)
         # device twins of the host mirrors above: positions/toks/counts
         # advance ON DEVICE inside the step program (no per-step H2D
         # uploads); a join/leave/table event marks them dirty and the
@@ -1027,10 +1119,94 @@ class ServingEngine:
         cfg = self.model.cfg
         return int(getattr(cfg, "num_layers"))
 
+    # -------------------------------------------- tensor-parallel plumbing
+    _EMBED_KEYS = ("model.embed_tokens.weight", "gpt.wte.weight")
+
+    def _share_draft_embeddings(self, draft_state):
+        """Rebind the draft's embedding table to the TARGET's array when
+        shape+dtype match (SpecConfig(share_embeddings=True)). Returns
+        the rebound dict, or None when no key lines up — a smaller-
+        hidden draft keeps its own table, silently."""
+        for key in self._EMBED_KEYS:
+            tw = self._state.get(key)
+            dw = draft_state.get(key)
+            if (tw is not None and dw is not None
+                    and getattr(tw, "shape", None) == dw.shape
+                    and getattr(tw, "dtype", None) == dw.dtype):
+                out = dict(draft_state)
+                out[key] = tw
+                return out
+        return None
+
+    def _up(self, x, spec=None):
+        """Host→device upload for program inputs. Single-device engines
+        take the plain ``jnp.asarray`` path (byte-identical pre-mp
+        behavior); a mesh-sharded engine commits the upload under an
+        explicit NamedSharding (replicated unless ``spec`` says
+        otherwise) so dispatch inputs never mix mesh and single-device
+        placements."""
+        if self.layout is None:
+            return jnp.asarray(x)
+        from jax.sharding import PartitionSpec
+        # tpu-lint: allow(host-sync): inputs are host-canonical mirrors
+        return self.layout.place(
+            np.asarray(x), spec if spec is not None else PartitionSpec())
+
+    def _up_scales(self):
+        """The int8 per-slot scale device twin: canonical on the host,
+        shard-major permuted + head-dim sharded on the mesh (lockstep
+        with the pool's last dim)."""
+        if self.layout is None:
+            return jnp.asarray(self._kv_scales)
+        return self.layout.shard_kv_scales(
+            self._kv_scales, num_kv_heads=self.meta["num_kv_heads"],
+            head_dim=self.meta["head_dim"])
+
+    def _wrap_program(self, impl, in_specs, out_specs, donate_argnums=()):
+        """The ONE shard seam (ISSUE 17): every engine program routes
+        through here. mesh=None → plain ``jax.jit`` — the exact pre-mp
+        program. With a mesh, the impl runs under full-manual
+        ``jax.shard_map``: per-head math is local, the o-proj/logits
+        boundary gathers (inside fused_decode), and sampling runs
+        replicated on every device so per-slot ``fold_in`` RNG streams
+        survive verbatim. check_vma/check_rep=False is REQUIRED: the
+        replication checker cannot infer that all_gather outputs under
+        replicated out_specs are in fact replicated (jaxcompat
+        forwards the flag on 0.4.x)."""
+        if self.mesh is None:
+            return jax.jit(impl, donate_argnums=donate_argnums)
+        try:
+            sm = jax.shard_map(impl, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False,
+                               check_rep=False)
+        except TypeError:   # newer jax: check_rep renamed to check_vma
+            sm = jax.shard_map(impl, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+        return jax.jit(sm, donate_argnums=donate_argnums)
+
+    def _gather_stacked(self, stacked):
+        """fsdp gather-at-use: stacked leaves arrive sharded on the
+        layer dim; one tiled all_gather per leaf at body entry
+        reassembles the exact bytes (bitwise inert). mp-only meshes
+        (and mesh=None) pass through untouched."""
+        if self._fsdp_axis is None:
+            return stacked
+        ax = self._fsdp_axis
+        return {k: jax.lax.all_gather(w, ax, axis=0, tiled=True)
+                for k, w in stacked.items()}
+
+    def _replicated_specs(self, tree):
+        """A matching pytree of replicated PartitionSpecs."""
+        from jax.sharding import PartitionSpec
+        return jax.tree.map(lambda _: PartitionSpec(), tree)
+
     def _gauges_init(self):
         from paddle_tpu.observability import registry
         r = registry()
         r.gauge("serving.pool_blocks_total").set(self.pool.num_blocks - 1)
+        r.gauge("serving.mp_degree").set(self._mp)
+        r.gauge("serving.fsdp_degree").set(
+            self.layout.fsdp if self.layout is not None else 1)
         self._update_gauges()
 
     def _update_gauges(self):
@@ -1364,18 +1540,27 @@ class ServingEngine:
         n0 = hb + nb_new             # blocks covering the whole prompt
         model = self.model
         int8 = self.kv_int8
+        mp_axis = self._mp_axis
 
         def impl(state, pool, prefix, ids, last_idx, seeds, new_bids,
                  valid_len):
             # prefix: bf16 pools pass the (n, hb) shared block ids and
             # gather the prefix KV HERE (no separate dispatch); int8
             # pools pass the host-kept bf16 copies (L, n, R, 2dkv) —
-            # quantized blocks are per-slot-scaled, never shareable
+            # quantized blocks are per-slot-scaled, never shareable.
+            # Under mp the pool's last dim is the LOCAL [k_s|v_s] lanes
+            # (pool.shape[-1] == 2dkv/mp inside the shard): prefix
+            # gathers reassemble the canonical width, adopt scatters
+            # keep only the shard's own lanes.
             cache = model.init_cache(n, cache_len, dtype=jnp.bfloat16)
             if R:
-                pk = (prefix if int8
-                      else pool[:, prefix].reshape(
-                          len(cache), n, R, 2 * dkv))
+                if int8:
+                    pk = prefix
+                else:
+                    pk = pool[:, prefix].reshape(
+                        len(cache), n, R, pool.shape[-1])
+                    if mp_axis is not None:
+                        pk = mp_gather_kv_lastdim(pk, mp_axis)
                 for l in range(len(cache)):
                     kl = pk[l, :, :, :dkv].reshape(n, R, nkv, hd)
                     vl = pk[l, :, :, dkv:].reshape(n, R, nkv, hd)
@@ -1413,17 +1598,27 @@ class ServingEngine:
                 q = jnp.clip(jnp.round(
                     kv_flat.astype(jnp.float32) / lanes[:, :, None, :]),
                     -127, 127).astype(jnp.int8)
-                pool = pool.at[:, new_bids].set(
-                    q.reshape(-1, n, n0, BT, 2 * dkv))
+                blkq = q.reshape(-1, n, n0, BT, 2 * dkv)
+                if mp_axis is not None:
+                    blkq = mp_local_kv_lastdim(blkq, mp_axis)
+                pool = pool.at[:, new_bids].set(blkq)
                 return tok, pool, lanes, kv_flat
             blk = kv_flat[:, :, R:cache_len].reshape(
                 -1, n, nb_new, BT, 2 * dkv)
+            if mp_axis is not None:
+                blk = mp_local_kv_lastdim(blk, mp_axis)
             pool = pool.at[:, new_bids].set(blk.astype(pool.dtype))
             return tok, pool
 
         # `state` flows as a traced argument (matching generate) so the
         # weights are not baked into the program as constants
-        jitted = jax.jit(impl, donate_argnums=(1,))
+        from jax.sharding import PartitionSpec as P
+        lay = self.layout
+        pspec = lay.pool_spec() if lay is not None else None
+        in_specs = (P(), pspec) + (P(),) * 6
+        out_specs = ((P(), pspec, P(), P()) if int8 else (P(), pspec))
+        jitted = self._wrap_program(impl, in_specs, out_specs,
+                                    donate_argnums=(1,))
         fn = _program_handle(jitted, lambda: (self._state,))
         self._jit_cache[key] = fn
         return fn, False
@@ -1556,18 +1751,18 @@ class ServingEngine:
                 last_idx[r] = P - 1 - last_start
                 seeds[r] = np.uint32(s.req.seed)
                 valid[r] = len(s.req.prompt)
-            g.dev_ids = jnp.asarray(ids)
-            g.dev_bids = jnp.asarray(bids)
-            g.dev_last = jnp.asarray(last_idx)
-            g.dev_seeds = jnp.asarray(seeds)
+            g.dev_ids = self._up(ids)
+            g.dev_bids = self._up(bids)
+            g.dev_last = self._up(last_idx)
+            g.dev_seeds = self._up(seeds)
             if self.kv_int8:
-                g.dev_valid = jnp.asarray(valid)
+                g.dev_valid = self._up(valid)
                 if R:
                     # int8 chunk 0 over prefix hits rides the cache's
                     # exact bf16 host copies (quantized blocks are
                     # per-slot-scaled, never shareable) — uploaded once
                     hit_rows = [s.hits for _, s in rows]
-                    g.dev_prefix = jnp.asarray(np.stack(
+                    g.dev_prefix = self._up(np.stack(
                         [np.concatenate([e.kv_host for e in hs], axis=1)
                          for hs in hit_rows], axis=1))   # (L, n, R, 2dkv)
                     assert g.dev_prefix.shape == (L, n, R, 2 * self._dkv)
@@ -1656,6 +1851,7 @@ class ServingEngine:
         keep_kv = self.prefix_cache is not None
         temperature, top_k, top_p = (self.temperature, self.top_k,
                                      self.top_p)
+        mp_axis = self._mp_axis
 
         def body(state, pool, carry, ids, bids, prefix, last_idx,
                  cseeds, valid):
@@ -1671,8 +1867,13 @@ class ServingEngine:
                     # same bf16 the carry would). Only int8 pools need
                     # the resident bf16 carry (quantized blocks cannot
                     # re-feed the forward).
+                    # under mp the pool gather yields the LOCAL lanes;
+                    # one tiled all_gather reassembles the canonical
+                    # width (the exact bf16 bytes every shard scattered)
                     pk = pool[:, bids[:, :start // BT]].reshape(
-                        len(cache), n, start, 2 * dkv)
+                        len(cache), n, start, pool.shape[-1])
+                    if mp_axis is not None:
+                        pk = mp_gather_kv_lastdim(pk, mp_axis)
                 elif start == R:    # int8 chunk 0 over a prefix hit
                     pk = prefix
                 else:               # int8 mid/last: the resident carry
@@ -1825,8 +2026,39 @@ class ServingEngine:
             donate.append(3 + int(has_carry) + 2 + int(has_prefix)
                           + (2 if last else 0)
                           + (1 if (last and int8) else 0) + 6 + 3)
-        jitted = jax.jit(
-            impl, donate_argnums=resident_carry_donate_argnums(*donate))
+        from jax.sharding import PartitionSpec as P
+        lay = self.layout
+        pspec = lay.pool_spec() if lay is not None else None
+        sspec = lay.kv_scales_spec() if lay is not None else None
+        rest_specs = []
+        if has_carry:
+            rest_specs.append(P())
+        rest_specs += [P(), P()]                    # ids, bids
+        if has_prefix:
+            rest_specs.append(P())
+        if last:
+            rest_specs += [P(), P()]                # last_idx, cseeds
+        if last and int8:
+            rest_specs.append(P())                  # valid
+        rest_specs += [P()] * 5 + [sspec]           # tables..kv_scales
+        if spec:
+            rest_specs += [P(), P(), P()]           # props, nprop, cap
+        if ngram:
+            rest_specs.append(P())                  # history
+        in_specs = (P(), self._stacked_specs or P(), pspec, *rest_specs)
+        if spec:
+            dec_specs = [P()] * (9 if ngram else 6)
+            dec_specs[2] = pspec
+        else:
+            dec_specs = [P(), pspec, P(), P()]
+        n_outs = ((1 if (int8 and not last) else 0)
+                  + (1 if last else 0)
+                  + ((1 + (1 if keep_kv else 0))
+                     if (int8 and last) else 0))
+        out_specs = (*dec_specs, *([P()] * n_outs))
+        jitted = self._wrap_program(
+            impl, in_specs, out_specs,
+            donate_argnums=resident_carry_donate_argnums(*donate))
         fn = _program_handle(jitted,
                              lambda: (self._state, self._stacked))
         self._jit_cache[key] = fn
@@ -2251,14 +2483,15 @@ class ServingEngine:
         if self.kv_int8:
             new_bids = np.asarray([s.blocks for _, s, _, _, _ in grp],
                                   np.int32)                    # (n, n0)
-            prefix = (jnp.asarray(np.stack(
+            prefix = (self._up(np.stack(
                 [np.concatenate([e.kv_host for e in hits], axis=1)
                  for _, _, hits, _, _ in grp], axis=1)) if hb
-                else jnp.zeros((L, n, 0, 2 * self._dkv), jnp.bfloat16))
+                else self._up(np.zeros((L, n, 0, 2 * self._dkv),
+                                       np.float32).astype(jnp.bfloat16)))
             tok, self.kv_pool, lanes, kv_flat = fn(
-                self.kv_pool, prefix, jnp.asarray(ids),
-                jnp.asarray(last_idx), jnp.asarray(seeds),
-                jnp.asarray(new_bids), jnp.asarray(valid))
+                self.kv_pool, prefix, self._up(ids),
+                self._up(last_idx), self._up(seeds),
+                self._up(new_bids), self._up(valid))
             # tpu-lint: allow(host-sync): once-per-wave D2H — int8 scales
             lanes_np = np.asarray(lanes)
             # tpu-lint: allow(host-sync): once-per-wave D2H — the prefix
@@ -2272,9 +2505,9 @@ class ServingEngine:
                                   for _, _, hits, _, _ in grp], np.int32)
                       if hb else np.zeros((n, 0), np.int32))
             tok, self.kv_pool = fn(
-                self.kv_pool, jnp.asarray(prefix), jnp.asarray(ids),
-                jnp.asarray(last_idx), jnp.asarray(seeds),
-                jnp.asarray(new_bids), jnp.asarray(valid))
+                self.kv_pool, self._up(prefix), self._up(ids),
+                self._up(last_idx), self._up(seeds),
+                self._up(new_bids), self._up(valid))
             lanes_np = kv_np = None
         # tpu-lint: allow(host-sync): once-per-wave D2H — first tokens
         tok_np = np.asarray(tok)
@@ -2331,10 +2564,10 @@ class ServingEngine:
             toks[slot_idx] = int(tok)
             counts[slot_idx] = j + 1
             _nxt, self.kv_pool, _pos, _cnt = self._step_fn(
-                self.kv_pool, jnp.asarray(tables),
-                jnp.asarray(positions), jnp.asarray(toks),
-                jnp.asarray(seeds), jnp.asarray(counts),
-                jnp.asarray(self._kv_scales))
+                self.kv_pool, self._up(tables),
+                self._up(positions), self._up(toks),
+                self._up(seeds), self._up(counts),
+                self._up_scales())
             s.pos += 1
         n = len(s.resume) - 1
         self.stats["replay_tokens"] += n
@@ -2453,6 +2686,14 @@ class ServingEngine:
         model, cos_tab, sin_tab = self.model, self._cos_tab, self._sin_tab
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         pos_cap = self.max_seq_len - 1
+        # under mp the body runs INSIDE shard_map: each shard walks its
+        # own heads over its own pool lanes (local counts), and the
+        # fused op gathers at the o-proj boundary; mp=1 passes the full
+        # counts and mp_axis=None — the byte-identical pre-mp trace
+        mp_axis = self._mp_axis
+        nh_loc = meta["num_heads"] // self._mp
+        nkv_loc = meta["num_kv_heads"] // self._mp
+        gather_stacked = self._gather_stacked
 
         def body(state, stacked, pool, tables, positions, toks, seeds,
                  counts, kv_scales, chunk_bids=None, chunk_kv=None):
@@ -2460,6 +2701,7 @@ class ServingEngine:
             # stacked layer weights arrive prebuilt via `stacked`, so the
             # plan's own build_fused_params output is unused and XLA
             # dead-codes the per-step restacking away
+            stacked = gather_stacked(stacked)
             plan_t = model.fused_decode_plan(state)
             blocks = plan_t.get("blocks")
             if int8 and blocks is not None:
@@ -2469,11 +2711,12 @@ class ServingEngine:
             sin = jnp.take(sin_tab, positions, axis=0)
             x, pool = fused_paged_tick_step(
                 x, stacked, pool, tables, positions, cos, sin,
-                num_heads=meta["num_heads"],
-                num_kv_heads=meta["num_kv_heads"], eps=meta["eps"],
+                num_heads=nh_loc,
+                num_kv_heads=nkv_loc, eps=meta["eps"],
                 rope_base=meta["rope_base"], arch=arch, blocks=blocks,
                 kv_scales=kv_scales if int8 else None,
-                chunk_bids=chunk_bids, chunk_kv=chunk_kv)
+                chunk_bids=chunk_bids, chunk_kv=chunk_kv,
+                mp_axis=mp_axis)
             with jax.named_scope("decode.sample"):
                 keys = _row_keys(seeds)
                 ki = jax.vmap(jax.random.fold_in)(keys, counts)
@@ -2501,8 +2744,17 @@ class ServingEngine:
         # append into ONE scatter (jax-0.4 CPU ignores donation, so each
         # scatter costs one full pool copy — per step, not per layer);
         # on TPU the Pallas kernel aliases the pool and donation skips
-        # the defensive copy
-        jitted = jax.jit(impl, donate_argnums=(2,))
+        # the defensive copy (per SHARD under mp — the donation_report
+        # pin covers the sharded tick too)
+        from jax.sharding import PartitionSpec as P
+        lay = self.layout
+        pspec = lay.pool_spec() if lay is not None else None
+        in_specs = (P(), self._stacked_specs or P(), pspec,
+                    P(), P(), P(), P(), P(),
+                    lay.kv_scales_spec() if lay is not None else None)
+        out_specs = (P(), pspec, P(), P())
+        jitted = self._wrap_program(impl, in_specs, out_specs,
+                                    donate_argnums=(2,))
         return _program_handle(jitted,
                                lambda: (self._state, self._stacked))
 
@@ -2515,6 +2767,8 @@ class ServingEngine:
         if z is None:
             z = (jnp.zeros((self.max_slots, K), jnp.int32),
                  jnp.zeros((self.max_slots,), jnp.int32))
+            if self.layout is not None:
+                z = self.layout.place_replicated(z)
             self._prop_zeros[K] = z
         return z
 
@@ -2523,6 +2777,8 @@ class ServingEngine:
         a = self._nprop_fulls.get(K)
         if a is None:
             a = jnp.full((self.max_slots,), K, jnp.int32)
+            if self.layout is not None:
+                a = self.layout.place_replicated(a)
             self._nprop_fulls[K] = a
         return a
 
@@ -2658,11 +2914,22 @@ class ServingEngine:
         ngram = self.speculate.proposer == "ngram"
         nmax = self.speculate.ngram_max
         nmin = self.speculate.ngram_min
+        mp_axis = self._mp_axis
+        nh_loc = meta["num_heads"] // self._mp
+        nkv_loc = meta["num_kv_heads"] // self._mp
+        gather_stacked = self._gather_stacked
 
         def body(state, stacked, pool, tables, positions, toks, seeds,
                  counts, kv_scales, proposals, nprop, cap, history=None,
                  chunk_bids=None, chunk_kv=None):
+            stacked = gather_stacked(stacked)
             if chunk_bids is not None:
+                if mp_axis is not None \
+                        and chunk_kv.shape[-1] != pool.shape[-1]:
+                    # the chunk half hands over CANONICAL-width payload
+                    # (the replicated full-model forward); keep this
+                    # shard's own [k_s|v_s] lanes before the scatter
+                    chunk_kv = mp_local_kv_lastdim(chunk_kv, mp_axis)
                 with jax.named_scope("fused_decode.chunk_scatter"):
                     pool = paged_chunk_scatter(pool, chunk_bids, chunk_kv)
             plan_t = model.fused_decode_plan(state)
@@ -2683,10 +2950,10 @@ class ServingEngine:
             x, pool = fused_paged_verify_step(
                 x, stacked, pool, tables, positions,
                 jnp.stack(coss, axis=1), jnp.stack(sins, axis=1),
-                num_heads=meta["num_heads"],
-                num_kv_heads=meta["num_kv_heads"], eps=meta["eps"],
+                num_heads=nh_loc,
+                num_kv_heads=nkv_loc, eps=meta["eps"],
                 rope_base=meta["rope_base"], arch=arch, blocks=blocks,
-                kv_scales=kv_scales if int8 else None)
+                kv_scales=kv_scales if int8 else None, mp_axis=mp_axis)
             keys = _row_keys(seeds)
             gs = []
             for j in range(K1):
@@ -2752,8 +3019,18 @@ class ServingEngine:
         # buffer is dead at dispatch — undonated it cost one full
         # (max_slots, max_seq_len) copy per speculative tick (the
         # donation lint rule's first catch; donation_report pins it)
-        jitted = jax.jit(impl,
-                         donate_argnums=(2,) + ((12,) if ngram else ()))
+        from jax.sharding import PartitionSpec as P
+        lay = self.layout
+        pspec = lay.pool_spec() if lay is not None else None
+        in_specs = ((P(), self._stacked_specs or P(), pspec)
+                    + (P(),) * 5
+                    + (lay.kv_scales_spec() if lay is not None else None,)
+                    + (P(),) * 3 + ((P(),) if ngram else ()))
+        out_specs = [P()] * (9 if ngram else 6)
+        out_specs[2] = pspec
+        jitted = self._wrap_program(
+            impl, in_specs, tuple(out_specs),
+            donate_argnums=(2,) + ((12,) if ngram else ()))
         return _program_handle(jitted,
                                lambda: (self._state, self._stacked))
 
@@ -2807,7 +3084,13 @@ class ServingEngine:
                 draft_step, (toks, dpool, positions), None, length=K + 1)
             return props[:K].T.astype(jnp.int32), pool
 
-        jitted = jax.jit(impl, donate_argnums=(2,))
+        # the draft runs fully REPLICATED under mp (every spec is P());
+        # the shard_map wrap still matters — it pins the draft's inputs
+        # and outputs to the mesh so a speculative tick never mixes
+        # mesh-committed and single-device buffers
+        from jax.sharding import PartitionSpec as P
+        jitted = self._wrap_program(impl, (P(),) * 6, (P(), P()),
+                                    donate_argnums=(2,))
         return _program_handle(
             jitted, lambda: (self._draft_state, self._draft_stacked))
 
@@ -2842,7 +3125,9 @@ class ServingEngine:
             blk = kv_flat.reshape(Ld, 1, nb, BT, 2 * dkv)
             return pool.at[:, new_bids].set(blk.astype(pool.dtype))
 
-        jitted = jax.jit(impl, donate_argnums=(1,))
+        from jax.sharding import PartitionSpec as P
+        jitted = self._wrap_program(impl, (P(),) * 4, P(),
+                                    donate_argnums=(1,))
         fn = _program_handle(jitted, lambda: (self._draft_state,))
         self._jit_cache[key] = fn
         return fn, False
@@ -2872,8 +3157,8 @@ class ServingEngine:
         ids[0, :P] = feed
         fn, _cached = self._draft_prefill_fn(dn0 * BT)
         self.draft_kv_pool = fn(
-            self.draft_kv_pool, jnp.asarray(ids),
-            jnp.asarray(np.asarray([s.dblocks[:dn0]], np.int32)))
+            self.draft_kv_pool, self._up(ids),
+            self._up(np.asarray([s.dblocks[:dn0]], np.int32)))
 
     def _ensure_blocks(self, slot_idx: int, horizon: int = 0):
         """Append positions [pos, pos+horizon] must resolve to allocated
@@ -3110,14 +3395,14 @@ class ServingEngine:
             # device-resident from admission.
             steady = self._step_fn_warm and not self._dirty and tick_warm
             if self._dirty:
-                self._dev = (jnp.asarray(self._tables),
-                             jnp.asarray(self._positions),
-                             jnp.asarray(self._toks),
-                             jnp.asarray(self._seeds),
-                             jnp.asarray(self._counts),
-                             jnp.asarray(self._kv_scales))
+                self._dev = (self._up(self._tables),
+                             self._up(self._positions),
+                             self._up(self._toks),
+                             self._up(self._seeds),
+                             self._up(self._counts),
+                             self._up_scales())
                 if self._history is not None:
-                    self._dev_hist = jnp.asarray(self._history)
+                    self._dev_hist = self._up(self._history)
                     # a join/leave tick drops the carried proposals —
                     # the device matcher re-primes them at the end of
                     # this tick's verify (one plain-decode tick per
@@ -3125,9 +3410,9 @@ class ServingEngine:
                     self._dev_prop = (self._prop_zero(self._spec_k_eff)
                                       if spec_tick else None)
                 if spec:
-                    self._dev_cap = jnp.asarray(self._spec_cap)
+                    self._dev_cap = self._up(self._spec_cap)
                 if self._draft_tables is not None:
-                    self._draft_dev = jnp.asarray(self._draft_tables)
+                    self._draft_dev = self._up(self._draft_tables)
                 self._dirty = False
         # everything up to the dispatch call is the admit segment
         # (minus the prefill programs, which _run_prefill_group timed)
@@ -3775,7 +4060,11 @@ class ServingEngine:
         request — in-flight slots and queued work alike — through the
         token-exact resume path: zero loss across a crash. Finished
         results carry over. ``overrides`` replace constructor config
-        (e.g. a new ``flight_dump_path``)."""
+        (e.g. a new ``flight_dump_path``). Snapshots are MESH-FREE
+        (host-canonical: KV never serializes, scales/tokens are
+        host-side canonical forms), so ``mesh=``/``layout=`` overrides
+        restore the same snapshot onto any mesh shape — including a
+        single chip — byte-identically (tests/test_serving_mp.py)."""
         from paddle_tpu.observability import registry
         from paddle_tpu.resilience import record_event
 

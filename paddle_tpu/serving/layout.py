@@ -1,0 +1,218 @@
+"""Tensor-parallel layout for one paged-serving replica (docs/SERVING.md
+§Tensor-parallel replicas).
+
+The reference serves a big model by sharding each fused_multi_transformer
+layer over the ``mp`` process group (qkv/gate/up column-parallel, the KV
+cache split by head) with one collective per layer at the o-proj
+boundary. TPU-native, the process group is a mesh axis: this module maps
+the engine's stacked per-layer weights, the paged KV pool, and the int8
+scale twins to :class:`~jax.sharding.PartitionSpec` s over a
+``{mp, fsdp}`` submesh (``parallel.topology`` axis names), and the
+engine wraps its program sites in full-manual ``jax.shard_map`` with
+these specs — SNIPPETS exemplar [3]'s ``SpecLayout``, specialized to the
+serving engine's actual pytrees.
+
+Parity-first sharding (the all_gather flavor, not psum): qkv / gate / up
+projections are COLUMN-parallel — each shard computes its own heads'
+attention and its own FFN lanes over exact full-width inputs — and the
+(b, cols) activations are reassembled by one tiled ``all_gather`` before
+the o-proj / down-proj, which stay replicated full-width matmuls. A
+row-parallel o-proj with a ``psum`` would change the reduction order of
+every output element and break the engine's bitwise token-parity pins;
+gather-then-full-matmul reproduces the mp=1 float ops exactly (each
+output element is one dot over the same operands in the same order), so
+the mp=2 engine is bit-identical to mp=1 — the property
+tests/test_serving_mp.py pins on a forced-host-device CPU mesh.
+
+Shard-major permutations: the fused qkv stack packs columns ``[q|k|v]``
+and the paged pool packs its last dim ``[k|v]`` — a contiguous mp-split
+of either crosses region boundaries. The layout permutes those dims
+shard-major (``ops.fused_decode.mp_qkv_permutation`` /
+``mp_kv_permutation``) at device-placement time, so each shard's
+contiguous block IS its canonical local ``[q_s|k_s|v_s]`` /
+``[k_s|v_s]`` layout and the per-shard kernel code is unchanged. Host
+mirrors (``_kv_scales``, snapshots, the prefix cache's bf16 copies)
+stay canonical — only device twins are permuted.
+
+``fsdp`` is the weight-memory axis: every stacked leaf is additionally
+sharded on its layer dim (L) and gathered at use inside the shard body
+(one tiled ``all_gather`` per decode program — classic
+gather-at-use FSDP; bitwise inert, it reassembles the exact bytes).
+Sampling, RNG streams, block tables and every host mirror stay
+replicated, which is what keeps the engine's per-slot
+``fold_in(seed, count)`` streams — and with them every token-parity
+pin — intact verbatim.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ServingLayout"]
+
+# stacked llama/gpt leaves whose LAST dim is the mp-sharded (column)
+# dim: the fused qkv projection (+ its bias/scale rows, permuted
+# shard-major) and the gate/up FFN lanes. Everything else — o/down
+# projections, layernorms, their biases/scales — stays full-width on
+# every shard (the gather-then-full-matmul parity contract above).
+_MP_COL_KEYS = frozenset({
+    "wqkv", "wqkv_s", "bqkv",       # fused [q|k|v], shard-major permuted
+    "wg", "wg_s", "bg",             # gate/up FFN lanes, contiguous split
+    "wu", "wu_s",
+})
+# leaves whose last dim is permuted shard-major with the qkv
+# permutation before the contiguous mp split
+_QKV_PERM_KEYS = frozenset({"wqkv", "wqkv_s", "bqkv"})
+
+
+class ServingLayout:
+    """PartitionSpecs for one tensor-parallel ServingEngine replica.
+
+    ``mesh`` must carry the axes named by ``mp_axis`` / ``fsdp_axis``
+    (either may be absent — its degree is then 1 and the corresponding
+    sharding degrades to replication). Axis names default to the
+    ``parallel.topology.KNOWN_AXES`` registry names, which is what
+    keeps the mesh-lint ``collective-axis`` / ``pspec-axis`` rules able
+    to pin them statically.
+    """
+
+    def __init__(self, mesh, *, mp_axis: str = "mp",
+                 fsdp_axis: str = "fsdp"):
+        if mesh is None:
+            raise ValueError("ServingLayout needs a jax.sharding.Mesh")
+        if mp_axis not in mesh.axis_names \
+                and fsdp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} carry neither "
+                f"{mp_axis!r} nor {fsdp_axis!r}; a serving layout "
+                f"shards over those two axes only")
+        self.mesh = mesh
+        self.mp_axis = mp_axis if mp_axis in mesh.axis_names else None
+        self.fsdp_axis = (fsdp_axis if fsdp_axis in mesh.axis_names
+                          else None)
+        self.mp = (int(mesh.shape[self.mp_axis])
+                   if self.mp_axis is not None else 1)
+        self.fsdp = (int(mesh.shape[self.fsdp_axis])
+                     if self.fsdp_axis is not None else 1)
+        for ax in mesh.axis_names:
+            if ax not in (mp_axis, fsdp_axis) and mesh.shape[ax] != 1:
+                raise ValueError(
+                    f"mesh axis {ax!r} has degree {mesh.shape[ax]}; a "
+                    f"single serving replica only shards over "
+                    f"{mp_axis!r}/{fsdp_axis!r} — put data parallelism "
+                    f"in Router replicas, not this mesh")
+        # collapse degree-1 axes to None so the specs (and the program
+        # cache keys derived from them) are canonical
+        if self.mp == 1:
+            self.mp_axis = None
+        if self.fsdp == 1:
+            self.fsdp_axis = None
+
+    # ---------------------------------------------------------- validation
+    def validate(self, *, num_heads: int, num_kv_heads: int,
+                 num_layers: int, ffn: Optional[int] = None):
+        """Divisibility gates, checked at engine construction (a trace
+        error on a v5p mesh is the failure mode this pre-empts):
+        mp must divide the head counts (each shard owns whole kv
+        groups), fsdp must divide the layer count, and the (padded)
+        ffn width must split evenly over mp."""
+        if num_kv_heads % self.mp or num_heads % self.mp:
+            raise ValueError(
+                f"mp={self.mp} must divide num_heads={num_heads} and "
+                f"num_kv_heads={num_kv_heads} (each shard walks whole "
+                f"kv groups so its block-table gather stays local)")
+        if num_layers % self.fsdp:
+            raise ValueError(
+                f"fsdp={self.fsdp} must divide num_layers="
+                f"{num_layers} (stacked weights shard on the layer dim)")
+        if ffn is not None and ffn % self.mp:
+            raise ValueError(
+                f"mp={self.mp} must divide the padded ffn width {ffn}")
+
+    # ------------------------------------------------------------- specs
+    @property
+    def replicated(self) -> P:
+        return P()
+
+    def pool_spec(self) -> P:
+        """The paged KV pool (L, num_blocks, block_tokens, 2*nkv*hd):
+        sharded on the head (last) dim after the shard-major kv
+        permutation — each shard's block-table walk reads only its own
+        heads' lanes, no cross-shard traffic in the attention walk."""
+        return P(None, None, None, self.mp_axis)
+
+    def kv_scales_spec(self) -> P:
+        """The int8 per-slot scale device twin (L, max_slots, 2*nkv*hd),
+        permuted+sharded in lockstep with the pool's last dim."""
+        return P(None, None, self.mp_axis)
+
+    def stacked_specs(self, stacked: Dict) -> Dict[str, P]:
+        """Per-leaf specs for a ``build_fused_params``-shaped stack
+        (llama or gpt keys): column-parallel leaves shard their last
+        dim over mp, every leaf shards its layer dim over fsdp."""
+        out = {}
+        for k, w in stacked.items():
+            ax = [None] * w.ndim
+            ax[0] = self.fsdp_axis
+            if k in _MP_COL_KEYS:
+                ax[-1] = self.mp_axis
+            out[k] = P(*ax)
+        return out
+
+    # ------------------------------------------------------- permutations
+    def qkv_perm(self, num_heads: int, num_kv_heads: int,
+                 head_dim: int) -> np.ndarray:
+        from paddle_tpu.ops.fused_decode import mp_qkv_permutation
+        return mp_qkv_permutation(num_heads, num_kv_heads, head_dim,
+                                  self.mp)
+
+    def kv_perm(self, num_kv_heads: int, head_dim: int) -> np.ndarray:
+        from paddle_tpu.ops.fused_decode import mp_kv_permutation
+        return mp_kv_permutation(num_kv_heads, head_dim, self.mp)
+
+    # --------------------------------------------------------- placement
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def place(self, x, spec: P):
+        """Commit ``x`` (host or device) to the mesh under ``spec``."""
+        return jax.device_put(x, self.sharding(spec))
+
+    def place_replicated(self, tree):
+        """Commit a whole pytree replicated onto the mesh (device
+        mirrors, draft weights/pool, program constants — anything a
+        mesh-committed program consumes that is not sharded)."""
+        return jax.device_put(tree, self.sharding(P()))
+
+    def shard_stacked(self, stacked: Dict, *, num_heads: int,
+                      num_kv_heads: int, head_dim: int) -> Dict:
+        """Permute the fused-qkv leaves shard-major and commit every
+        stacked leaf to the mesh under :meth:`stacked_specs`. The
+        permutation is applied to the DEVICE twin only — host-side
+        canonical forms (snapshots, state dicts) never see it."""
+        perm = self.qkv_perm(num_heads, num_kv_heads, head_dim)
+        specs = self.stacked_specs(stacked)
+        out = {}
+        for k, w in stacked.items():
+            if k in _QKV_PERM_KEYS and self.mp > 1:
+                # tpu-lint: allow(host-sync): one-time init permutation
+                # of the device twin (host round trip, not a step cost)
+                w = np.asarray(w)[..., perm]
+            out[k] = self.place(w, specs[k])
+        return out
+
+    def shard_kv_scales(self, scales: np.ndarray, *, num_kv_heads: int,
+                        head_dim: int):
+        """Permute the canonical host scales (L, ms, [k|v]) shard-major
+        and commit the device twin under :meth:`kv_scales_spec`."""
+        # tpu-lint: allow(host-sync): scales are the host-canonical mirror
+        s = np.asarray(scales)
+        if self.mp > 1:
+            s = s[..., self.kv_perm(num_kv_heads, head_dim)]
+        return self.place(s, self.kv_scales_spec())
+
+    def __repr__(self):
+        return (f"ServingLayout(mp={self.mp}, fsdp={self.fsdp}, "
+                f"mesh={dict(self.mesh.shape)})")

@@ -242,9 +242,16 @@ class Router:
         (draft models don't serialize — without this a draft-proposer
         tier could never take the restore path; restore would raise
         ``RestoreError("draft_model_missing")`` and every failover
-        would silently degrade to redistribution)."""
-        spec = self._engine_kwargs.get("speculate")
-        return {"speculate": spec} if spec is not None else {}
+        would silently degrade to redistribution), and the live
+        mesh/layout (snapshots are mesh-free, so a sharded router's
+        restored replica must be re-handed its mesh explicitly or it
+        would come back single-device)."""
+        out = {}
+        for key in ("speculate", "mesh", "layout"):
+            v = self._engine_kwargs.get(key)
+            if v is not None:
+                out[key] = v
+        return out
 
     @property
     def num_replicas(self) -> int:
@@ -834,22 +841,39 @@ class Router:
         ``warm=True`` (default) a throwaway one-block request is run to
         completion first, so the replica's smallest prefill bucket and
         its step program are compiled BEFORE it takes traffic — "joins
-        warm". Affinity hashing uses the slot count, so existing
+        warm". For a tensor-parallel tier the warmup runs UNDER THE
+        REPLICA'S OWN MESH context (asserted below): the engine's
+        programs carry their mesh explicitly through ``shard_map``, but
+        entering the context pins any ambient-mesh-sensitive lowering
+        (and any future jit cache keyed on the mesh context) to the
+        same programs the replica will re-dispatch under traffic — a
+        warmup compiled under a DIFFERENT ambient mesh would be paid
+        for twice. Affinity hashing uses the slot count, so existing
         prefixes keep their homes and only the new slot's share moves."""
+        import contextlib
+
         from paddle_tpu.observability import registry
 
         idx = len(self._replicas)
         rep = _Replica(self._new_engine(), self._replica_root(idx))
         if warm:
-            bt = rep.engine.block_tokens
-            # tpu-lint: allow(host-sync): host-built warmup prompt
-            prompt = np.full(min(bt, rep.engine.max_seq_len - 2), 3,
-                             np.int32)
-            rid = rep.engine.submit(Request(prompt, max_new_tokens=1,
-                                            seed=0))
-            rep.engine.drain(max_steps=64)
-            rep.engine.results.pop(rid, None)
-            rep.engine.reset_stats()
+            mesh = rep.engine.mesh
+            with (mesh if mesh is not None else contextlib.nullcontext()):
+                if mesh is not None:
+                    from jax.interpreters import pxla
+                    active = pxla.thread_resources.env.physical_mesh
+                    assert active is mesh, (
+                        "add_replica warmup must run under the "
+                        "replica's own mesh context")
+                bt = rep.engine.block_tokens
+                # tpu-lint: allow(host-sync): host-built warmup prompt
+                prompt = np.full(min(bt, rep.engine.max_seq_len - 2), 3,
+                                 np.int32)
+                rid = rep.engine.submit(Request(prompt, max_new_tokens=1,
+                                                seed=0))
+                rep.engine.drain(max_steps=64)
+                rep.engine.results.pop(rid, None)
+                rep.engine.reset_stats()
         self._replicas.append(rep)
         registry().counter("serving.router.replicas_added").inc()
         self.flight.mark("add_replica", replica=idx, warm=warm)

@@ -80,7 +80,8 @@ class SpecConfig:
 
     __slots__ = ("k", "proposer", "ngram_max", "ngram_min",
                  "draft_model", "draft_state", "adaptive", "k_min",
-                 "acceptance_floor", "acceptance_ceiling", "adapt_every")
+                 "acceptance_floor", "acceptance_ceiling", "adapt_every",
+                 "share_embeddings")
 
     def __init__(self, k: int = 4, proposer: str = "ngram",
                  ngram_max: int = 3, ngram_min: int = 1,
@@ -88,7 +89,8 @@ class SpecConfig:
                  adaptive: bool = False, k_min: int = 1,
                  acceptance_floor: float = 0.35,
                  acceptance_ceiling: float = 0.65,
-                 adapt_every: int = 4):
+                 adapt_every: int = 4,
+                 share_embeddings: bool = True):
         if isinstance(k, bool) or not isinstance(k, numbers.Integral) \
                 or k < 1:
             raise ValueError(f"speculate k must be an int >= 1, got {k!r}")
@@ -136,6 +138,14 @@ class SpecConfig:
                 "eligible small model)")
         self.draft_model = draft_model
         self.draft_state = draft_state
+        # draft proposer: rebind the draft's embedding table to the
+        # TARGET's array when the shapes/dtypes line up (same
+        # vocab×hidden — and through tied_unembed the shared table is
+        # the draft's unembedding too). One device buffer instead of
+        # two; a draft with a different hidden keeps its own table,
+        # silently. Bit-inert either way: equal arrays, shared or
+        # copied, produce identical draft logits.
+        self.share_embeddings = bool(share_embeddings)
 
     def to_config(self) -> dict:
         """JSON-serializable form for engine snapshots. The draft MODEL
@@ -146,7 +156,8 @@ class SpecConfig:
                 "adaptive": self.adaptive, "k_min": self.k_min,
                 "acceptance_floor": self.acceptance_floor,
                 "acceptance_ceiling": self.acceptance_ceiling,
-                "adapt_every": self.adapt_every}
+                "adapt_every": self.adapt_every,
+                "share_embeddings": self.share_embeddings}
 
 
 def ngram_propose(history, lengths, k: int, nmax: int, nmin: int):

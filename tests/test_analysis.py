@@ -1101,6 +1101,98 @@ def test_router_steady_state_zero_h2d_zero_recompiles():
         router.drain(max_steps=200)
 
 
+def _mp2_mesh():
+    from paddle_tpu.parallel.topology import build_mesh
+    return build_mesh({"mp": 2}, devices=jax.devices()[:2])
+
+
+def test_sharded_steady_state_zero_h2d_zero_recompiles():
+    """The steady-state claim survives tensor parallelism: an mp=2
+    engine's event-free ``step()`` — per-shard attention, one tiled
+    all_gather at the o-proj boundary, replicated sampling — performs
+    no host->device transfer and compiles nothing after warmup. Every
+    dispatch input is mesh-committed at admission (``_up``/constructor
+    placement), so sharding adds collectives, never uploads."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(0)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=128, sanitize=True,
+                               mesh=_mp2_mesh()) as eng:
+        for _ in range(2):
+            eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                       max_new_tokens=16))
+        eng.step()          # admission: prefill + first dispatch compile
+        guarded = 0
+        while eng.active_slots and guarded < 8:
+            with rt.no_transfer(what="steady sharded tick"), \
+                    rt.count_compiles() as c:
+                eng.step()
+            assert c.count == 0, c.events
+            guarded += 1
+        assert guarded == 8
+        assert eng.stats["sanitized_steps"] >= guarded
+        eng.drain()
+
+
+def test_sharded_join_leave_compile_set_matches_mp1_pin():
+    """The mp=2 engine keeps the EXACT compile-set pins of the mp=1
+    engine (test_join_leave_compile_set_is_exactly_prefill_shapes):
+    first admission = prefill + step program, a covered shape bucket =
+    ZERO compiles, a new bucket = exactly its one prefill program.
+    shard_map wrapping must not fragment the program set."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(1)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=128, prefix_caching=False,
+                               mesh=_mp2_mesh()) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=16)
+        assert c.count == 2, c.events       # prefill(s_pad=32) + step fn
+        eng.submit(serving.Request(rng.randint(3, 500, (20,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=16)
+        assert c.count == 0, c.events
+        eng.submit(serving.Request(rng.randint(3, 500, (40,)),
+                                   max_new_tokens=4))
+        with rt.count_compiles() as c:
+            eng.drain(max_steps=16)
+        assert c.count == 1, c.events
+
+
+def test_donation_report_sharded_pool_step():
+    """Donation survives sharding: the mp=2 pool-step program aliases
+    its (per-shard) KV pool buffer in place — the report computes each
+    donated leaf's LOCAL shard shape for the alias-table match, so 'the
+    sharded tick aliases the pool away' is a checked property on the
+    real mesh-committed program, exactly like the mp=1 pin."""
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(7)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=128,
+                               mesh=_mp2_mesh()) as eng:
+        eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                   max_new_tokens=6))
+        for _ in range(3):
+            eng.step()
+        assert eng._step_fn is not None
+        rep = rt.donation_report(eng._step_fn, eng.kv_pool, *eng._dev,
+                                 what="sharded pool step")
+        # lowered-call positions: state=0, stacked=1, pool=2
+        assert rep.donated_argnums == [2]
+        rep.expect_aliased(2)
+        eng.drain(max_steps=100)
+
+
 def test_donation_report_serving_pool_step_and_chunk_programs():
     """THE donation pins: the serving pool-step program aliases its KV
     pool input into the pool output (every leaf); the bf16 fused chunk

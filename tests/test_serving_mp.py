@@ -1,0 +1,364 @@
+"""Tensor-parallel paged serving: the bitwise parity matrix.
+
+One ServingEngine replica sharded over the ``mp`` mesh axis (and
+optionally ``fsdp`` for weights) must emit BIT-IDENTICAL tokens to the
+unsharded engine — not "close", identical. The layout is parity-first
+(serving/layout.py): qkv/gate/up are column-parallel, each shard runs
+its own heads' attention over its own KV-pool lanes, and one tiled
+``all_gather`` reassembles the (b, cols) activations before the
+replicated full-width o/down projections — the mp=1 float ops exactly,
+in the same order. Sampling and the per-slot ``fold_in(seed, count)``
+RNG streams stay replicated, so every token-parity pin in the rest of
+the suite transfers verbatim.
+
+The conftest forces an 8-device CPU host, so ``mesh_of({"mp": 2})``
+here is a real 2-shard mesh (forced-host-device parity — the same
+programs a v5e/v5p mesh runs, minus the fast interconnect). The matrix:
+greedy+sampled x bf16+int8 x chunked x speculative vs mp=1, through
+preempt/resume and snapshot/restore onto a DIFFERENT mesh shape
+(snapshots are host-canonical and mesh-free by contract). Heavy combos
+ride @slow.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.parallel.topology import build_mesh
+from paddle_tpu.serving.layout import ServingLayout
+
+
+def mesh_of(axis_dims):
+    """Submesh over the first prod(dims) of the conftest's 8
+    forced host devices (build_mesh wants an exact device list)."""
+    import jax
+    n = int(np.prod(list(axis_dims.values())))
+    return build_mesh(axis_dims, devices=jax.devices()[:n])
+
+
+def tiny_llama(L=3):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=L,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return cfg, m
+
+
+def tiny_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                    num_heads=2, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle_tpu.seed(0)
+    g = GPTPretrainModel(cfg)
+    g.eval()
+    return cfg, g
+
+
+def draft_llama():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=1,
+                      num_heads=4, num_kv_heads=4, intermediate_size=128,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(1)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return m
+
+
+PROMPTS = [list(range(1, 40)), [7, 8, 9], list(range(50, 75))]
+
+
+def run(eng, prompts=PROMPTS, max_new=8):
+    """Token lists in SUBMISSION order — request ids are minted from a
+    module-global counter, so cross-engine comparisons must be
+    positional, never keyed by id."""
+    rids = [eng.submit(serving.Request(np.asarray(p, np.int32), max_new,
+                                       seed=100 + i))
+            for i, p in enumerate(prompts)]
+    eng.drain()
+    return [list(map(int, eng.results[r].tokens)) for r in rids]
+
+
+def assert_mp_parity(model, mesh=None, prompts=PROMPTS, **kw):
+    """mp=1 vs sharded engine over the same workload: identical."""
+    mesh = mesh if mesh is not None else mesh_of({"mp": 2})
+    e1 = serving.ServingEngine(model, max_slots=4, block_tokens=16,
+                               max_seq_len=128, eos_token_id=None, **kw)
+    o1 = run(e1, prompts)
+    e1.close()
+    e2 = serving.ServingEngine(model, max_slots=4, block_tokens=16,
+                               max_seq_len=128, eos_token_id=None,
+                               mesh=mesh, **kw)
+    assert e2.mesh is mesh and e2._mp == mesh.shape.get("mp", 1)
+    o2 = run(e2, prompts)
+    e2.close()
+    assert o1 == o2, (o1, o2)
+    return o1
+
+
+# ------------------------------------------------------ the parity matrix
+
+def test_mp2_parity_greedy_bf16():
+    _, m = tiny_llama()
+    assert_mp_parity(m)
+
+
+def test_mp2_parity_sampled_bf16():
+    _, m = tiny_llama()
+    assert_mp_parity(m, temperature=0.8, top_k=40)
+
+
+def test_mp2_parity_greedy_int8():
+    _, m = tiny_llama()
+    assert_mp_parity(m, cache_dtype=jnp.int8)
+
+
+def test_mp2_parity_chunked_bf16():
+    _, m = tiny_llama()
+    assert_mp_parity(m, chunk_tokens=16)
+
+
+def test_mp2_parity_ngram_spec():
+    _, m = tiny_llama()
+    assert_mp_parity(m, speculate=serving.SpecConfig(k=3,
+                                                     proposer="ngram"))
+
+
+def test_mp2_parity_gpt():
+    _, g = tiny_gpt()
+    assert_mp_parity(g, prompts=[[1, 2, 3, 4, 5], [7, 8, 9],
+                                 list(range(20, 45))])
+
+
+def test_fsdp2_parity_chunked():
+    # fsdp shards the layer dim, so L must divide
+    _, m = tiny_llama(L=4)
+    assert_mp_parity(m, mesh=mesh_of({"fsdp": 2}), chunk_tokens=16)
+
+
+@pytest.mark.slow
+def test_mp2_parity_sampled_int8():
+    _, m = tiny_llama()
+    assert_mp_parity(m, temperature=0.8, top_k=40, cache_dtype=jnp.int8)
+
+
+@pytest.mark.slow
+def test_mp2_parity_chunked_int8():
+    _, m = tiny_llama()
+    assert_mp_parity(m, chunk_tokens=16, cache_dtype=jnp.int8)
+
+
+@pytest.mark.slow
+def test_mp2_parity_draft_spec():
+    _, m = tiny_llama()
+    assert_mp_parity(m, speculate=serving.SpecConfig(
+        k=3, proposer="draft", draft_model=draft_llama()))
+
+
+@pytest.mark.slow
+def test_mp2_parity_chunked_spec_int8():
+    _, m = tiny_llama()
+    assert_mp_parity(m, chunk_tokens=16, cache_dtype=jnp.int8,
+                     speculate=serving.SpecConfig(k=3, proposer="ngram"))
+
+
+@pytest.mark.slow
+def test_mp4_fsdp2_parity():
+    # the composed submesh: heads split 4 ways, layers split 2 ways
+    _, m = tiny_llama(L=4)
+    assert_mp_parity(m, mesh=mesh_of({"fsdp": 2, "mp": 4}))
+
+
+# -------------------------------------------- scheduling events, sharded
+
+def test_mp2_preempt_resume_parity():
+    """A priority preemption + token-exact resume at mp=2 replays the
+    same schedule (and the same tokens) as the mp=1 engine — resume
+    state is host-canonical, so the re-prefill re-enters the sharded
+    programs with identical inputs."""
+    _, m = tiny_llama()
+    rng = np.random.RandomState(25)
+    lp = rng.randint(3, 512, (21,))
+    hp = rng.randint(3, 512, (9,))
+
+    def preempt_run(mesh):
+        eng = serving.ServingEngine(m, max_slots=1, block_tokens=16,
+                                    max_seq_len=64, chunk_tokens=16,
+                                    mesh=mesh)
+        rl = eng.submit(serving.Request(lp, max_new_tokens=10, seed=101,
+                                        priority="low"))
+        for _ in range(5):
+            eng.step()
+        rh = eng.submit(serving.Request(hp, max_new_tokens=4, seed=202,
+                                        priority="high"))
+        eng.drain(max_steps=300)
+        assert eng.stats["preemptions"] == 1
+        out = (eng.results[rl].tokens.tolist(),
+               eng.results[rh].tokens.tolist())
+        eng.close()
+        return out
+
+    assert preempt_run(None) == preempt_run(mesh_of({"mp": 2}))
+
+
+def test_mp2_snapshot_restore_cross_mesh():
+    """Snapshots are MESH-FREE: a mid-flight mp=2 snapshot restores
+    byte-compatibly onto mp=1, onto fsdp=2, and back onto mp=2 — each
+    restored engine finishes with the exact tokens the uninterrupted
+    mp=2 engine emits, and re-snapshots canonically."""
+    from paddle_tpu.analysis.runtime import compare_snapshots
+    _, m = tiny_llama(L=4)
+    mesh = mesh_of({"mp": 2})
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=128, eos_token_id=None,
+                                mesh=mesh)
+    rids = [eng.submit(serving.Request(np.asarray(p, np.int32), 8,
+                                       seed=100 + i))
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(3):
+        eng.step()
+    snap = eng.snapshot()
+    eng.drain()
+    ref = [list(map(int, eng.results[r].tokens)) for r in rids]
+    eng.close()
+    for kw in ({}, {"mesh": mesh_of({"fsdp": 2})}, {"mesh": mesh}):
+        er = serving.ServingEngine.restore(m, snap, **kw)
+        er.drain()
+        got = [list(map(int, er.results[r].tokens)) for r in rids]
+        assert got == ref, (kw, got, ref)
+        snap2 = er.snapshot()
+        er.close()
+        # canonical protocol state survives the mesh hop minus the
+        # finished work: compare the CONFIG sections (pool geometry,
+        # sampling, speculate) — mesh must not leak into any of them
+        assert "mesh" not in snap["config"] \
+            and "mesh" not in snap2["config"]
+
+
+def test_router_replicas_ride_the_mesh():
+    """Router(mesh=...) hands every replica (initial AND add_replica'd)
+    the same mesh; the warmup runs under the replica's own mesh context
+    (asserted inside add_replica) and tier traffic stays token-exact
+    vs an unsharded tier."""
+    _, m = tiny_llama()
+    mesh = mesh_of({"mp": 2})
+
+    def tier_run(**kw):
+        r = serving.Router(m, replicas=1, snapshot_every=None,
+                           max_slots=2, block_tokens=16, max_seq_len=64,
+                           eos_token_id=None, **kw)
+        r.add_replica(warm=True)
+        rids = [r.submit(serving.Request(np.asarray(p, np.int32), 6,
+                                         seed=100 + i))
+                for i, p in enumerate([[1, 2, 3], [5, 6, 7, 8]])]
+        r.drain()
+        out = [list(map(int, r.results[q].tokens)) for q in rids]
+        for i in r.live_replicas:
+            eng = r.replica_engine(i)
+            assert (eng.mesh is mesh) == ("mesh" in kw)
+        r.close()
+        return out
+
+    assert tier_run() == tier_run(mesh=mesh)
+
+
+# ------------------------------------------------- layout + construction
+
+def test_degree1_mesh_collapses_to_unsharded_engine():
+    """mp=1 engines take the EXACT pre-PR program path: a degree-1 mesh
+    normalizes to mesh=None at construction, so the jit cache, program
+    set and donation signatures are byte-identical to an engine that
+    never heard of meshes."""
+    _, m = tiny_llama()
+    eng = serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                                max_seq_len=64,
+                                mesh=mesh_of({"mp": 1}))
+    assert eng.mesh is None and eng.layout is None and eng._mp == 1
+    run(eng, [[1, 2, 3]], max_new=4)
+    eng.close()
+
+
+def test_layout_validation_rejects_bad_degrees():
+    _, m = tiny_llama()          # 4 heads, 3 layers
+    with pytest.raises(ValueError, match="num_heads"):
+        serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                              max_seq_len=64,
+                              mesh=mesh_of({"mp": 8}))
+    with pytest.raises(ValueError, match="num_layers"):
+        serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                              max_seq_len=64,
+                              mesh=mesh_of({"fsdp": 2}))
+
+
+def test_layout_rejects_foreign_replica_axes():
+    # a serving replica shards over mp/fsdp only — data parallelism
+    # belongs to Router replicas, not this mesh
+    with pytest.raises(ValueError, match="dp"):
+        ServingLayout(mesh_of({"dp": 2, "mp": 2}))
+    with pytest.raises(ValueError, match="neither"):
+        ServingLayout(mesh_of({"dp": 2}))
+
+
+def test_layout_specs_shape():
+    mesh = mesh_of({"mp": 2})
+    lay = ServingLayout(mesh)
+    assert lay.mp == 2 and lay.fsdp == 1 and lay.fsdp_axis is None
+    from jax.sharding import PartitionSpec as P
+    assert lay.pool_spec() == P(None, None, None, "mp")
+    assert lay.kv_scales_spec() == P(None, None, "mp")
+    stacked = {"wqkv": np.zeros((2, 8, 24)), "wo": np.zeros((2, 8, 8)),
+               "wg": np.zeros((2, 8, 16))}
+    specs = lay.stacked_specs(stacked)
+    assert specs["wqkv"] == P(None, None, "mp")      # column-parallel
+    assert specs["wo"] == P(None, None, None)        # replicated full
+    assert specs["wg"] == P(None, None, "mp")
+
+
+def test_mismatched_layout_mesh_rejected():
+    import jax
+    _, m = tiny_llama()
+    mesh = mesh_of({"mp": 2})                     # devices 0,1
+    lay = ServingLayout(                          # a DIFFERENT mesh:
+        build_mesh({"mp": 2}, devices=jax.devices()[2:4]))
+    with pytest.raises(ValueError):
+        serving.ServingEngine(m, max_slots=2, block_tokens=16,
+                              max_seq_len=64, mesh=mesh, layout=lay)
+
+
+# ------------------------------------------------- draft embedding share
+
+def test_draft_shares_target_embedding_table():
+    """satellite: a same-shape draft rebinds its embedding table to the
+    TARGET's array (one device buffer; through tied_unembed it is the
+    draft's unembedding too) — and the share is bit-inert, so it is on
+    by default. share_embeddings=False keeps separate buffers."""
+    _, m = tiny_llama()
+    key = "model.embed_tokens.weight"
+
+    def build(share):
+        return serving.ServingEngine(
+            m, max_slots=2, block_tokens=16, max_seq_len=64,
+            eos_token_id=None,
+            speculate=serving.SpecConfig(k=2, proposer="draft",
+                                         draft_model=draft_llama(),
+                                         share_embeddings=share))
+
+    e1 = build(True)
+    assert e1._draft_state[key] is e1._state[key]
+    o1 = run(e1, [[1, 2, 3, 1, 2, 3, 1, 2]], max_new=6)
+    e1.close()
+    e2 = build(False)
+    assert e2._draft_state[key] is not e2._state[key]
+    o2 = run(e2, [[1, 2, 3, 1, 2, 3, 1, 2]], max_new=6)
+    e2.close()
+    assert o1 == o2      # the share is bit-inert
+
+    # serialized in SpecConfig.to_config (snapshot round trips it)
+    assert serving.SpecConfig(k=2).to_config()["share_embeddings"] is True
