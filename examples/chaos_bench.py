@@ -23,7 +23,13 @@ Exit contract (the acceptance bar, enforced with a non-zero exit):
 * **reported shedding** — the final ``paddle_tpu.bench/v1`` record
   carries ``shed_rate``, ``preemptions``, ``restores`` and
   ``lost_requests`` (== 0), and the flight ring/dump holds the
-  preempt/shed/restore markers a postmortem would replay.
+  preempt/shed/restore markers a postmortem would replay;
+* **trace continuity** (``--replicas`` mode) — every accepted
+  request's journal events must form ONE connected ``trace_id`` chain
+  (accept/place/finish all carry the same id — a migration off a
+  killed replica must not fork the chain); a broken chain exits 4.
+  ``--timeline out.json`` additionally exports the run as a
+  Perfetto-loadable timeline (docs/OBSERVABILITY.md §Timelines).
 
 Run::
 
@@ -48,8 +54,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from load_bench import calibrate, gen_arrivals, make_requests
-from serving_bench import (add_mesh_args, build_engine_mesh, build_model,
-                           build_speculate, mesh_fields)
+from serving_bench import (add_mesh_args, add_timeline_arg,
+                           build_engine_mesh, build_model,
+                           build_speculate, mesh_fields, timeline_fields)
 
 
 def engine_kwargs(ns, flight_dump, speculate=None):
@@ -280,6 +287,7 @@ def main():
     ap.add_argument("--snapshot_dir", default=None)
     ap.add_argument("--flight_dump", default=None)
     add_mesh_args(ap)
+    add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -405,6 +413,22 @@ def main():
         for evt in eng.flight.events():
             _count(evt)
 
+    # trace-continuity gate (router mode): every accepted request's
+    # journal events must form ONE connected trace_id chain — a
+    # failover/drain migration that re-minted (or dropped) the id is an
+    # orphan fragment and fails the run with exit code 4
+    journal_path = (os.path.join(snap_root, "journal.jsonl")
+                    if ns.replicas > 1 else None)
+    trace_problems = []
+    if journal_path and os.path.isfile(journal_path):
+        from paddle_tpu.observability.timeline import \
+            verify_trace_continuity
+        from paddle_tpu.serving.journal import RouterJournal
+        events, _corrupt = RouterJournal.replay(journal_path)
+        trace_problems = verify_trace_continuity(
+            events, accepted_rids=accepted, require_finish=True)
+    tfields = timeline_fields(ns, eng, journal_path=journal_path)
+
     parity_checked = 0
     if ns.verify and eng.temperature == 0.0:
         from paddle_tpu.inference import generate
@@ -448,7 +472,7 @@ def main():
             "serving.snapshot_roundtrips"),
         lost_requests=len(lost), finishes=finishes,
         flight_markers=markers, parity_checked=parity_checked,
-        **mesh_fields(ns, build_engine_mesh(ns)),
+        **mesh_fields(ns, build_engine_mesh(ns)), **tfields,
         wall_s=round(wall, 3))
     print(json.dumps(rec))
     eng.close()
@@ -473,6 +497,13 @@ def main():
             print(f"# {kills} kills but only {failovers} failovers — "
                   f"a dead replica was never rebuilt", file=sys.stderr)
             sys.exit(1)
+    if trace_problems:
+        for p in trace_problems[:10]:
+            print(f"# TRACE CHAIN BROKEN: {p}", file=sys.stderr)
+        print(f"# {len(trace_problems)} trace-continuity problem(s) — "
+              f"a request's journal events do not form one connected "
+              f"trace_id chain", file=sys.stderr)
+        sys.exit(4)
     print(f"# zero loss across {restores} restores / {fired} faults"
           + (f" / {kills} replica kills" if kills else "")
           + f"; shed {shed}/{ns.requests}, parity x{parity_checked} OK",

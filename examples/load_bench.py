@@ -75,9 +75,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serving_bench import (add_mesh_args, build_engine_mesh, build_model,
+from serving_bench import (add_mesh_args, add_timeline_arg,
+                           build_engine_mesh, build_model,
                            build_speculate, mesh_fields, spec_fields,
-                           spec_hist_base)
+                           spec_hist_base, timeline_fields)
 
 
 def parse_priority_mix(spec):
@@ -329,6 +330,7 @@ def main():
                     "--slo_tpot_s (requires --chunk_tokens as the cold "
                     "default)")
     add_mesh_args(ap)
+    add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -450,7 +452,10 @@ def main():
         knee_goodput=ns.knee_goodput,
         knee_load_mult=knee["load_mult"] if knee else None,
         prompt_mix=ns.prompt_mix, chunk_tokens=ns.chunk_tokens,
-        calibrated_capacity_rps=round(cap_rps, 4), curve=curve)
+        calibrated_capacity_rps=round(cap_rps, 4), curve=curve,
+        # the flight ring (and results) cover the LAST sweep point —
+        # the timeline is that point's postmortem window
+        **timeline_fields(ns, eng))
     print(json.dumps(rec))
     eng.close()         # free the KV pool (long sweeps, repeated runs)
 

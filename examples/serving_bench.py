@@ -211,6 +211,57 @@ def mesh_fields(ns, mesh):
                             for k, v in mesh.shape.items()})
 
 
+def add_timeline_arg(ap):
+    """--timeline flag shared by serving_bench/load_bench/chaos_bench."""
+    ap.add_argument("--timeline", default=None, metavar="OUT.json",
+                    help="write a Perfetto-loadable Chrome trace-event "
+                    "timeline of the run: flight-ring tick segments, "
+                    "per-request instants and trace_id flow chains "
+                    "(plus the router journal in --replicas mode — "
+                    "docs/OBSERVABILITY.md §Timelines); the bench "
+                    "record gains timeline_path/trace_count")
+
+
+def timeline_fields(ns, eng, journal_path=None):
+    """Write ``--timeline`` (empty dict when unset) and return the
+    BENCH fields ``{timeline_path, trace_count}``. ``eng`` is a
+    ServingEngine or the Router — a router contributes its own flight
+    ring plus one process track per replica engine, and the replayed
+    request journal when the tier keeps one at ``journal_path``. The
+    flight rings cover their engines' LAST ``flight_capacity`` ticks
+    (and, single-engine chaos, only the latest restore incarnation) —
+    the timeline is a postmortem window, not a full-run archive."""
+    if not getattr(ns, "timeline", None):
+        return {}
+    from paddle_tpu.observability import timeline as tl
+    from paddle_tpu.serving.journal import RouterJournal
+
+    anchor = tl.clock_anchor()
+    trace_map = {rid: res.trace_id for rid, res in eng.results.items()
+                 if getattr(res, "trace_id", None)}
+    if hasattr(eng, "replica_engine"):          # Router tier
+        processes = [{"name": "router", "flight": eng.flight.events(),
+                      "anchor": anchor}]
+        for i in range(eng.num_replicas):
+            rep = eng.replica_engine(i)
+            if rep is not None:
+                processes.append({"name": f"replica_{i}",
+                                  "flight": rep.flight.events(),
+                                  "anchor": anchor})
+    else:
+        processes = [{"name": "engine", "flight": eng.flight.events(),
+                      "anchor": anchor}]
+    journal = ()
+    if journal_path and os.path.isfile(journal_path):
+        journal, _corrupt = RouterJournal.replay(journal_path)
+    info = tl.write_timeline(ns.timeline, processes=processes,
+                             journal=journal, trace_map=trace_map)
+    print(f"# timeline: {info['path']} ({info['events']} events, "
+          f"{info['trace_count']} trace chains)", file=sys.stderr)
+    return dict(timeline_path=info["path"],
+                trace_count=info["trace_count"])
+
+
 def spec_hist_base(ns):
     """Snapshot of the serving.spec_accepted_len bucket counts, taken
     BEFORE a measured pass so ``spec_fields(hist_base=...)`` can report
@@ -359,6 +410,7 @@ def main():
                     "replicated tier (serving.Router over N engine "
                     "replicas) instead of one engine")
     add_mesh_args(ap)
+    add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
 
@@ -466,6 +518,7 @@ def main():
                      else eng.pool.num_blocks - 1),
         block_tokens=ns.block_tokens, **spec_fields(eng, ns),
         **mesh_fields(ns, build_engine_mesh(ns)),
+        **timeline_fields(ns, eng),
         **slo.bench_fields(), **common)))
     eng.close()         # free the KV pool (back-to-back bench runs)
 

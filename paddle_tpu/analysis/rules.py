@@ -37,6 +37,13 @@ Rule catalog (docs/ANALYSIS.md has the workflow):
     docs/OBSERVABILITY.md (the PR 7 drift grep, promoted to a rule —
     tests/test_slo.py delegates here).
 
+``span-drift``
+    Every ``serving.``/``decode.`` span-name literal
+    (``tracer.span(...)`` / ``tr.record(...)``) must appear in
+    docs/OBSERVABILITY.md's span table — metric-drift's twin for the
+    tracing plane, so timeline output never carries spans a reader
+    cannot look up.
+
 ``fault-site``
     ``maybe_fire(...)`` / ``Fault(...)`` site literals must be
     registered in ``resilience.faults.KNOWN_SITES`` — an unregistered
@@ -123,7 +130,8 @@ import re
 from typing import Dict, Iterator, List, Optional, Set
 
 __all__ = ["Finding", "ALL_RULES", "KERNEL_DIRS", "SNAPSHOT_OWNED",
-           "collect_metric_names", "known_fault_sites",
+           "collect_metric_names", "collect_span_names",
+           "known_fault_sites",
            "known_journal_events", "known_mesh_axes", "run_rules"]
 
 KERNEL_DIRS = ("paddle_tpu/ops", "paddle_tpu/inference",
@@ -146,6 +154,14 @@ _TRACED_JAX_SUBMODULES = {"nn", "random", "numpy", "lax", "scipy"}
 _METRIC_CALL = re.compile(
     r'(?:counter|gauge|histogram|sketch)\(\s*'
     r'"((?:serving|resilience|decode)\.[a-z0-9_.]+)"')
+
+# span-name literals — Tracer span/record calls whose first argument
+# is a ``serving.``/``decode.``-prefixed string: the span-drift rule
+# pins every one against the span table in docs/OBSERVABILITY.md,
+# exactly like _METRIC_CALL pins metric names
+_SPAN_CALL = re.compile(
+    r'(?:\.record|\.span|record_span)\(\s*'
+    r'"((?:serving|decode)\.[a-z0-9_.]+)"')
 
 
 class Finding:
@@ -628,6 +644,40 @@ def check_metric_drift(sources: Dict[str, str], docs_text: str,
             findings.append(Finding(
                 "metric-drift", path, line, 0,
                 f"metric {name!r} is not documented in "
+                f"docs/OBSERVABILITY.md", line_lookup(path, line)))
+    return findings
+
+
+# ----------------------------------------------------------- span-drift
+
+def collect_span_names(sources: Dict[str, str]) -> Dict[str, List]:
+    """name -> [(path, line)] for every ``serving.``/``decode.`` span
+    literal created in the package (``tracer.span("...")`` /
+    ``tr.record("...")``). The span twin of
+    :func:`collect_metric_names` — whole-file scan, wrapped calls
+    included."""
+    names: Dict[str, List] = {}
+    for path, src in sources.items():
+        for m in _SPAN_CALL.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            names.setdefault(m.group(1), []).append((path, line))
+    return names
+
+
+def check_span_drift(sources: Dict[str, str], docs_text: str,
+                     line_lookup) -> List[Finding]:
+    """Every span-name literal must appear in docs/OBSERVABILITY.md's
+    span table — an undocumented span is timeline output a reader
+    cannot interpret, the exact drift metric-drift catches for metric
+    names."""
+    findings = []
+    for name, sites in sorted(collect_span_names(sources).items()):
+        if name in docs_text:
+            continue
+        for path, line in sites:
+            findings.append(Finding(
+                "span-drift", path, line, 0,
+                f"span {name!r} is not documented in "
                 f"docs/OBSERVABILITY.md", line_lookup(path, line)))
     return findings
 
@@ -2228,9 +2278,9 @@ def _module_name(path: str) -> str:
 
 
 ALL_RULES = ("host-sync", "traced-branch", "default-dtype",
-             "metric-drift", "fault-site", "snapshot-coverage",
-             "journal-coverage", "rng-stream", "collective-axis",
-             "pspec-axis", "donation")
+             "metric-drift", "span-drift", "fault-site",
+             "snapshot-coverage", "journal-coverage", "rng-stream",
+             "collective-axis", "pspec-axis", "donation")
 
 
 def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
@@ -2251,10 +2301,12 @@ def run_rules(files: Dict[str, SourceFile], graph, docs_text: str,
     aggregate = {"journal-coverage": check_journal_coverage,
                  "rng-stream": check_rng_stream,
                  "donation": lambda fs: check_donation(fs, graph)}
+    docs_checks = {"metric-drift": check_metric_drift,
+                   "span-drift": check_span_drift}
     for rule in rules:
-        if rule == "metric-drift":
+        if rule in docs_checks:
             sources = {p: sf.source for p, sf in files.items()}
-            findings.extend(check_metric_drift(
+            findings.extend(docs_checks[rule](
                 sources, docs_text,
                 lambda p, ln: files[p].line_text(ln)))
             continue

@@ -23,6 +23,10 @@ One subsystem, four pieces (docs/OBSERVABILITY.md has the full story):
   serving-engine events, auto-dumped to JSONL at the resilience seams
   (fired fault / `PoolExhausted` / deadline retirement) for
   postmortems.
+* **Timeline export** (`timeline.py`): folds spans + flight rings +
+  the router journal into Chrome trace-event JSON (Perfetto-loadable)
+  with per-replica process tracks and `trace_id`-keyed flow arrows —
+  plus the trace-continuity checker the chaos harness gates on.
 
 Roofline attribution lives with the xplane parser:
 `paddle_tpu.profiler.roofline_report(log_dir, plan)`.
@@ -40,15 +44,19 @@ from paddle_tpu.observability.schema import (     # noqa: F401
     validate_roofline_plan,
 )
 from paddle_tpu.observability.slo import (        # noqa: F401
-    QuantileSketch, SLOReport,
+    QuantileSketch, SLOReport, BurnRateWatchdog,
 )
 from paddle_tpu.observability.flight import (     # noqa: F401
     FLIGHT_SCHEMA, FlightRecorder,
+)
+from paddle_tpu.observability.timeline import (   # noqa: F401
+    build_timeline, write_timeline, verify_trace_continuity, clock_anchor,
 )
 from paddle_tpu.observability import flight       # noqa: F401
 from paddle_tpu.observability import memory       # noqa: F401
 from paddle_tpu.observability import schema       # noqa: F401
 from paddle_tpu.observability import slo          # noqa: F401
+from paddle_tpu.observability import timeline     # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -57,6 +65,9 @@ __all__ = [
     "run_traced_decode",
     "BENCH_SCHEMA", "bench_record", "validate_bench", "validate_spans",
     "validate_roofline_plan",
-    "QuantileSketch", "SLOReport", "FLIGHT_SCHEMA", "FlightRecorder",
-    "flight", "memory", "schema", "slo",
+    "QuantileSketch", "SLOReport", "BurnRateWatchdog",
+    "FLIGHT_SCHEMA", "FlightRecorder",
+    "build_timeline", "write_timeline", "verify_trace_continuity",
+    "clock_anchor",
+    "flight", "memory", "schema", "slo", "timeline",
 ]

@@ -69,11 +69,15 @@ class FlightRecorder:
             self._n += 1
 
     def mark(self, kind: str, **fields):
-        """Record a non-step marker event (``{"kind": kind, "ts": ...}``
-        + fields) — engine restores, operator annotations. Markers ride
-        the same ring as step events, so a dump shows them in sequence
-        with the scheduler ticks around them."""
-        evt = {"kind": kind, "ts": round(time.time(), 6)}
+        """Record a non-step marker event (``{"kind": kind, "ts": ...,
+        "ts_mono": ...}`` + fields) — engine restores, operator
+        annotations. Markers ride the same ring as step events, so a
+        dump shows them in sequence with the scheduler ticks around
+        them. ``ts`` is wall-clock (cross-process timeline alignment),
+        ``ts_mono`` is ``perf_counter`` (monotonic ordering + exact
+        deltas against span clocks, immune to wall-clock steps)."""
+        evt = {"kind": kind, "ts": round(time.time(), 6),
+               "ts_mono": round(time.perf_counter(), 6)}
         evt.update(fields)
         self.record(evt)
 

@@ -22,7 +22,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "RegistryView",
     "registry", "set_default_labels", "DEFAULT_BUCKETS",
 ]
 
@@ -234,10 +234,62 @@ class MetricsRegistry:
         return self._get(QuantileSketch, name, labels,
                          relative_accuracy=relative_accuracy)
 
+    def view(self, **labels) -> "RegistryView":
+        """A label-stamping facade over THIS registry: every metric
+        created through the view carries ``labels`` merged under the
+        caller's own. Storage stays here — ``counter_total`` /
+        ``snapshot`` / exporters see the view's series like any other —
+        so a Router can tag each replica engine's series
+        (``view(replica="0")``) without forking the registry or
+        threading labels through every call site."""
+        return RegistryView(self, labels)
+
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> List[dict]:
         return [m.snapshot() for m in list(self._metrics.values())]
+
+    def series(self, name: str, kind: Optional[str] = None) -> List:
+        """Every live instrument registered under ``name`` (one per
+        label set), optionally filtered by kind — the tier-merge and
+        burn-rate consumers' accessor."""
+        return [m for (n, k, _), m in list(self._metrics.items())
+                if n == name and (kind is None or k == kind)]
+
+    def merged_across(self, label: str) -> "MetricsRegistry":
+        """A NEW registry with the given label collapsed: counters
+        summed, histograms added bucket-wise, sketches merged
+        (``QuantileSketch.merge`` — same relative-accuracy bound as one
+        sketch over the pooled samples). Gauges are last-value samples
+        — summing replicas' queue depths into one number would fake a
+        gauge nobody set — so they KEEP the label, one labeled series
+        per replica. Series never carrying ``label`` pass through
+        unchanged. The result is a plain registry: ``export_jsonl`` /
+        ``prometheus_text`` work on it directly
+        (``Router.metrics_snapshot`` is this over ``"replica"``)."""
+        out = MetricsRegistry()
+        for (name, kind, _), m in sorted(list(self._metrics.items()),
+                                         key=lambda kv: kv[0]):
+            labels = dict(m.labels)
+            if kind != "gauge":
+                labels.pop(label, None)
+            if kind == "counter":
+                out.counter(name, **labels).inc(m.value)
+            elif kind == "gauge":
+                out.gauge(name, **labels).set(m.value)
+            elif kind == "histogram":
+                h = out.histogram(name, buckets=m.bounds, **labels)
+                with m._lock:
+                    counts, s, c = list(m.counts), m.sum, m.count
+                for i, cv in enumerate(counts):
+                    h.counts[i] += cv
+                h.sum += s
+                h.count += c
+            elif kind == "sketch":
+                out.sketch(name,
+                           relative_accuracy=m.relative_accuracy,
+                           **labels).merge(m)
+        return out
 
     def counter_total(self, name: str) -> int:
         """Sum a counter across every label set it was created with —
@@ -310,6 +362,52 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
             self._default_labels.clear()
+
+
+class RegistryView:
+    """Label-stamping facade returned by :meth:`MetricsRegistry.view`.
+
+    Quacks like the registry for the metric-producing surface
+    (``counter``/``gauge``/``histogram``/``sketch`` — the only methods
+    hot paths touch) and delegates storage to the backing registry with
+    the view's labels merged UNDER per-call labels (a caller's explicit
+    label wins). Reading/exporting goes through the backing registry.
+    """
+
+    __slots__ = ("_reg", "_labels")
+
+    def __init__(self, reg: MetricsRegistry, labels: Dict):
+        self._reg = reg
+        self._labels = {str(k): str(v) for k, v in labels.items()}
+
+    @property
+    def backing(self) -> MetricsRegistry:
+        return self._reg
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+    def _merged(self, labels: Dict) -> Dict:
+        merged = dict(self._labels)
+        merged.update(labels)
+        return merged
+
+    def counter(self, name: str, /, **labels) -> Counter:
+        return self._reg.counter(name, **self._merged(labels))
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        return self._reg.gauge(name, **self._merged(labels))
+
+    def histogram(self, name: str, /, buckets: Optional[Tuple] = None,
+                  **labels) -> Histogram:
+        return self._reg.histogram(name, buckets=buckets,
+                                   **self._merged(labels))
+
+    def sketch(self, name: str, /,
+               relative_accuracy: Optional[float] = None, **labels):
+        return self._reg.sketch(name, relative_accuracy=relative_accuracy,
+                                **self._merged(labels))
 
 
 def _prom_name(name: str) -> str:

@@ -65,6 +65,11 @@ _BENCH_OPTIONAL = {
     "preemptions": numbers.Integral,
     "restores": numbers.Integral,
     "lost_requests": numbers.Integral,
+    # timeline-export fields (--timeline out.json on the serving
+    # benches): where the Perfetto-loadable trace-event JSON landed
+    # and how many distinct trace_id chains it carries
+    "timeline_path": str,
+    "trace_count": numbers.Integral,
     # chunked-prefill fields (load_bench/serving_bench --chunk_tokens):
     # chunk_tokens = the engine's chunk size (null = monolithic wave
     # prefill), prefill_chunks = chunk programs run over the measured

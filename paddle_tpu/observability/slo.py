@@ -30,11 +30,15 @@ method="inverted_cdf")`` convention — for any distribution whose
 values lie in ``[min_value, max_value]``.
 """
 
+import logging
 import math
 import threading
 from typing import Dict, Optional, Tuple
 
-__all__ = ["QuantileSketch", "SLOReport", "DEFAULT_QUANTILES"]
+logger = logging.getLogger("paddle_tpu.observability")
+
+__all__ = ["QuantileSketch", "SLOReport", "BurnRateWatchdog",
+           "DEFAULT_QUANTILES"]
 
 # the quantiles snapshot()/prometheus export answer by default
 DEFAULT_QUANTILES = (0.5, 0.9, 0.95, 0.99)
@@ -132,6 +136,63 @@ class QuantileSketch:
                     return min(max(est, self.min), self.max)
             return self.max
 
+    def count_above(self, v) -> int:
+        """Observations above ``v``, answered to bucket granularity:
+        whole buckets strictly above the one containing ``v`` — so the
+        miscount is confined to the threshold's own bucket, i.e. to
+        observations within ``relative_accuracy`` of ``v``. The SLO
+        burn-rate numerator (:class:`BurnRateWatchdog`)."""
+        v = float(v)
+        with self._lock:
+            if self.count == 0:
+                return 0
+            if v < 0.0:
+                return self.count
+            if v <= self._min_value:
+                return self.count - self.zero_count
+            return sum(self.counts[self._index(v) + 1:])
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other``'s observations into this sketch, bucket-wise.
+        Exact in the DDSketch sense: merging per-replica sketches then
+        asking a quantile is within ``relative_accuracy`` of the
+        pooled-sample quantile, same bound as a single sketch (the
+        ``Router.metrics_snapshot`` merge relies on it — pinned by the
+        property test in tests/test_slo.py). Both sketches must share
+        the bucket geometry (``relative_accuracy`` and the
+        ``[min_value, max_value]`` range)."""
+        if not isinstance(other, QuantileSketch):
+            raise TypeError(f"cannot merge {type(other).__name__} into a "
+                            f"QuantileSketch")
+        if (other.relative_accuracy != self.relative_accuracy
+                or other._min_value != self._min_value
+                or other._max_value != self._max_value):
+            raise ValueError(
+                f"sketch geometry mismatch: cannot merge "
+                f"(a={other.relative_accuracy}, range=[{other._min_value}, "
+                f"{other._max_value}]) into (a={self.relative_accuracy}, "
+                f"range=[{self._min_value}, {self._max_value}])")
+        # copy under other's lock, fold under ours — never hold both
+        # (two registries merging into each other must not deadlock)
+        with other._lock:
+            o_counts = list(other.counts)
+            o_zero, o_count, o_sum = (other.zero_count, other.count,
+                                      other.sum)
+            o_min, o_max = other.min, other.max
+        with self._lock:
+            for i, c in enumerate(o_counts):
+                self.counts[i] += c
+            self.zero_count += o_zero
+            self.count += o_count
+            self.sum += o_sum
+            if o_min is not None:
+                self.min = o_min if self.min is None \
+                    else min(self.min, o_min)
+            if o_max is not None:
+                self.max = o_max if self.max is None \
+                    else max(self.max, o_max)
+        return self
+
     def mean(self) -> Optional[float]:
         return self.sum / self.count if self.count else None
 
@@ -226,3 +287,127 @@ class SLOReport:
             out["slo_ttft_s"] = self.ttft_slo_s
             out["slo_tpot_s"] = self.tpot_slo_s
         return out
+
+
+class BurnRateWatchdog:
+    """Rolling-window SLO burn-rate tripwire over the registry sketches
+    (docs/OBSERVABILITY.md §Burn-rate watchdog).
+
+    The engines stream per-request TTFT/TPOT into the
+    ``serving.ttft_s`` / ``serving.tpot_s`` sketches; each
+    :meth:`check` reads the cumulative (count, violations-above-SLO)
+    totals across every label set of those sketches — so a
+    replica-labeled tier sums naturally — and differences them against
+    the previous check. The window is therefore "since the last check":
+
+        burn = (window violations / window samples) / error_budget
+
+    A burn of 1.0 means the tier is spending its error budget exactly
+    at the sustainable rate; above ``trip_burn`` the watchdog TRIPS:
+    it bumps ``serving.slo_watchdog_trips``, auto-dumps every flight
+    ring with a path configured (:func:`flight.auto_dump_all`), and —
+    when built with ``dump_dir`` — writes a Perfetto timeline slice of
+    the tripping source's flight ring so the postmortem starts with a
+    picture, not a grep. The per-window burns land in the
+    ``serving.slo_ttft_burn_rate`` / ``serving.slo_tpot_burn_rate``
+    gauges either way.
+
+    Wired as ``Router(watchdog=BurnRateWatchdog(...))``: the router
+    calls ``check(self)`` every ``check_every`` ticks. ``check`` never
+    raises — a broken dump sink must not kill the serving tick.
+    """
+
+    def __init__(self, ttft_slo_s: Optional[float] = None,
+                 tpot_slo_s: Optional[float] = None, *,
+                 error_budget: float = 0.1, trip_burn: float = 1.0,
+                 min_samples: int = 16, check_every: int = 8,
+                 dump_dir: Optional[str] = None, registry=None):
+        if ttft_slo_s is None and tpot_slo_s is None:
+            raise ValueError("BurnRateWatchdog needs at least one of "
+                             "ttft_slo_s / tpot_slo_s")
+        if not 0.0 < error_budget <= 1.0:
+            raise ValueError(f"error_budget must be in (0, 1], got "
+                             f"{error_budget}")
+        if check_every < 1 or min_samples < 1:
+            raise ValueError("check_every and min_samples must be >= 1")
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        self.error_budget = float(error_budget)
+        self.trip_burn = float(trip_burn)
+        self.min_samples = int(min_samples)
+        self.check_every = int(check_every)
+        self.dump_dir = dump_dir
+        self.registry = registry
+        self.trips = 0
+        self._last: Dict[str, Tuple[int, int]] = {}
+
+    def _registry(self):
+        from paddle_tpu.observability.registry import \
+            registry as default_registry
+        return self.registry if self.registry is not None \
+            else default_registry()
+
+    def _totals(self, name: str, slo: float) -> Tuple[int, int]:
+        """Cumulative (samples, violations-above-slo) summed over every
+        label set of sketch ``name`` — per-replica series included."""
+        count = viol = 0
+        for m in self._registry().series(name, kind="sketch"):
+            count += m.count
+            viol += m.count_above(slo)
+        return count, viol
+
+    def check(self, source=None) -> Dict:
+        """One watchdog pass. ``source`` (optional) is the tripping
+        tier — anything with a ``flight`` ring (the Router); its events
+        feed the timeline slice on a trip. Returns
+        ``{"burn": {...}, "tripped": [...]}``."""
+        reg = self._registry()
+        status: Dict = {"burn": {}, "tripped": []}
+        for key, metric, slo in (
+                ("ttft", "serving.ttft_s", self.ttft_slo_s),
+                ("tpot", "serving.tpot_s", self.tpot_slo_s)):
+            if slo is None:
+                continue
+            count, viol = self._totals(metric, slo)
+            last_c, last_v = self._last.get(key, (0, 0))
+            dc, dv = count - last_c, viol - last_v
+            if dc < self.min_samples:
+                continue        # window too thin to judge — keep it open
+            self._last[key] = (count, viol)
+            burn = (dv / dc) / self.error_budget
+            reg.gauge(f"serving.slo_{key}_burn_rate").set(round(burn, 4))
+            status["burn"][key] = burn
+            if burn > self.trip_burn:
+                status["tripped"].append(key)
+        if status["tripped"]:
+            self.trips += 1
+            reg.counter("serving.slo_watchdog_trips").inc()
+            self._on_trip(status, source)
+        return status
+
+    def _on_trip(self, status: Dict, source) -> None:
+        from paddle_tpu.observability import flight as _flight
+
+        reason = "slo_burn:" + ",".join(status["tripped"])
+        try:
+            fl = getattr(source, "flight", None)
+            if fl is not None:
+                fl.mark("slo_burn_trip",
+                        burn={k: round(v, 4)
+                              for k, v in status["burn"].items()},
+                        tripped=list(status["tripped"]))
+            _flight.auto_dump_all(reason)
+            if self.dump_dir is not None and fl is not None:
+                import os
+
+                from paddle_tpu.observability import timeline as _timeline
+                os.makedirs(self.dump_dir, exist_ok=True)
+                path = os.path.join(
+                    self.dump_dir, f"slo_trip_{self.trips}.json")
+                _timeline.write_timeline(
+                    path,
+                    processes=[{"name": getattr(fl, "name", "tier"),
+                                "flight": fl.events()}])
+                status["timeline_path"] = path
+        except Exception:   # noqa: BLE001 — diagnostics must not raise
+            logger.warning("SLO watchdog trip dump failed", exc_info=True)

@@ -108,6 +108,7 @@ import numbers
 import os
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -243,14 +244,15 @@ class Request:
     """
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "seed",
-                 "deadline_s", "priority", "_t_submit", "_t_first",
-                 "_resume_tokens", "_seq")
+                 "deadline_s", "priority", "trace_id", "_t_submit",
+                 "_t_first", "_resume_tokens", "_seq")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  seed: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  priority: str = "normal",
-                 request_id: Optional[int] = None):
+                 request_id: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         # tpu-lint: allow(host-sync): API boundary — prompts are host ids
         prompt = np.asarray(prompt)
         if not np.issubdtype(prompt.dtype, np.integer):
@@ -294,6 +296,15 @@ class Request:
         else:
             self.request_id = int(request_id)
             _note_req_id(self.request_id)
+        # causal trace id: minted ONCE at first construction, carried
+        # verbatim through preempt/resume, snapshot/restore, and router
+        # failover migration — every journal event / span / timeline
+        # fragment a request produces anywhere in the tier keys on it
+        # (docs/OBSERVABILITY.md §Request traces)
+        if trace_id is None:
+            self.trace_id = uuid.uuid4().hex[:16]
+        else:
+            self.trace_id = str(trace_id)
         self._t_submit: Optional[float] = None
         # preempt/resume state: the generated-so-far tokens a requeued
         # request re-prefills from (None = fresh), and the original
@@ -316,11 +327,12 @@ class RequestResult:
     full bounded queue — ``tokens`` is empty, ``ttft_s`` None)."""
 
     __slots__ = ("request_id", "prompt", "tokens", "gen_len", "finish",
-                 "ttft_s", "tpot_s", "prefix_hit_blocks")
+                 "ttft_s", "tpot_s", "prefix_hit_blocks", "trace_id")
 
     def __init__(self, request_id, prompt, tokens, gen_len, finish,
-                 ttft_s, tpot_s, prefix_hit_blocks):
+                 ttft_s, tpot_s, prefix_hit_blocks, trace_id=None):
         self.request_id = request_id
+        self.trace_id = trace_id
         self.prompt = prompt
         # tpu-lint: allow(host-sync): generated tokens are a host list
         self.tokens = np.asarray(tokens, np.int32)
@@ -641,6 +653,7 @@ class ServingEngine:
                  prefix_cache_blocks: int = 256,
                  flight_capacity: int = 256,
                  flight_dump_path: Optional[str] = None,
+                 metrics_labels: Optional[Dict] = None,
                  max_queue: Optional[int] = None,
                  shed_infeasible: bool = False,
                  chunk_tokens: Optional[int] = None,
@@ -653,6 +666,7 @@ class ServingEngine:
                  state: Optional[Dict] = None):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
+        from paddle_tpu.observability.registry import registry
 
         self.model = model
         self._state = state if state is not None else _inference_state(model)
@@ -1033,6 +1047,16 @@ class ServingEngine:
         self.flight = FlightRecorder(capacity=flight_capacity,
                                      auto_dump_path=flight_dump_path,
                                      name="serving-engine")
+        # metrics facade: the process-global registry, optionally
+        # wrapped in a label-stamping view (Router-built replicas pass
+        # metrics_labels={"replica": "<i>"} so one process's series
+        # stay distinguishable and merged_across("replica") can fold
+        # them back into the tier export). Storage stays in the global
+        # registry either way — counter_total / exporters see one pool.
+        # tpu-lint: volatile(telemetry facade; the Router re-stamps it
+        # via engine kwargs on restore/rebuild)
+        self._metrics = (registry().view(**metrics_labels)
+                         if metrics_labels else registry())
         self._step_seq = 0              # flight event ordinal
         # tpu-lint: volatile(flight-dump latch, per tick)
         self._dump_pending: Optional[str] = None
@@ -1201,8 +1225,7 @@ class ServingEngine:
         return jax.tree.map(lambda _: PartitionSpec(), tree)
 
     def _gauges_init(self):
-        from paddle_tpu.observability import registry
-        r = registry()
+        r = self._metrics
         r.gauge("serving.pool_blocks_total").set(self.pool.num_blocks - 1)
         r.gauge("serving.mp_degree").set(self._mp)
         r.gauge("serving.fsdp_degree").set(
@@ -1210,8 +1233,7 @@ class ServingEngine:
         self._update_gauges()
 
     def _update_gauges(self):
-        from paddle_tpu.observability import registry
-        r = registry()
+        r = self._metrics
         active = sum(s is not None for s in self._slots)
         r.gauge("serving.batch_occupancy").set(active / self.max_slots)
         r.gauge("serving.queue_depth").set(len(self._queue))
@@ -1262,8 +1284,7 @@ class ServingEngine:
 
     # ---------------------------------------------------------- submission
     def _count_rejected(self, request: Request, reason: str):
-        from paddle_tpu.observability import registry
-        registry().counter("serving.rejected", reason=reason).inc()
+        self._metrics.counter("serving.rejected", reason=reason).inc()
         self.stats["requests_rejected"] += 1
         # tpu-lint: allow(journal-coverage): submit-time rejection —
         # the request was never ACCEPTED, so the zero-loss journal owes
@@ -1281,7 +1302,6 @@ class ServingEngine:
         silently lost. A previously-preempted victim keeps the tokens
         it already generated (like a deadline cut), not an empty
         result."""
-        from paddle_tpu.observability import registry
         self._queue.remove(victim)
         toks = victim._resume_tokens or []
         ttft = (victim._t_first - victim._t_submit
@@ -1293,10 +1313,11 @@ class ServingEngine:
         # single-engine durability is the snapshot, which serializes
         # results
         res = RequestResult(victim.request_id, victim.prompt, toks,
-                            len(toks), "shed", ttft, None, 0)
+                            len(toks), "shed", ttft, None, 0,
+                            trace_id=victim.trace_id)
         self.results[victim.request_id] = res
         self._pending_finished.append(victim.request_id)
-        r = registry()
+        r = self._metrics
         r.counter("serving.rejected", reason=reason).inc()
         r.counter("serving.requests", finish="shed").inc()
         self.stats["requests_shed"] += 1
@@ -1645,8 +1666,6 @@ class ServingEngine:
         :meth:`estimated_ttft_s` pricing (a short admission's clamp,
         or a probe's unmeasured bucket, must not re-price every other
         queued prompt)."""
-        from paddle_tpu.observability import registry
-
         base = self.chunk_tokens
         if not self.chunk_autotune:
             return base
@@ -1716,7 +1735,7 @@ class ServingEngine:
                         self._chunk_probe_tries.get(nxt, 0) + 1)
                     pick = nxt
         self._chunk_choice = pricing
-        registry().gauge("serving.chunk_autotune").set(pricing)
+        self._metrics.gauge("serving.chunk_autotune").set(pricing)
         return pick
 
     def _make_chunk_groups(self, wave):
@@ -2075,7 +2094,6 @@ class ServingEngine:
         per COMPUTED token for the estimator), and the chunk-stall
         auto-dump trigger."""
         from paddle_tpu import observability as obs
-        from paddle_tpu.observability import registry
 
         CT = g.chunk
         n = g.n
@@ -2083,7 +2101,7 @@ class ServingEngine:
         for r, (slot_idx, s) in enumerate(g.rows):
             ntok = min(CT, len(s.feed) - start)
             self._tick_chunks.append((s.req.request_id, start, ntok))
-            registry().histogram(
+            self._metrics.histogram(
                 "serving.chunk_tokens",
                 buckets=_CHUNK_SIZE_BUCKETS).observe(ntok)
             s.filled = start + CT
@@ -2099,7 +2117,7 @@ class ServingEngine:
             # the carry for the rest of a long prefill
             g.dev_prefix = None
         self.stats["prefill_chunks"] += 1
-        r = registry()
+        r = self._metrics
         r.counter("serving.prefill_chunks").inc()
         r.histogram("serving.chunk_rows",
                     buckets=_CHUNK_ROWS_BUCKETS).observe(n)
@@ -2108,6 +2126,7 @@ class ServingEngine:
             s0 = g.rows[0][1]
             tr.record("serving.prefill_chunk", ts=time.time() - t_wall,
                       dur_s=t_wall, request_id=s0.req.request_id,
+                      trace_id=s0.req.trace_id,
                       start=int(start),
                       tokens=int(min(CT, len(s0.feed) - start)),
                       rows=int(n), last=bool(last))
@@ -2189,8 +2208,6 @@ class ServingEngine:
         immutable blocks to the prefix cache, so resume re-prefill
         adopts instead of recomputing), releases its reservation, and
         requeues the request for a token-exact resume."""
-        from paddle_tpu.observability import registry
-
         s = self._slots[slot_idx]
         req = s.req
         if s.prefilling:
@@ -2219,7 +2236,7 @@ class ServingEngine:
         self._release_slot(slot_idx)
         self._queue.push(req)
         self.stats["preemptions"] += 1
-        registry().counter("serving.preemptions").inc()
+        self._metrics.counter("serving.preemptions").inc()
         # tpu-lint: allow(journal-coverage): preemption is NOT terminal
         # — the request requeues in-engine with its tokens, which the
         # router's periodic "progress" events keep mirroring
@@ -2456,8 +2473,6 @@ class ServingEngine:
         into the running decode batch. The whole group (program + host
         pulls + slot adoption) is timed as the step's wave-prefill
         segment."""
-        from paddle_tpu.observability import registry
-
         t_pf0 = time.perf_counter()
         n = len(grp)
         BT = self.block_tokens
@@ -2537,8 +2552,6 @@ class ServingEngine:
         invariant — the PR 5 join/leave parity property). Cost:
         ``len(resume) - 1`` dispatches per resume; resumes are
         preemption/failover events, not the hot path."""
-        from paddle_tpu.observability import registry
-
         if len(s.resume) <= 1:
             return
         if self._step_fn is None:
@@ -2571,7 +2584,7 @@ class ServingEngine:
             s.pos += 1
         n = len(s.resume) - 1
         self.stats["replay_tokens"] += n
-        registry().counter("serving.replay_tokens").inc(n)
+        self._metrics.counter("serving.replay_tokens").inc(n)
 
     def _adopt_slot(self, slot_idx: int, s: "_Slot", tok: int,
                     lanes_row, kv_row):
@@ -2587,8 +2600,6 @@ class ServingEngine:
         tokens); a resumed slot's sample is discarded — its next token
         comes from the next decode step at ``fold_in(seed, count)``,
         exactly where the uninterrupted run's stream stood."""
-        from paddle_tpu.observability import registry
-
         req = s.req
         P = len(s.feed)
         BT = self.block_tokens
@@ -2601,7 +2612,7 @@ class ServingEngine:
         if lanes_row is not None:
             self._kv_scales[:, slot_idx, :] = lanes_row
         s.pos = P
-        r = registry()
+        r = self._metrics
         if s.resume:
             s.count = len(s.resume)
             s.tok = int(s.resume[-1])
@@ -2809,8 +2820,6 @@ class ServingEngine:
         observes on both). ``serving.spec_k_probes`` counts probed
         slots; the cap drops back when the window closes unless
         ``_adapt_spec_k`` climbed the slot's k in between."""
-        from paddle_tpu.observability import registry
-
         if self._probe_window > 0:
             # window survives only while a probed slot is still active
             # (a retirement mid-window resets its cap via
@@ -2836,7 +2845,7 @@ class ServingEngine:
             self._spec_cap[i] = 1
         self._dirty = True
         self.stats["spec_k_probes"] += len(parked)
-        registry().counter("serving.spec_k_probes").inc(len(parked))
+        self._metrics.counter("serving.spec_k_probes").inc(len(parked))
 
     def _close_probe_window(self):
         """End-of-spec-tick bookkeeping for an open probe window: when
@@ -3194,7 +3203,6 @@ class ServingEngine:
 
     def _retire(self, slot_idx: int, finish: str):
         from paddle_tpu import observability as obs
-        from paddle_tpu.observability import registry
 
         s = self._slots[slot_idx]
         now = time.perf_counter()
@@ -3226,12 +3234,13 @@ class ServingEngine:
         # from step(); single-engine durability is the snapshot, which
         # serializes results
         res = RequestResult(s.req.request_id, s.req.prompt, toks, gen_len,
-                            finish, ttft, tpot, s.prefix_hit_blocks)
+                            finish, ttft, tpot, s.prefix_hit_blocks,
+                            trace_id=s.req.trace_id)
         self.results[s.req.request_id] = res
         self._finished_tick.append(s.req.request_id)
         self._tick_retired.append((s.req.request_id, finish))
         self.stats["requests_finished"] += 1
-        r = registry()
+        r = self._metrics
         r.counter("serving.requests", finish=finish).inc()
         # the SLO percentile layer: per-request TTFT/TPOT land in
         # bounded-relative-error sketches (docs/OBSERVABILITY.md)
@@ -3251,7 +3260,8 @@ class ServingEngine:
             tr.record("serving.request",
                       ts=time.time() - (now - s.req._t_submit),
                       dur_s=now - s.req._t_submit,
-                      request_id=s.req.request_id, finish=finish,
+                      request_id=s.req.request_id,
+                      trace_id=s.req.trace_id, finish=finish,
                       prompt_len=int(len(s.req.prompt)),
                       tokens=int(s.count), ttft_s=ttft, tpot_s=tpot,
                       prefix_hit_blocks=s.prefix_hit_blocks)
@@ -3307,7 +3317,6 @@ class ServingEngine:
             raise
 
     def _step_inner(self, t0: float) -> Dict:
-        from paddle_tpu.observability import registry
         from paddle_tpu.resilience import faults as _faults
         from paddle_tpu.resilience import record_event
 
@@ -3496,8 +3505,6 @@ class ServingEngine:
         """One plain (non-speculative) tick's dispatch + host commit:
         the fused tick program when a chunk is due (``grp``), else the
         per-token step program. Returns (dispatch_s, sync_s)."""
-        from paddle_tpu.observability import registry
-
         t_d0 = time.perf_counter()
         if grp is not None:
             fn = tick_fn
@@ -3533,7 +3540,7 @@ class ServingEngine:
             # speculation — the speculative perf gate's denominator
             self.stats["decode_slot_dispatches"] += len(active)
             self.stats["idle_slot_steps"] += self.max_slots - len(active)
-            r = registry()
+            r = self._metrics
             r.counter("serving.steps").inc()
             r.counter("serving.tokens_generated").inc(len(active))
             r.counter("serving.idle_slot_steps").inc(
@@ -3581,7 +3588,6 @@ class ServingEngine:
         retirement inside the commit loop marks the mirrors dirty like
         any other leave event."""
         from paddle_tpu import observability as obs
-        from paddle_tpu.observability import registry
 
         ngram = self._history is not None
         K_eff = self._spec_k_eff
@@ -3636,7 +3642,7 @@ class ServingEngine:
         self.stats["spec_ticks"] += 1
         self.stats["decode_slot_dispatches"] += len(active)
         self.stats["idle_slot_steps"] += self.max_slots - len(active)
-        r = registry()
+        r = self._metrics
         r.counter("serving.steps").inc()
         r.counter("serving.idle_slot_steps").inc(
             self.max_slots - len(active))
@@ -3698,6 +3704,9 @@ class ServingEngine:
             dur = dispatch_s + sync_s
             tr.record("serving.spec_verify", ts=time.time() - dur,
                       dur_s=dur, slots=len(active),
+                      trace_ids=[self._slots[i].req.trace_id
+                                 for i in active
+                                 if self._slots[i] is not None],
                       proposed=proposed_total, accepted=accepted_total,
                       committed=committed_total)
         if grp is not None:
@@ -3719,11 +3728,10 @@ class ServingEngine:
         that ran a wave, dispatch/sync only on ticks that decoded — so
         each histogram is the distribution of the segment when it
         actually happened, not diluted by structural zeros."""
-        from paddle_tpu.observability import registry
         st = self.stats
         st["step_admit_s"] += admit_s
         st["step_prefill_s"] += self._tick_prefill_s
-        r = registry()
+        r = self._metrics
         r.histogram("serving.step_admit_s").observe(admit_s)
         if self._tick_prefills:
             r.histogram("serving.step_prefill_s").observe(
@@ -3755,6 +3763,7 @@ class ServingEngine:
     def _record_flight(self, admit_s, dispatch_s, sync_s, err=None):
         """One compact JSON-ready event per tick into the flight ring."""
         evt = {"step": self._step_seq, "ts": round(time.time(), 6),
+               "ts_mono": round(time.perf_counter(), 6),
                "active": self.active_slots, "queued": len(self._queue),
                "blocks_used": self.pool.used_blocks,
                "blocks_reserved": self._reserved,
@@ -3919,6 +3928,7 @@ class ServingEngine:
                     "max_new_tokens": req.max_new_tokens,
                     "seed": int(req.seed) if req.seed is not None else None,
                     "priority": req.priority, "seq": req._seq,
+                    "trace_id": req.trace_id,
                     "deadline_remaining_s": rem,
                     "tokens": [int(t) for t in tokens]}
 
@@ -3944,6 +3954,7 @@ class ServingEngine:
                     "tokens": [int(t) for t in res.tokens],
                     "gen_len": res.gen_len, "finish": res.finish,
                     "ttft_s": res.ttft_s, "tpot_s": res.tpot_s,
+                    "trace_id": res.trace_id,
                     "prefix_hit_blocks": res.prefix_hit_blocks}
                    for res in self.results.values()]
         config = {"max_slots": self.max_slots,
@@ -3987,7 +3998,6 @@ class ServingEngine:
         existence IS the commit marker — :meth:`restore` walks back
         past uncommitted or corrupt snapshots exactly like checkpoint
         resume does. Returns the step directory."""
-        from paddle_tpu.observability import registry
         from paddle_tpu.resilience import faults as _faults
         from paddle_tpu.resilience import integrity as _integ
 
@@ -4023,7 +4033,7 @@ class ServingEngine:
             os.fsync(f.fileno())
         os.replace(tmp, path)
         _integ.write_manifest(root, step, _integ.file_checksums(step_dir))
-        registry().counter("serving.snapshots").inc()
+        self._metrics.counter("serving.snapshots").inc()
         return step_dir
 
     @staticmethod
@@ -4065,7 +4075,6 @@ class ServingEngine:
         host-side canonical forms), so ``mesh=``/``layout=`` overrides
         restore the same snapshot onto any mesh shape — including a
         single chip — byte-identically (tests/test_serving_mp.py)."""
-        from paddle_tpu.observability import registry
         from paddle_tpu.resilience import record_event
 
         snap = (cls.load_snapshot(source) if isinstance(source, str)
@@ -4112,7 +4121,8 @@ class ServingEngine:
                           rs["max_new_tokens"], seed=rs["seed"],
                           deadline_s=rs["deadline_remaining_s"],
                           priority=rs.get("priority", "normal"),
-                          request_id=rs["request_id"])
+                          request_id=rs["request_id"],
+                          trace_id=rs.get("trace_id"))
             req._seq = int(rs.get("seq", 0))
             eng._submit_seq = max(eng._submit_seq, req._seq + 1)
             req._t_submit = now     # remaining deadline re-anchors here
@@ -4127,9 +4137,10 @@ class ServingEngine:
             eng.results[rr["request_id"]] = RequestResult(
                 rr["request_id"], np.asarray(rr["prompt"], np.int32),
                 rr["tokens"], rr["gen_len"], rr["finish"], rr["ttft_s"],
-                rr["tpot_s"], rr["prefix_hit_blocks"])
+                rr["tpot_s"], rr["prefix_hit_blocks"],
+                trace_id=rr.get("trace_id"))
         eng._step_seq = int(snap.get("step_seq", 0)) + 1
-        registry().counter("serving.restores").inc()
+        eng._metrics.counter("serving.restores").inc()
         record_event("engine_restored")
         eng.flight.mark("restore", restored=restored,
                         results_carried=len(snap.get("results", [])),

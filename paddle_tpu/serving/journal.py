@@ -29,7 +29,8 @@ import zlib
 
 logger = logging.getLogger("paddle_tpu.serving")
 
-__all__ = ["KNOWN_EVENTS", "ROUTER_JOURNAL_SCHEMA", "RouterJournal"]
+__all__ = ["KNOWN_EVENTS", "TRACE_ID_EVENTS", "ROUTER_JOURNAL_SCHEMA",
+           "RouterJournal"]
 
 ROUTER_JOURNAL_SCHEMA = "paddle_tpu.router_journal/v1"
 
@@ -41,20 +42,27 @@ ROUTER_JOURNAL_SCHEMA = "paddle_tpu.router_journal/v1"
 KNOWN_EVENTS = {
     "header": "journal birth record: schema, replica count, router seed",
     "accept": "request accepted by the tier (prompt, seed, priority, "
-              "deadline, first placement) — the zero-loss contract "
-              "starts here",
-    "place": "request (re-)placed onto a replica: failover/drain "
-             "re-placement and tier-level shed rescue",
+              "deadline, trace_id, first placement) — the zero-loss "
+              "contract AND the causal trace both start here",
+    "place": "request (re-)placed onto a replica (trace_id carried): "
+             "failover/drain re-placement and tier-level shed rescue",
     "progress": "periodic generated-so-far token mirror for unfinished "
                 "requests (any prefix is a token-exact resume point)",
     "finish": "request reached a terminal state (eos/length/deadline/"
-              "shed) with its tokens and latency telemetry",
+              "shed) with its tokens, trace_id and latency telemetry",
     "failover": "dead replica rebuilt (mode=restore|redistribute)",
     "drain": "replica elastically drained; its work migrated",
     "add_replica": "tier grew by one (warm-joined) replica slot",
     "close": "router closed cleanly (no recovery needed past here)",
     "recover": "router process rebuilt from this journal",
 }
+
+#: request-scoped event kinds whose payload MUST carry ``trace_id`` —
+#: the causal chain a request's journal events form across replicas
+#: (docs/OBSERVABILITY.md has the trace_id lifecycle table;
+#: ``timeline.verify_trace_continuity`` checks real journals against
+#: it, and ``append`` warns on a violation at the write site).
+TRACE_ID_EVENTS = frozenset({"accept", "place", "finish"})
 
 
 class RouterJournal:
@@ -93,6 +101,11 @@ class RouterJournal:
                 "journal event kind %r is not registered in "
                 "serving.journal.KNOWN_EVENTS (known: %s) — replay "
                 "tooling cannot see it", kind, ", ".join(KNOWN_EVENTS))
+        elif kind in TRACE_ID_EVENTS and fields.get("trace_id") is None:
+            logger.warning(
+                "journal event %r appended without a trace_id — the "
+                "request's causal chain breaks here "
+                "(serving.journal.TRACE_ID_EVENTS)", kind)
         evt = {"kind": kind, "ts": round(time.time(), 6)}
         evt.update(fields)
         p = json.dumps(evt, separators=(",", ":"), sort_keys=True)

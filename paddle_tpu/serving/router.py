@@ -93,11 +93,12 @@ class _Tracked:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "seed", "priority",
                  "deadline_s", "t_accept", "replica", "tokens",
-                 "finished", "journaled_tokens")
+                 "finished", "journaled_tokens", "trace_id")
 
     def __init__(self, rid, prompt, max_new_tokens, seed, priority,
-                 deadline_s, replica):
+                 deadline_s, replica, trace_id=None):
         self.rid = rid
+        self.trace_id = trace_id        # causal chain key, accept-minted
         self.prompt = prompt            # np.int32 host ids
         self.max_new_tokens = max_new_tokens
         self.seed = seed
@@ -116,9 +117,12 @@ class _Tracked:
                    - (time.perf_counter() - self.t_accept), 1e-9)
 
     def as_request(self) -> Request:
+        # trace_id rides along: a failover/drain re-placement is the
+        # SAME causal request — its chain must not fork at migration
         return Request(self.prompt, self.max_new_tokens, seed=self.seed,
                        deadline_s=self.remaining_deadline(),
-                       priority=self.priority, request_id=self.rid)
+                       priority=self.priority, request_id=self.rid,
+                       trace_id=self.trace_id)
 
 
 class _Replica:
@@ -158,6 +162,7 @@ class Router:
                  rebuild_dead: bool = True,
                  flight_capacity: int = 256,
                  flight_dump_path: Optional[str] = None,
+                 watchdog=None,
                  seed: int = 0, **engine_kwargs):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -199,7 +204,7 @@ class Router:
         self._replicas: List[_Replica] = []
         for i in range(replicas):
             self._replicas.append(
-                _Replica(self._new_engine(), self._replica_root(i)))
+                _Replica(self._new_engine(i), self._replica_root(i)))
         self._requests: Dict[int, _Tracked] = {}
         self._open: set = set()         # accepted, not yet finished
         self.results: Dict[int, RequestResult] = {}
@@ -214,6 +219,10 @@ class Router:
         self.flight = FlightRecorder(capacity=flight_capacity,
                                      auto_dump_path=flight_dump_path,
                                      name="serving-router")
+        # SLO burn-rate watchdog (observability.slo.BurnRateWatchdog):
+        # checked on its own tick cadence; a trip dumps flight rings +
+        # a timeline slice (docs/OBSERVABILITY.md §Burn-rate watchdog)
+        self.watchdog = watchdog
         # tpu-lint: volatile(tier telemetry; the registry counters are
         # the cross-recovery accounting)
         self.router_stats = dict(
@@ -233,11 +242,17 @@ class Router:
         return (os.path.join(self.root, f"replica_{i}")
                 if self.root is not None else None)
 
-    def _new_engine(self) -> ServingEngine:
+    def _new_engine(self, i: int) -> ServingEngine:
+        """Build replica ``i``'s engine. Every replica's metric series
+        carry a ``replica="<i>"`` label (a registry view — storage
+        stays process-global), so :meth:`metrics_snapshot` can merge
+        the tier and a dashboard can still tell replicas apart."""
         return ServingEngine(self.model, state=self._state,
-                             seed=self.seed, **self._engine_kwargs)
+                             seed=self.seed,
+                             metrics_labels={"replica": str(i)},
+                             **self._engine_kwargs)
 
-    def _restore_overrides(self) -> Dict:
+    def _restore_overrides(self, i: int) -> Dict:
         """Overrides every replica restore needs: the live SpecConfig
         (draft models don't serialize — without this a draft-proposer
         tier could never take the restore path; restore would raise
@@ -245,8 +260,9 @@ class Router:
         would silently degrade to redistribution), and the live
         mesh/layout (snapshots are mesh-free, so a sharded router's
         restored replica must be re-handed its mesh explicitly or it
-        would come back single-device)."""
-        out = {}
+        would come back single-device) — plus the replica metric label,
+        which is a live-construction knob snapshots never carry."""
+        out = {"metrics_labels": {"replica": str(i)}}
         for key in ("speculate", "mesh", "layout"):
             v = self._engine_kwargs.get(key)
             if v is not None:
@@ -398,7 +414,8 @@ class Router:
                 continue
             t = _Tracked(rid, request.prompt, request.max_new_tokens,
                          request.seed, request.priority,
-                         request.deadline_s, idx)
+                         request.deadline_s, idx,
+                         trace_id=request.trace_id)
             self._requests[rid] = t
             self._open.add(rid)
             self.router_stats["placed"] += 1
@@ -406,7 +423,7 @@ class Router:
                       policy=policy if j == 0 else "least_loaded").inc()
             if self.journal is not None:
                 self.journal.append(
-                    "accept", rid=rid,
+                    "accept", rid=rid, trace_id=request.trace_id,
                     prompt=[int(x) for x in request.prompt],
                     max_new_tokens=request.max_new_tokens,
                     seed=request.seed, priority=request.priority,
@@ -500,7 +517,7 @@ class Router:
                 snap = ServingEngine.load_snapshot(rep.root)
                 eng = ServingEngine.restore(self.model, snap,
                                             state=self._state,
-                                            **self._restore_overrides())
+                                            **self._restore_overrides(i))
                 covered = {rs["request_id"]
                            for rs in snap["slots"] + snap["queue"]}
                 mode = "restore"
@@ -511,7 +528,7 @@ class Router:
                                "redistributing", i, exc_info=True)
                 eng = None
         if eng is None and self.rebuild_dead:
-            eng = self._new_engine()
+            eng = self._new_engine(i)
         if eng is not None:
             rep.engine = eng
             rep.state = "healthy"
@@ -566,6 +583,7 @@ class Router:
             registry().counter("serving.router.replaced").inc()
             if self.journal is not None:
                 self.journal.append("place", rid=t.rid, replica=idx,
+                                    trace_id=t.trace_id,
                                     tokens=len(t.tokens))
         self._pending_replace = still
 
@@ -617,6 +635,7 @@ class Router:
         queued += len(self._pending_replace)
         self.flight.record({
             "step": self._tick, "ts": round(time.time(), 6),
+            "ts_mono": round(time.perf_counter(), 6),
             "active": active, "queued": queued,
             "finished": list(finished),
             "pending_replace": len(self._pending_replace),
@@ -630,6 +649,9 @@ class Router:
                             and not r.engine.closed else 0)}
                 for i, r in enumerate(self._replicas)]})
         self._update_gauges()
+        if self.watchdog is not None \
+                and self._tick % self.watchdog.check_every == 0:
+            self.watchdog.check(source=self)
         return dict(active=active, queued=queued, finished=finished)
 
     def _on_step_crash(self, i: int, rep: _Replica, exc: BaseException):
@@ -687,6 +709,7 @@ class Router:
             registry().counter("serving.router.replaced").inc()
             if self.journal is not None:
                 self.journal.append("place", rid=t.rid, replica=idx,
+                                    trace_id=t.trace_id,
                                     tokens=len(t.tokens))
             return True
         return False
@@ -713,6 +736,7 @@ class Router:
             if self.journal is not None and t is not None:
                 self.journal.append(
                     "finish", rid=rid, finish=res.finish,
+                    trace_id=t.trace_id,
                     tokens=[int(x) for x in res.tokens],
                     gen_len=res.gen_len, ttft_s=res.ttft_s,
                     tpot_s=res.tpot_s)
@@ -855,7 +879,7 @@ class Router:
         from paddle_tpu.observability import registry
 
         idx = len(self._replicas)
-        rep = _Replica(self._new_engine(), self._replica_root(idx))
+        rep = _Replica(self._new_engine(idx), self._replica_root(idx))
         if warm:
             mesh = rep.engine.mesh
             with (mesh if mesh is not None else contextlib.nullcontext()):
@@ -938,6 +962,19 @@ class Router:
         for k, v in self.router_stats.items():
             out[f"router_{k}"] = v
         return out
+
+    def metrics_snapshot(self) -> "MetricsRegistry":
+        """The tier metrics plane: one merged :class:`MetricsRegistry`
+        folding every replica's ``replica="<i>"``-labeled series into
+        tier totals — counters summed, histograms bucket-summed,
+        quantile sketches :meth:`~QuantileSketch.merge`-d, gauges kept
+        per-replica-labeled (a summed occupancy gauge is meaningless;
+        a per-replica one is a dashboard row). The merged registry is
+        a detached point-in-time copy with the full export surface
+        (``export_jsonl`` / ``prometheus_text``); mutating it does not
+        touch the live series (docs/OBSERVABILITY.md §Tier metrics)."""
+        from paddle_tpu.observability import registry
+        return registry().merged_across("replica")
 
     def reset_stats(self):
         self._stats_base = {}
@@ -1142,11 +1179,11 @@ class Router:
             try:
                 eng = ServingEngine.restore(
                     model, snap, state=rt._state,
-                    **rt._restore_overrides())
+                    **rt._restore_overrides(i))
             except (RestoreError, ValueError, KeyError):
                 logger.warning("router recovery: replica %d snapshot "
                                "unusable", i, exc_info=True)
-                rep.engine = rt._new_engine()
+                rep.engine = rt._new_engine(i)
                 continue
             rep.engine = eng
             covered |= {rs["request_id"]
@@ -1160,17 +1197,19 @@ class Router:
                     rid, prompt, fin.get("tokens", []),
                     fin.get("gen_len", len(fin.get("tokens", []))),
                     fin.get("finish", "length"), fin.get("ttft_s"),
-                    fin.get("tpot_s"), 0)
+                    fin.get("tpot_s"), 0,
+                    trace_id=rec.get("trace_id"))
                 t = _Tracked(rid, prompt, rec["max_new_tokens"],
                              rec["seed"], rec.get("priority", "normal"),
-                             None, None)
+                             None, None, trace_id=rec.get("trace_id"))
                 t.finished = True
                 t.tokens = list(fin.get("tokens", []))
                 rt._requests[rid] = t
                 continue
             t = _Tracked(rid, prompt, rec["max_new_tokens"], rec["seed"],
                          rec.get("priority", "normal"),
-                         rec.get("deadline_s"), rec.get("replica"))
+                         rec.get("deadline_s"), rec.get("replica"),
+                         trace_id=rec.get("trace_id"))
             t.tokens = list(rec.get("tokens", []))
             rt._requests[rid] = t
             rt._open.add(rid)

@@ -31,6 +31,12 @@ longest exact-match-of-argmax prefix; sampled draws each position's
 sample from its own leave-one-out fold of the request stream. Either
 way the committed tokens are bitwise the ones the non-speculative
 engine emits — the parity contract tests/test_serving_spec.py pins.
+
+Telemetry: each speculative tick records a ``serving.spec_verify``
+span (proposed/accepted/committed counts) carrying the ``trace_ids``
+of every active slot — a verify tick is a shared event on N causal
+request chains, and the timeline export fans it out to each
+(docs/OBSERVABILITY.md §Request traces).
 """
 
 import numbers

@@ -200,6 +200,51 @@ def test_metric_drift_rule_shared_implementation():
                           "serving.wrapped_rotten"}
 
 
+def test_span_drift_rule_shared_implementation():
+    sources = {"paddle_tpu/a.py":
+               'tr.record("serving.good_span", ts=0.0)\n'
+               'tr.record("serving.rotten_span", ts=0.0)\n'
+               # wrapped across lines: the scan must still see it
+               'with tracer.span(\n'
+               '        "decode.wrapped_rotten_span"):\n'
+               '    pass\n'}
+    docs = "| `serving.good_span` | documented |\n"
+    found = rules_mod.check_span_drift(sources, docs, lambda p, ln: "")
+    assert [(f.rule, f.line) for f in found] == [
+        ("span-drift", 3), ("span-drift", 2)]
+    assert all("not documented in docs/OBSERVABILITY.md" in f.message
+               for f in found)
+    names = rules_mod.collect_span_names(sources)
+    assert set(names) == {"serving.good_span", "serving.rotten_span",
+                          "decode.wrapped_rotten_span"}
+
+
+def test_span_drift_skipped_without_docs_file(tmp_path):
+    """Installed-package run (docs/ not shipped): span-drift is dropped
+    like metric-drift instead of flagging every span literal."""
+    src = 'tr.record("serving.undocumented_span", ts=0.0)\n'
+    res = lint.run_lint(str(tmp_path), rules=("span-drift",),
+                        files=_files(mod=src), respect_baseline=False)
+    assert res.ok
+
+
+def test_span_names_documented_in_observability_table():
+    """Every serving.*/decode.* span literal in paddle_tpu/ must appear
+    in docs/OBSERVABILITY.md's span taxonomy table — the timeline
+    export's track names cannot silently rot. Same shared-implementation
+    pattern as the metric-drift delegate in tests/test_slo.py:
+    suppressions and the baseline are DISABLED here."""
+    files = lint.package_sources(ROOT)
+    names = rules_mod.collect_span_names(
+        {p: sf.source for p, sf in files.items()})
+    assert len(names) >= 5, f"span scan found only {sorted(names)}"
+    res = lint.run_lint(ROOT, rules=("span-drift",), files=files,
+                        respect_suppressions=False,
+                        respect_baseline=False)
+    assert res.ok, "undocumented spans:\n" + "\n".join(
+        map(repr, res.findings))
+
+
 # -------------------------------------- state-protocol rules (PR 13)
 
 def test_snapshot_coverage_rule():

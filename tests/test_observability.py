@@ -67,6 +67,87 @@ def test_registry_counters_gauges_histograms(tmp_path):
     assert r'metric="7\" disk\\x"' in r.prometheus_text()
 
 
+def test_prometheus_label_newline_escaped():
+    """Exposition-format escaping regression: a hostile label value
+    carrying a literal newline must be emitted as the two-character
+    escape \\n — a raw newline inside a label value tears the line and
+    poisons every scrape of the whole registry."""
+    r = obs.MetricsRegistry()
+    r.counter("req", reason='line1\nline2"x\\y').inc()
+    txt = r.prometheus_text()
+    lines = txt.splitlines()
+    # the value never leaks a raw newline: one metric -> exactly TYPE
+    # line + sample line, and the sample parses as a single line
+    assert len(lines) == 2
+    assert lines[1] == 'req{reason="line1\\nline2\\"x\\\\y"} 1'
+
+
+def test_registry_view_stamps_labels_shared_storage():
+    r = obs.MetricsRegistry()
+    v = r.view(replica="0")
+    assert v.backing is r and v.labels == {"replica": "0"}
+    v.counter("serving.requests", finish="eos").inc(2)
+    # storage stays in the backing registry: label-blind accessors and
+    # get-or-create through the view both see the same object
+    assert r.counter_total("serving.requests") == 2
+    assert v.counter("serving.requests", finish="eos") \
+        is r.counter("serving.requests", finish="eos", replica="0")
+    # a caller's explicit label WINS over the view's stamp
+    v.gauge("g", replica="7").set(1.0)
+    assert [dict(m.labels) for m in r.series("g")] == [{"replica": "7"}]
+    # histograms/sketches ride the same merge path
+    v.histogram("h", buckets=(1.0,)).observe(0.5)
+    v.sketch("s").observe(0.5)
+    assert dict(r.series("h")[0].labels) == {"replica": "0"}
+    assert dict(r.series("s", kind="sketch")[0].labels) \
+        == {"replica": "0"}
+
+
+def test_registry_series_accessor_filters_name_and_kind():
+    r = obs.MetricsRegistry()
+    r.counter("x", a="1").inc()
+    r.counter("x", a="2").inc()
+    r.gauge("x").set(3)
+    r.counter("y").inc()
+    assert len(r.series("x")) == 3
+    assert len(r.series("x", kind="counter")) == 2
+    assert [m.kind for m in r.series("x", kind="gauge")] == ["gauge"]
+    assert r.series("nope") == []
+
+
+def test_merged_across_collapses_label_per_kind():
+    """merged_across('replica') unit semantics — the tier-merge rules:
+    counters summed, histograms bucket-summed, sketches merged, gauges
+    KEEP the label; label-free series pass through unchanged."""
+    r = obs.MetricsRegistry()
+    for i, n in ((0, 3), (1, 5)):
+        r.counter("c", replica=str(i)).inc(n)
+        r.gauge("q", replica=str(i)).set(n)
+        h = r.histogram("h", buckets=(1.0, 2.0), replica=str(i))
+        h.observe(0.5)
+        h.observe(1.5)
+        sk = r.sketch("s", replica=str(i))
+        sk.observe(0.1 * (i + 1))
+    r.counter("plain").inc(7)
+    m = r.merged_across("replica")
+    (c,) = m.series("c", kind="counter")
+    assert c.value == 8 and "replica" not in dict(c.labels)
+    (h,) = m.series("h", kind="histogram")
+    assert h.count == 4 and h.counts == [2, 2, 0]
+    (s,) = m.series("s", kind="sketch")
+    assert s.count == 2 and s.min == pytest.approx(0.1) \
+        and s.max == pytest.approx(0.2)
+    gauges = {dict(g.labels)["replica"]: g.value
+              for g in m.series("q", kind="gauge")}
+    assert gauges == {"0": 3, "1": 5}
+    (p,) = m.series("plain", kind="counter")
+    assert p.value == 7
+    # detached: bumping the merged copy leaves the source untouched
+    c.inc(100)
+    assert r.counter("c", replica="0").value == 3
+    assert r.counter("c", replica="1").value == 5
+
+
 def test_trace_is_reentrant():
     with obs.trace(registry=obs.MetricsRegistry()) as outer:
         with obs.trace(registry=obs.MetricsRegistry()) as inner:
@@ -523,7 +604,7 @@ def test_decode_bench_smoke_emits_valid_schema(tmp_path):
 
 # ---- serving_bench smoke (continuous-batching A/B, BENCH schema) ------------
 
-def test_serving_bench_smoke_emits_valid_schema():
+def test_serving_bench_smoke_emits_valid_schema(tmp_path):
     """`not slow` CI smoke: serving_bench in tiny-CPU mode must emit TWO
     schema-valid BENCH records — static first, then continuous carrying
     the A/B fields (speedup, occupancy, pad-waste, prefix-hit). The
@@ -542,7 +623,8 @@ def test_serving_bench_smoke_emits_valid_schema():
          "--requests", "6", "--slots", "2", "--min_prompt", "4",
          "--max_prompt", "12", "--min_new", "2", "--max_new", "8",
          "--sys_prompt_len", "16", "--reps", "1",
-         "--chunk_tokens", "16", "--speculate", "2"],
+         "--chunk_tokens", "16", "--speculate", "2",
+         "--timeline", str(tmp_path / "t.json")],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -574,3 +656,12 @@ def test_serving_bench_smoke_emits_valid_schema():
     assert 0.0 <= cont["acceptance_rate"] <= 1.0
     assert isinstance(cont["accepted_len_hist"], dict)
     assert sum(cont["accepted_len_hist"].values()) >= 1
+    # --timeline rode along: the continuous record names a Perfetto
+    # trace-event export covering the engine run's flight ring
+    assert cont["timeline_path"] == str(tmp_path / "t.json")
+    assert cont["trace_count"] >= 1
+    doc = json.load(open(cont["timeline_path"]))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    assert doc["otherData"]["trace_count"] == cont["trace_count"]
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert {"M", "X", "i"} <= phases        # tracks, segments, instants

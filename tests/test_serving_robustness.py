@@ -474,6 +474,46 @@ def test_chaos_bench_smoke_zero_loss(tmp_path):
     assert rec["parity_checked"] >= 1
 
 
+@pytest.mark.slow
+def test_chaos_bench_kill_replica_trace_continuity(tmp_path):
+    """The PR 18 acceptance drive: a replicated chaos run with
+    kill-replica churn and --timeline must exit 0 with every accepted
+    request's journal events forming ONE connected trace_id chain
+    (chaos_bench exits 4 on a broken chain), and the exported timeline
+    must be Perfetto-loadable with replica process tracks and flow
+    arrows."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tpath = str(tmp_path / "t.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "chaos_bench.py"),
+         "--model", "llama-tiny", "--requests", "12", "--replicas", "3",
+         "--kill_replica_every", "12", "--max_kills", "2",
+         "--fault_every", "0", "--max_faults", "0",
+         "--min_new", "3", "--max_new", "8", "--verify", "1",
+         "--snapshot_dir", str(tmp_path / "snap"),
+         "--timeline", tpath],
+        capture_output=True, text=True, timeout=540, env=env, cwd=root)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import paddle_tpu.observability as _obs
+    (rec,) = [json.loads(ln) for ln in out.stdout.splitlines()
+              if ln.startswith("{")]
+    _obs.validate_bench(rec)
+    assert rec["lost_requests"] == 0 and rec["replica_kills"] >= 1
+    assert rec["timeline_path"] == tpath
+    assert rec["trace_count"] >= 12     # one chain per accepted request
+    doc = json.load(open(tpath))
+    assert doc["otherData"]["trace_count"] == rec["trace_count"]
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"router", "replica_0", "replica_1", "replica_2"} <= procs
+    # flow arrows exist and terminate: one s and one f per rendered
+    # chain, at least one per accepted request (accept+finish journal
+    # instants give every request >= 2 touch points)
+    phases = [e["ph"] for e in doc["traceEvents"]]
+    assert phases.count("s") == phases.count("f") >= 12
+
+
 # ------------------------------------------------------- schema additions
 
 def test_bench_schema_robustness_fields():

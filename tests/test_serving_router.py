@@ -370,6 +370,129 @@ def test_restore_draft_snapshot_missing_model_is_typed(model):
     eng2.close()
 
 
+# -------------------------------------------- causal trace-id threading
+
+def test_trace_chain_connected_across_kill_replica(model, tmp_path):
+    """One request = ONE trace_id chain, reconstructible from the
+    journal alone — including across a kill-replica failover, whose
+    re-placement must carry the accept-minted id instead of forking."""
+    from paddle_tpu.observability.timeline import verify_trace_continuity
+    rng = np.random.RandomState(9)
+    with _router(model, tmp_path, replicas=2, snapshot_every=2) as rt:
+        rids = [rt.submit(serving.Request(rng.randint(3, 500, (10,)),
+                                          max_new_tokens=6, seed=i))
+                for i in range(4)]
+        for _ in range(3):
+            rt.step()
+        # wipe the victim's snapshots: failover takes the REDISTRIBUTE
+        # path, whose journaled "place" re-placements must carry the
+        # accept-minted trace ids onto the surviving replica
+        import shutil
+        victim = rt.live_replicas[0]
+        shutil.rmtree(rt.replica_snapshot_root(victim),
+                      ignore_errors=True)
+        rt.kill_replica(victim)
+        rt.drain(max_steps=400)
+        # every result carries the 16-hex id minted at submit, distinct
+        # per request
+        ids = {r: rt.results[r].trace_id for r in rids}
+        assert all(len(t) == 16 and int(t, 16) >= 0
+                   for t in ids.values())
+        assert len(set(ids.values())) == len(rids)
+        journal_path = rt.journal.path
+    events, corrupt = RouterJournal.replay(journal_path)
+    assert corrupt == 0
+    assert verify_trace_continuity(events, accepted_rids=rids,
+                                   require_finish=True) == []
+    # the journal's accept/finish ids agree with the results' ids —
+    # the chain the timeline flows render is the one the caller saw
+    for evt in events:
+        if evt["kind"] in ("accept", "place", "finish") \
+                and evt.get("rid") in ids:
+            assert evt["trace_id"] == ids[evt["rid"]]
+    # a post-failover re-placement actually happened on this run
+    assert any(e["kind"] == "place" for e in events)
+
+
+def test_trace_id_events_pin_and_append_warning(tmp_path, caplog):
+    """TRACE_ID_EVENTS is a pinned contract: the request-scoped kinds
+    whose payload must carry trace_id, warned at the write site."""
+    import logging
+    from paddle_tpu.serving import journal as journal_mod
+    assert journal_mod.TRACE_ID_EVENTS == frozenset(
+        {"accept", "place", "finish"})
+    assert journal_mod.TRACE_ID_EVENTS <= set(journal_mod.KNOWN_EVENTS)
+    j = RouterJournal(str(tmp_path / "j.jsonl"))
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.serving"):
+        assert j.append("accept", rid=1, trace_id="ab" * 8)
+        assert not caplog.records
+        assert j.append("accept", rid=2)        # chain breaks here
+    assert any("without a trace_id" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------- tier metrics plane
+
+def test_router_metrics_snapshot_merges_replica_series(model):
+    from paddle_tpu.observability import registry
+    rng = np.random.RandomState(10)
+    with _router(model, replicas=2) as rt:
+        for i in range(4):
+            rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                      max_new_tokens=4, seed=i))
+        rt.drain(max_steps=300)
+        snap = rt.metrics_snapshot()
+    # counters: the replica label is collapsed and values summed — the
+    # merged total equals the label-blind sum over the live registry
+    merged = {tuple(sorted(m.labels)): m.value
+              for m in snap.series("serving.requests", kind="counter")}
+    assert all("replica" not in dict(lbl) for lbl in merged)
+    assert sum(merged.values()) \
+        == registry().counter_total("serving.requests")
+    assert sum(v for lbl, v in merged.items()
+               if dict(lbl).get("finish") == "length") >= 4
+    # sketches: bucket-wise merge, count = pooled count across replicas
+    pooled = sum(m.count for m in
+                 registry().series("serving.ttft_s", kind="sketch")
+                 if dict(m.labels).get("replica") in ("0", "1"))
+    tier = [m for m in snap.series("serving.ttft_s", kind="sketch")
+            if "replica" not in dict(m.labels)]
+    assert len(tier) == 1 and tier[0].count >= pooled > 0
+    # gauges KEEP the replica label: one dashboard row per replica
+    qd = {dict(m.labels).get("replica")
+          for m in snap.series("serving.queue_depth", kind="gauge")}
+    assert {"0", "1"} <= qd
+    # the merged registry is a detached copy with the full export
+    # surface; mutating it does not touch the live tier counters
+    before = registry().counter_total("serving.requests")
+    next(iter(snap.series("serving.requests", kind="counter"))).inc(99)
+    assert registry().counter_total("serving.requests") == before
+    txt = snap.prometheus_text()
+    assert "serving_requests" in txt and 'replica="0"' in txt
+
+
+def test_router_drives_watchdog_on_its_cadence(model):
+    class _StubDog:
+        check_every = 2
+
+        def __init__(self):
+            self.calls = []
+
+        def check(self, source=None):
+            self.calls.append(source)
+            return {"burn": {}, "tripped": []}
+
+    rng = np.random.RandomState(11)
+    wd = _StubDog()
+    with _router(model, replicas=2, watchdog=wd) as rt:
+        rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                  max_new_tokens=4))
+        for _ in range(6):
+            rt.step()
+    # ticks 2, 4, 6 of the check_every=2 cadence, source = the router
+    assert len(wd.calls) == 3
+    assert all(s is rt for s in wd.calls)
+
+
 # ------------------------------------------------------- bench duck-type
 
 def test_router_duck_types_engine_bench_surface(model):

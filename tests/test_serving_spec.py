@@ -109,7 +109,7 @@ def test_spec_config_validation():
     assert cfg == {"k": 3, "proposer": "ngram", "ngram_max": 3,
                    "ngram_min": 1, "adaptive": False, "k_min": 1,
                    "acceptance_floor": 0.35, "acceptance_ceiling": 0.65,
-                   "adapt_every": 4}
+                   "adapt_every": 4, "share_embeddings": True}
     with pytest.raises(ValueError, match="k_min"):
         SpecConfig(k=2, k_min=3)
     with pytest.raises(ValueError, match="acceptance_floor"):
@@ -299,7 +299,8 @@ def test_spec_snapshot_restore_token_exact(tmp_path):
     assert snap["config"]["speculate"] == {
         "k": 3, "proposer": "ngram", "ngram_max": 3, "ngram_min": 1,
         "adaptive": False, "k_min": 1, "acceptance_floor": 0.35,
-        "acceptance_ceiling": 0.65, "adapt_every": 4}
+        "acceptance_ceiling": 0.65, "adapt_every": 4,
+        "share_embeddings": True}
     eng.close()
     eng2 = serving.ServingEngine.restore(m, root)
     assert eng2.speculate is not None and eng2.speculate.k == 3

@@ -89,6 +89,64 @@ def test_sketch_edge_cases():
     assert sk.quantile(1.0) == pytest.approx(5.0, rel=0.01)
 
 
+def test_sketch_count_above_bucket_granular():
+    sk = obs.QuantileSketch(relative_accuracy=0.02)
+    assert sk.count_above(0.5) == 0             # empty
+    for v in (0.0, 0.0, 0.01, 0.2, 0.2, 5.0):
+        sk.observe(v)
+    assert sk.count_above(-1.0) == 6            # negative: everything
+    assert sk.count_above(0.0) == 4             # zero bucket excluded
+    # thresholds well clear of bucket edges: exact whole-bucket answers
+    assert sk.count_above(0.1) == 3
+    assert sk.count_above(1.0) == 1
+    assert sk.count_above(100.0) == 0
+
+
+def test_sketch_merge_matches_pooled_quantiles_property():
+    """The Router.metrics_snapshot claim: merging per-replica sketches
+    then asking a quantile is within relative_accuracy of the
+    POOLED-sample quantile — same bound as one sketch over everything."""
+    rng = np.random.RandomState(1)
+    alpha = 0.02
+    parts = [rng.lognormal(mean=-3.0, sigma=1.2, size=n)
+             for n in (400, 1500, 900)]         # uneven replica loads
+    sketches = []
+    for x in parts:
+        sk = obs.QuantileSketch(relative_accuracy=alpha)
+        for v in x:
+            sk.observe(v)
+        sketches.append(sk)
+    merged = obs.QuantileSketch(relative_accuracy=alpha)
+    for sk in sketches:
+        assert merged.merge(sk) is merged       # chains, folds in place
+    pooled = np.sort(np.concatenate(parts))
+    assert merged.count == len(pooled)
+    for q in (0.05, 0.5, 0.9, 0.99):
+        est = merged.quantile(q)
+        true = _rank_value(pooled, q)
+        assert abs(est - true) / true <= alpha + 1e-9, (q, est, true)
+    # merge also folds the count_above surface the watchdog reads
+    thresh = float(np.median(pooled) * 4)
+    true_above = int((pooled > thresh).sum())
+    assert merged.count_above(thresh) == pytest.approx(
+        true_above, abs=max(2, int(0.05 * true_above)))
+
+
+def test_sketch_merge_geometry_and_type_errors():
+    a = obs.QuantileSketch(relative_accuracy=0.02)
+    b = obs.QuantileSketch(relative_accuracy=0.05)
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(b)
+    with pytest.raises(TypeError):
+        a.merge({"not": "a sketch"})
+    # the source sketch is read-only under merge: folding b into a
+    # fresh same-geometry sketch leaves b intact
+    c = obs.QuantileSketch(relative_accuracy=0.05)
+    b.observe(1.0)
+    c.merge(b)
+    assert b.count == 1 and c.count == 1
+
+
 def test_sketch_registry_get_or_create_export_conflict(tmp_path):
     r = obs.MetricsRegistry()
     s = r.sketch("serving.ttft_s")
@@ -308,6 +366,12 @@ def test_engine_step_segments_flight_and_auto_dumps(tmp_path):
     assert evts[0]["prefills"] == [[0, 16, 1]]
     assert evts[-1]["retired"] == [[rid, "length"]]
     assert all(e["t_admit_s"] >= 0 for e in evts)
+    # every tick event carries BOTH clocks: wall ts (cross-process
+    # timeline alignment) and monotonic ts_mono (the timeline builder
+    # re-anchors on it, so ordering survives wall-clock steps)
+    assert all(e["ts"] > 1e9 and e["ts_mono"] >= 0 for e in evts)
+    assert [e["ts_mono"] for e in evts] \
+        == sorted(e["ts_mono"] for e in evts)
     assert not os.path.exists(dump)     # nothing dumped on a clean run
 
     # -- (3): deadline retirement auto-dumps --------------------------------
@@ -351,6 +415,110 @@ def test_engine_step_segments_flight_and_auto_dumps(tmp_path):
     assert hdr["reason"] == "pool_exhausted:submit"
 
 
+# ---- SLO burn-rate watchdog -------------------------------------------------
+
+class _TripSource:
+    """Watchdog trip target: anything with a ``flight`` ring (the
+    Router's shape)."""
+
+    def __init__(self):
+        self.flight = obs.FlightRecorder(capacity=16, name="tier")
+
+
+def test_burn_watchdog_window_semantics_and_gauges():
+    r = obs.MetricsRegistry()
+    wd = obs.BurnRateWatchdog(ttft_slo_s=0.1, error_budget=0.1,
+                              min_samples=10, registry=r)
+    # replica-labeled series sum naturally — the tier shape
+    s0 = r.sketch("serving.ttft_s", replica="0")
+    s1 = r.sketch("serving.ttft_s", replica="1")
+    for _ in range(4):
+        s0.observe(0.01)
+    # thin window (4 < min_samples): not judged, no gauge, stays OPEN
+    st = wd.check()
+    assert st == {"burn": {}, "tripped": []}
+    assert r.series("serving.slo_ttft_burn_rate") == []
+    # more samples: the still-open window now spans ALL 20 (1 violation
+    # across both replicas) -> burn = (1/20)/0.1 = 0.5, gauged
+    for _ in range(15):
+        s1.observe(0.01)
+    s1.observe(5.0)
+    st = wd.check()
+    assert st["burn"]["ttft"] == pytest.approx(0.5)
+    assert st["tripped"] == []
+    assert r.gauge("serving.slo_ttft_burn_rate").value == 0.5
+    # no new samples: the NEXT window is empty -> thin again, the gauge
+    # keeps its last judged value
+    st = wd.check()
+    assert st["burn"] == {} and wd.trips == 0
+    assert r.gauge("serving.slo_ttft_burn_rate").value == 0.5
+
+
+def test_burn_watchdog_trip_dumps_flight_and_timeline(tmp_path):
+    r = obs.MetricsRegistry()
+    wd = obs.BurnRateWatchdog(ttft_slo_s=0.1, tpot_slo_s=0.05,
+                              error_budget=0.1, trip_burn=1.0,
+                              min_samples=8, dump_dir=str(tmp_path),
+                              registry=r)
+    sk = r.sketch("serving.ttft_s")
+    for _ in range(8):
+        sk.observe(0.01)
+    tp = r.sketch("serving.tpot_s")
+    for _ in range(4):
+        tp.observe(0.01)
+        tp.observe(5.0)             # 50% TPOT violations: burn 5.0
+    src = _TripSource()
+    src.flight.record({"step": 0, "ts": time.time()})
+    st = wd.check(source=src)
+    assert st["tripped"] == ["tpot"]
+    assert st["burn"]["ttft"] == pytest.approx(0.0)
+    assert st["burn"]["tpot"] == pytest.approx(5.0)
+    assert wd.trips == 1
+    # the trip counter is UNLABELED (one tier-wide series)
+    assert r.counter("serving.slo_watchdog_trips").value == 1
+    # the tripping source's ring got the postmortem marker
+    marks = [e for e in src.flight.events()
+             if e.get("kind") == "slo_burn_trip"]
+    assert len(marks) == 1 and marks[0]["tripped"] == ["tpot"]
+    assert marks[0]["burn"]["tpot"] == pytest.approx(5.0)
+    # and a Perfetto timeline slice of that ring was written
+    assert st["timeline_path"] == str(tmp_path / "slo_trip_1.json")
+    doc = json.load(open(st["timeline_path"]))
+    assert isinstance(doc["traceEvents"], list)
+    assert any(e.get("args", {}).get("name") == "tier"
+               for e in doc["traceEvents"] if e["ph"] == "M")
+
+
+def test_burn_watchdog_check_never_raises(tmp_path):
+    """A broken dump sink must not kill the serving tick: dump_dir
+    colliding with an existing FILE makes the trip dump fail, and
+    check() still returns (trip counted, no timeline_path)."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("in the way")
+    r = obs.MetricsRegistry()
+    wd = obs.BurnRateWatchdog(ttft_slo_s=0.1, min_samples=4,
+                              dump_dir=str(blocked), registry=r)
+    sk = r.sketch("serving.ttft_s")
+    for _ in range(4):
+        sk.observe(5.0)             # 100% violations
+    st = wd.check(source=_TripSource())
+    assert st["tripped"] == ["ttft"] and wd.trips == 1
+    assert "timeline_path" not in st
+
+
+def test_burn_watchdog_constructor_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        obs.BurnRateWatchdog()
+    with pytest.raises(ValueError, match="error_budget"):
+        obs.BurnRateWatchdog(ttft_slo_s=0.1, error_budget=0.0)
+    with pytest.raises(ValueError, match="error_budget"):
+        obs.BurnRateWatchdog(ttft_slo_s=0.1, error_budget=1.5)
+    with pytest.raises(ValueError, match=">= 1"):
+        obs.BurnRateWatchdog(ttft_slo_s=0.1, check_every=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        obs.BurnRateWatchdog(ttft_slo_s=0.1, min_samples=0)
+
+
 # ---- metric-name drift guard ------------------------------------------------
 
 def test_metric_names_documented_in_observability_table():
@@ -381,13 +549,15 @@ def test_metric_names_documented_in_observability_table():
 
 # ---- load_bench smoke (open-loop harness, BENCH percentile fields) ----------
 
-def test_load_bench_smoke_emits_slo_percentiles():
+def test_load_bench_smoke_emits_slo_percentiles(tmp_path):
     """`not slow` CI smoke: load_bench at tiny CPU scale (with the PR 8
     overload knobs armed: --shed bounded queue + a priority mix) must
     emit one schema-valid record per offered-load point carrying
     p50/p95/p99 TTFT+TPOT, goodput-under-SLO, the step-segment
     breakdown and the shed_rate/preemptions robustness fields, plus the
-    final knee record with the full curve."""
+    final knee record with the full curve — and, with --timeline, a
+    Perfetto trace-event export of the last sweep point."""
+    tpath = str(tmp_path / "t.json")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", "load_bench.py"),
@@ -400,7 +570,8 @@ def test_load_bench_smoke_emits_slo_percentiles():
          # A/B surface (chunk_tokens/prefill_chunks record fields)
          "--chunk_tokens", "16", "--prompt_mix", "long",
          "--long_prompt", "40", "--long_frac", "0.4",
-         "--priority_mix", "low:1,normal:2,high:1"],
+         "--priority_mix", "low:1,normal:2,high:1",
+         "--timeline", tpath],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     recs = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -432,3 +603,10 @@ def test_load_bench_smoke_emits_slo_percentiles():
     knee = recs[2]
     assert knee["unit"] == "req/s" and len(knee["curve"]) == 2
     assert knee["slo_ttft_s"] == 30.0 and knee["knee_goodput"] == 0.9
+    # --timeline rode along: the knee record names a Perfetto-loadable
+    # trace-event export of the last sweep point
+    assert knee["timeline_path"] == tpath
+    assert knee["trace_count"] >= 1
+    doc = json.load(open(tpath))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    assert doc["otherData"]["trace_count"] == knee["trace_count"]
