@@ -188,10 +188,18 @@ def drive_chaos_router(rt, ns, reqs, arrivals):
             live = rt.live_replicas
             if live:
                 # round-robin the roundtrip sanitizer over live
-                # replicas; drift propagates and fails the bench
+                # replicas; drift propagates and fails the bench. A
+                # cross-process replica runs the check INSIDE its
+                # worker (the twin engine must live beside the real
+                # one); SnapshotDriftError keeps its type through the
+                # RPC error envelope.
                 victim = live[roundtrip_cursor % len(live)]
                 roundtrip_cursor += 1
-                rt_guard.snapshot_roundtrip(rt.replica_engine(victim))
+                veng = rt.replica_engine(victim)
+                if hasattr(veng, "snapshot_roundtrip"):
+                    veng.snapshot_roundtrip()
+                else:
+                    rt_guard.snapshot_roundtrip(veng)
         if ns.kill_replica_every and tick % ns.kill_replica_every == 0 \
                 and kills < ns.max_kills:
             live = rt.live_replicas
@@ -206,8 +214,9 @@ def drive_chaos_router(rt, ns, reqs, arrivals):
                     if root:
                         shutil.rmtree(root, ignore_errors=True)
                 print(f"# kill #{kills + 1}: replica {victim} "
-                      f"(forcing {mode})", file=sys.stderr)
-                rt.kill_replica(victim)
+                      f"(forcing {mode}, {ns.kill_mode})",
+                      file=sys.stderr)
+                rt.kill_replica(victim, mode=ns.kill_mode)
                 kills += 1
     return accepted, rejected, kills, time.perf_counter() - t0
 
@@ -271,6 +280,27 @@ def main():
                     "every N router ticks (0 = no kills), up to "
                     "--max_kills")
     ap.add_argument("--max_kills", type=int, default=3)
+    ap.add_argument("--processes", action="store_true",
+                    help="router mode: one OS process per replica "
+                    "(serving.worker.ReplicaProxy over the CRC-framed "
+                    "transport). The zero-loss exit contract then "
+                    "covers REAL process death — --kill_mode sigkill "
+                    "sends an actual SIGKILL mid-step — plus torn-"
+                    "frame transport faults (--transport_fault_every)")
+    ap.add_argument("--kill_mode", choices=("close", "sigkill"),
+                    default="close",
+                    help="how --kill_replica_every kills: 'close' "
+                    "drops the engine in-process; 'sigkill' "
+                    "(--processes only) sends a real SIGKILL armed to "
+                    "land mid-step")
+    ap.add_argument("--transport_fault_every", type=int, default=0,
+                    help="processes mode: raise an injected "
+                    "TransportCorruption (torn frame) at every Nth "
+                    "transport.recv, alternating a single torn frame "
+                    "(the CRC rejection -> idempotent retry path) with "
+                    "a burst long enough to exhaust the retry budget "
+                    "(broken proxy -> reap -> failover)")
+    ap.add_argument("--max_transport_faults", type=int, default=2)
     ap.add_argument("--snapshot_every", type=int, default=8,
                     help="router mode: round-robin one replica "
                     "snapshot through the integrity-manifest path "
@@ -313,7 +343,29 @@ def main():
             r["deadline"] = None
 
     speculate = build_speculate(ns)
-    if ns.replicas > 1:
+    if ns.processes and ns.replicas < 2:
+        raise SystemExit("--processes needs --replicas >= 2")
+    if ns.kill_mode == "sigkill" and not ns.processes:
+        raise SystemExit("--kill_mode sigkill needs --processes (an "
+                         "in-process replica has no pid to SIGKILL)")
+    if ns.transport_fault_every and not ns.processes:
+        raise SystemExit("--transport_fault_every needs --processes")
+    if ns.processes:
+        import functools
+
+        from serving_bench import build_model_only
+        ekw = engine_kwargs(ns, flight_dump, speculate)
+        ekw.pop("flight_dump_path")     # router forwards its own
+        for k in ("mesh", "speculate"):     # in-process-only knobs
+            if ekw.get(k) is not None:
+                raise SystemExit(f"--processes does not support {k}")
+            ekw.pop(k, None)
+        eng = serving.Router(
+            None, replicas=ns.replicas, processes=True,
+            model_factory=functools.partial(build_model_only, ns.model),
+            root=snap_root, snapshot_every=ns.snapshot_every,
+            flight_dump_path=flight_dump, **ekw)
+    elif ns.replicas > 1:
         ekw = engine_kwargs(ns, flight_dump, speculate)
         ekw.pop("flight_dump_path")     # router forwards its own
         eng = serving.Router(
@@ -345,12 +397,43 @@ def main():
           f"~ {cap_rps:.2f} req/s; offering {ns.load:g}x",
           file=sys.stderr)
 
-    plan = faults.FaultPlan(*[
-        faults.Fault("decode.dispatch",
-                     kind=("raise" if k % 2 == 0
-                           else "resource_exhausted"),
-                     at=(k + 1) * ns.fault_every)
-        for k in range(ns.max_faults)])
+    if ns.processes:
+        # engine-level faults live IN the workers — ship the schedule
+        # over the arm_faults RPC so each worker fires its own
+        # decode.dispatch crashes (a worker step crash rides the typed
+        # error envelope back and lands in the router's step-crash →
+        # failover path, same accounting as in-process). The parent
+        # plan carries the TRANSPORT faults: the wire is parent-side.
+        wspecs = [
+            {"site": "decode.dispatch",
+             "kind": ("raise" if k % 2 == 0 else "resource_exhausted"),
+             "at": (k + 1) * ns.fault_every}
+            for k in range(ns.max_faults)]
+        for ri in eng.live_replicas:
+            eng.replica_engine(ri).arm_faults(wspecs)
+        pfaults = []
+        if ns.transport_fault_every:
+            from paddle_tpu.serving.transport import TransportCorruption
+            burst = eng.retry_policy.max_attempts + 1
+            for k in range(ns.max_transport_faults):
+                # even slots: ONE torn frame (CRC rejection — an
+                # idempotent retry absorbs it); odd slots: a burst
+                # outlasting the retry budget (exhaustion → broken
+                # proxy → reap → failover)
+                pfaults.append(faults.Fault(
+                    "transport.recv", kind="raise",
+                    at=(k + 1) * ns.transport_fault_every,
+                    count=(1 if k % 2 == 0 else burst),
+                    exc=TransportCorruption(
+                        "injected: torn frame (chaos)")))
+        plan = faults.FaultPlan(*pfaults)
+    else:
+        plan = faults.FaultPlan(*[
+            faults.Fault("decode.dispatch",
+                         kind=("raise" if k % 2 == 0
+                               else "resource_exhausted"),
+                         at=(k + 1) * ns.fault_every)
+            for k in range(ns.max_faults)])
     faults.arm(plan)
     arrivals = gen_arrivals(ns.requests, ns.load * cap_rps, "poisson",
                             rng)
@@ -384,6 +467,12 @@ def main():
             finishes[f] = finishes.get(f, 0) + 1
     shed = rejected + finishes.get("shed", 0)
     fired = len(plan.fired())
+    if ns.processes:
+        # worker-side fires (decode.dispatch inside replicas). A killed
+        # worker takes its count with it — telemetry undercount, never
+        # an overcount, so the fired-but-no-restore gate stays sound.
+        fired += sum(eng.replica_engine(ri).faults_fired()
+                     for ri in eng.live_replicas)
     # whole-run marker census: the auto-dump file spans every engine
     # incarnation (each crash + each restore dumped); the live ring only
     # covers the last one

@@ -83,6 +83,14 @@ def build_model(name):
     return cfg, m
 
 
+def build_model_only(name):
+    """Module-level (hence picklable) model factory half for the
+    cross-process serving tier: each worker process rebuilds the model
+    itself, and ``paddle_tpu.seed(0)`` inside `build_model` makes every
+    replica's weights bit-identical to the parent's reference copy."""
+    return build_model(name)[1]
+
+
 def make_workload(ns, rng):
     """N requests: Poisson arrivals (exp gaps, in decode-step units),
     mixed prompt lengths and LONG-TAILED token budgets, optional shared

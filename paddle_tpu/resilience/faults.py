@@ -39,6 +39,23 @@ site                        fired from / index
                             propagating; enough consecutive misses
                             declare the replica dead and trigger
                             zero-loss failover
+``transport.send``          ``serving.transport.Channel.send`` — call
+                            counter, fired BEFORE the frame is written,
+                            so a raising fault never leaves a half
+                            frame on the wire; a raised
+                            ``TransportCorruption`` simulates a torn
+                            frame the peer's CRC check would reject
+``transport.recv``          ``serving.transport.Channel.recv`` — call
+                            counter, fired BEFORE the read, so the
+                            frame stays queued for the retry
+``worker.tick``             ``serving.worker`` serve loop — one call
+                            per received RPC message, fired before the
+                            op dispatches; kind='hang' makes the worker
+                            sleep ``seconds`` (payload) holding the
+                            reply, which the router's wall-clock
+                            heartbeat deadline must convert into
+                            suspect → dead, exactly as a live-but-hung
+                            process would
 ==========================  ================================================
 
 Zero-overhead contract: with no plan armed, ``maybe_fire`` is ONE global
@@ -51,9 +68,12 @@ Kinds split in two families:
   at the site — the caller's normal exception handling (restart loop,
   degradation ladder) takes over, exactly as a real fault would.
 * **cooperative** (``nan_grads``, ``corrupt_checkpoint``,
-  ``drop_heartbeat``): ``maybe_fire`` RETURNS the fired `Fault`; the
-  hooked site applies the effect itself (poison the step outputs, damage
-  the files just committed, skip the store put).
+  ``drop_heartbeat``, ``hang``): ``maybe_fire`` RETURNS the fired
+  `Fault`; the hooked site applies the effect itself (poison the step
+  outputs, damage the files just committed, skip the store put, sleep
+  ``seconds`` at the exact point the site documents — e.g.
+  ``serving.snapshot`` hangs INSIDE the torn window, after the engine
+  state is written but before the manifest commits).
 """
 
 import logging
@@ -68,7 +88,8 @@ __all__ = [
 ]
 
 RAISING_KINDS = ("raise", "resource_exhausted")
-COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat")
+COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat",
+                     "hang")
 
 #: The registered fault sites — the module-docstring table in code.
 #: tpu-lint's `fault-site` rule pins every `maybe_fire(...)`/`Fault(...)`
@@ -77,7 +98,8 @@ COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat")
 #: warns on plans naming unknown sites (tests may use ad-hoc ones).
 KNOWN_SITES = ("train.step", "checkpoint.save", "elastic.heartbeat",
                "decode.dispatch", "kv.op", "serving.snapshot",
-               "router.heartbeat")
+               "router.heartbeat", "transport.send", "transport.recv",
+               "worker.tick")
 
 
 class SimulatedResourceExhausted(RuntimeError):

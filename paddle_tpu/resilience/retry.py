@@ -10,12 +10,19 @@ every retry lands on the `resilience.retries` counter so fleet health
 is visible in the metrics exporters.
 
 Deterministic by design: the backoff schedule is a pure function of the
-policy (no jitter) so tests can assert the exact sleep sequence, and
-the injected `sleep` argument makes the tests instant.
+policy — including the OPTIONAL jitter, which is seeded rather than
+drawn from a PRNG stream. Jitter exists because N workers that all lose
+the same peer at the same instant would otherwise retry in lockstep (a
+retry storm, re-synchronized every backoff rung); folding the policy
+``seed`` and the attempt index through a hash de-correlates the
+schedules while keeping every schedule reproducible — tests can still
+assert the exact sleep sequence for a fixed seed, and the injected
+`sleep` argument makes the tests instant.
 """
 
 import logging
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional, Tuple, Type
 
@@ -32,19 +39,38 @@ __all__ = [
 class RetryPolicy:
     """max_attempts counts the FIRST try too: max_attempts=3 means one
     call plus at most two retries. Delay before retry k (1-based) is
-    min(base_delay_s * backoff**(k-1), max_delay_s)."""
+    min(base_delay_s * backoff**(k-1), max_delay_s), then scaled by the
+    deterministic jitter factor for (seed, k) when ``jitter > 0``:
+    a value in [1 - jitter, 1 + jitter] derived from crc32(seed:k) —
+    no PRNG state, so two policies with the same seed produce the SAME
+    schedule and two workers with different seeds de-correlate."""
 
     max_attempts: int = 3
     base_delay_s: float = 0.05
     backoff: float = 2.0
     max_delay_s: float = 2.0
+    jitter: float = 0.0
+    seed: int = 0
+
+
+def _jitter_factor(seed: int, attempt: int, jitter: float) -> float:
+    """Deterministic scale in [1 - jitter, 1 + jitter] for retry
+    `attempt` (1-based) under `seed` — the attempt index is folded into
+    the hash so consecutive rungs of ONE schedule de-correlate too."""
+    u = zlib.crc32(f"{int(seed)}:{int(attempt)}".encode()) / 0xFFFFFFFF
+    return 1.0 + jitter * (2.0 * u - 1.0)
 
 
 def backoff_delays(policy: RetryPolicy) -> Iterable[float]:
-    """The (max_attempts - 1) sleep durations, in order."""
+    """The (max_attempts - 1) sleep durations, in order (jittered when
+    the policy asks — the cap applies BEFORE the jitter scale, so the
+    spread survives saturation at max_delay_s)."""
     d = policy.base_delay_s
-    for _ in range(max(policy.max_attempts - 1, 0)):
-        yield min(d, policy.max_delay_s)
+    for k in range(1, max(policy.max_attempts, 1)):
+        delay = min(d, policy.max_delay_s)
+        if policy.jitter:
+            delay *= _jitter_factor(policy.seed, k, policy.jitter)
+        yield max(delay, 0.0)
         d *= policy.backoff
 
 

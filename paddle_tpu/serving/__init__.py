@@ -16,15 +16,20 @@ in the PAPERS lineage).
 """
 
 from paddle_tpu.serving.engine import (  # noqa: F401
-    ENGINE_SNAPSHOT_SCHEMA, PRIORITIES, Rejected, Request, RequestResult,
-    RestoreError, ServingEngine)
+    ENGINE_SNAPSHOT_SCHEMA, PRIORITIES, DrainTimeout, Rejected, Request,
+    RequestResult, RestoreError, ServingEngine)
 from paddle_tpu.serving.layout import ServingLayout  # noqa: F401
 from paddle_tpu.serving.pool import (  # noqa: F401
     SCRATCH_BLOCK, BlockPool, PoolExhausted, PrefixCache, PrefixEntry)
 from paddle_tpu.serving.router import (  # noqa: F401
-    REPLICA_STATES, ROUTER_JOURNAL_SCHEMA, Router, RouterJournal)
+    REPLICA_ROLES, REPLICA_STATES, ROUTER_JOURNAL_SCHEMA, ReplicaRole,
+    Router, RouterJournal)
 from paddle_tpu.serving.spec import (  # noqa: F401
     PROPOSERS, SpecConfig)
+from paddle_tpu.serving.transport import (  # noqa: F401
+    PROTOCOL_VERSION, RemoteError, TransportClosed, TransportCorruption,
+    TransportError, TransportTimeout)
+from paddle_tpu.serving.worker import ReplicaProxy  # noqa: F401
 
 __all__ = [
     "Request", "RequestResult", "ServingEngine", "ServingLayout",
@@ -32,5 +37,8 @@ __all__ = [
     "PROPOSERS", "BlockPool", "PoolExhausted", "PrefixCache",
     "PrefixEntry", "SCRATCH_BLOCK", "Rejected", "RestoreError",
     "PRIORITIES", "ENGINE_SNAPSHOT_SCHEMA", "Router", "RouterJournal",
-    "ROUTER_JOURNAL_SCHEMA", "REPLICA_STATES",
+    "ROUTER_JOURNAL_SCHEMA", "REPLICA_STATES", "REPLICA_ROLES",
+    "ReplicaRole", "DrainTimeout", "ReplicaProxy", "PROTOCOL_VERSION",
+    "TransportError", "TransportClosed", "TransportCorruption",
+    "TransportTimeout", "RemoteError",
 ]

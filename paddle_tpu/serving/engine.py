@@ -205,6 +205,21 @@ class Rejected(RuntimeError):
         self.reason = reason
 
 
+class DrainTimeout(RuntimeError):
+    """Typed drain-deadline failure: ``Router.drain(timeout_s=...)`` /
+    ``drain_replica(..., timeout_s=...)`` raise this instead of
+    spinning when a replica stops answering inside the wall-clock
+    budget. ``replica`` names the stuck replica slot (None when the
+    stall is tier-wide) and ``queue_depth`` is the work still parked
+    behind it — the two facts an operator needs to decide between
+    waiting longer and killing the worker."""
+
+    def __init__(self, msg: str, *, replica=None, queue_depth: int = 0):
+        super().__init__(msg)
+        self.replica = replica
+        self.queue_depth = int(queue_depth)
+
+
 class RestoreError(ValueError):
     """Typed :meth:`ServingEngine.restore` failure.
 
@@ -1506,6 +1521,34 @@ class ServingEngine:
         if tokens is not None:
             request._resume_tokens = list(tokens) or None
         return self._enqueue(request)
+
+    def release_request(self, request_id: int) -> Optional[List[int]]:
+        """Remove one UNFINISHED request from this engine entirely and
+        return its generated-so-far tokens (the token-exact resume
+        state another engine re-admits through
+        :meth:`admit_resumable`) — the role-migration primitive: a
+        prefill-role replica releases a request at first token and the
+        router re-places it on a decode-role replica. An active slot
+        goes through the preemption path first (blocks freed, full
+        bf16 blocks donated to the prefix cache, resume tokens
+        captured), then the requeued request is popped back out.
+        Returns None when this engine does not hold the request
+        unfinished (already retired, or never here) — the caller must
+        NOT re-place it elsewhere in that case."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        rid = int(request_id)
+        slot_idx = next((i for i, s in enumerate(self._slots)
+                         if s is not None and s.req.request_id == rid),
+                        None)
+        if slot_idx is not None:
+            self._preempt(slot_idx)
+        for req in list(self._queue.items()):
+            if req.request_id == rid:
+                self._queue.remove(req)
+                self._update_gauges()
+                return list(req._resume_tokens or [])
+        return None
 
     def inflight_tokens(self) -> Dict[int, List[int]]:
         """``{request_id: generated-so-far tokens}`` for every
@@ -4001,7 +4044,7 @@ class ServingEngine:
         from paddle_tpu.resilience import faults as _faults
         from paddle_tpu.resilience import integrity as _integ
 
-        _faults.maybe_fire("serving.snapshot")
+        fault = _faults.maybe_fire("serving.snapshot")
         snap = self.snapshot()
         if self._sanitize_roundtrip:
             # sanitize="roundtrip"/"all": verify the snapshot being
@@ -4032,6 +4075,14 @@ class ServingEngine:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        if fault is not None and fault.kind == "hang":
+            # the TORN window, held open on demand: engine.json is
+            # committed but the manifest (the commit marker) is not.
+            # A SIGKILL landing here leaves exactly the half-commit
+            # that load_snapshot's walk-back must skip — the
+            # cross-process torn-snapshot test kills the worker inside
+            # this sleep and pins the walk-back.
+            time.sleep(float(fault.payload.get("seconds", 3600.0)))
         _integ.write_manifest(root, step, _integ.file_checksums(step_dir))
         self._metrics.counter("serving.snapshots").inc()
         return step_dir

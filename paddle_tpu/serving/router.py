@@ -68,8 +68,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddle_tpu.serving.engine import (Rejected, Request, RequestResult,
-                                       RestoreError, ServingEngine)
+from paddle_tpu.serving.engine import (DrainTimeout, Rejected, Request,
+                                       RequestResult, RestoreError,
+                                       ServingEngine)
 from paddle_tpu.serving.journal import (ROUTER_JOURNAL_SCHEMA,
                                         RouterJournal)
 from paddle_tpu.serving.pool import PoolExhausted
@@ -77,7 +78,7 @@ from paddle_tpu.serving.pool import PoolExhausted
 logger = logging.getLogger("paddle_tpu.serving")
 
 __all__ = ["Router", "RouterJournal", "ROUTER_JOURNAL_SCHEMA",
-           "REPLICA_STATES"]
+           "REPLICA_STATES", "REPLICA_ROLES", "ReplicaRole"]
 
 #: replica health states. healthy/suspect take placements (suspect only
 #: when no healthy replica can), draining serves but takes none, dead is
@@ -85,6 +86,25 @@ __all__ = ["Router", "RouterJournal", "ROUTER_JOURNAL_SCHEMA",
 #: hashing stays stable as the tier grows).
 REPLICA_STATES = ("healthy", "suspect", "dead", "draining", "removed")
 _STATE_RANK = {s: i for i, s in enumerate(REPLICA_STATES)}
+
+
+class ReplicaRole:
+    """Splitwise/DistServe-style role disaggregation: a ``prefill``
+    replica takes fresh admissions and releases each request at first
+    token; a ``decode`` replica takes the migrated resume work;
+    ``mixed`` (the default) does both. Placement filters candidates by
+    the request's phase and FALLS BACK to any placeable replica rather
+    than strand work — roles are a routing preference, never a
+    correctness gate (migration rides the token-exact resume path, so
+    a roled run is bit-identical to a mixed one)."""
+
+    PREFILL = "prefill"
+    DECODE = "decode"
+    MIXED = "mixed"
+
+
+REPLICA_ROLES = (ReplicaRole.PREFILL, ReplicaRole.DECODE,
+                 ReplicaRole.MIXED)
 
 
 class _Tracked:
@@ -126,13 +146,14 @@ class _Tracked:
 
 
 class _Replica:
-    __slots__ = ("engine", "state", "misses", "root")
+    __slots__ = ("engine", "state", "misses", "root", "role")
 
-    def __init__(self, engine, root):
+    def __init__(self, engine, root, role: str = ReplicaRole.MIXED):
         self.engine = engine
         self.state = "healthy"
         self.misses = 0
         self.root = root
+        self.role = role
 
 
 class Router:
@@ -163,6 +184,12 @@ class Router:
                  flight_capacity: int = 256,
                  flight_dump_path: Optional[str] = None,
                  watchdog=None,
+                 processes: bool = False,
+                 model_factory=None,
+                 roles: Optional[Sequence[str]] = None,
+                 rpc_timeout_s: float = 180.0,
+                 heartbeat_timeout_s: float = 10.0,
+                 start_timeout_s: float = 300.0,
                  seed: int = 0, **engine_kwargs):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -174,9 +201,44 @@ class Router:
             raise ValueError(
                 f"need 1 <= suspect_after <= dead_after, got "
                 f"suspect_after={suspect_after} dead_after={dead_after}")
+        self.processes = bool(processes)
+        self.model_factory = model_factory
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.start_timeout_s = float(start_timeout_s)
+        if self.processes:
+            if model_factory is None:
+                raise ValueError(
+                    "processes=True requires model_factory= (a picklable "
+                    "zero-arg callable; each worker builds its OWN model "
+                    "— weights must be deterministic so replicas agree)")
+            for k in ("mesh", "layout", "speculate"):
+                if engine_kwargs.get(k) is not None:
+                    raise ValueError(
+                        f"processes=True does not support engine kwarg "
+                        f"{k!r} yet — run mesh/speculative replicas "
+                        f"in-process")
+        elif model is None:
+            raise ValueError("model is required for in-process replicas "
+                             "(processes=False)")
+        if roles is None:
+            roles = [ReplicaRole.MIXED] * replicas
+        roles = [str(r) for r in roles]
+        if len(roles) != replicas:
+            raise ValueError(f"roles must name one role per replica: "
+                             f"got {len(roles)} for {replicas} replicas")
+        for r in roles:
+            if r not in REPLICA_ROLES:
+                raise ValueError(f"unknown replica role {r!r}; one of "
+                                 f"{REPLICA_ROLES}")
         self.model = model
-        self._state = state if state is not None else _inference_state(
-            model)
+        if state is not None:
+            self._state = state
+        else:
+            # processes mode: the workers build their own models and
+            # inference state; the parent never touches device weights
+            self._state = (None if self.processes
+                           else _inference_state(model))
         # tpu-lint: volatile(constructor config — recover() rebuilds it
         # from router_kwargs; set_overload_controls re-arms post-bench)
         self._engine_kwargs = dict(engine_kwargs)
@@ -204,7 +266,8 @@ class Router:
         self._replicas: List[_Replica] = []
         for i in range(replicas):
             self._replicas.append(
-                _Replica(self._new_engine(i), self._replica_root(i)))
+                _Replica(self._new_engine(i), self._replica_root(i),
+                         role=roles[i]))
         self._requests: Dict[int, _Tracked] = {}
         self._open: set = set()         # accepted, not yet finished
         self.results: Dict[int, RequestResult] = {}
@@ -242,11 +305,23 @@ class Router:
         return (os.path.join(self.root, f"replica_{i}")
                 if self.root is not None else None)
 
-    def _new_engine(self, i: int) -> ServingEngine:
+    def _new_engine(self, i: int, restore_root: Optional[str] = None):
         """Build replica ``i``'s engine. Every replica's metric series
         carry a ``replica="<i>"`` label (a registry view — storage
         stays process-global), so :meth:`metrics_snapshot` can merge
-        the tier and a dashboard can still tell replicas apart."""
+        the tier and a dashboard can still tell replicas apart.
+        ``processes=True`` spawns a worker process behind a
+        :class:`~paddle_tpu.serving.worker.ReplicaProxy` instead —
+        with ``restore_root`` the WORKER attempts the snapshot restore
+        itself and reports restored/covered in its handshake."""
+        if self.processes:
+            from paddle_tpu.serving.worker import ReplicaProxy
+            return ReplicaProxy.start(
+                self.model_factory, engine_kwargs=self._engine_kwargs,
+                replica=i, seed=self.seed, restore_root=restore_root,
+                rpc_timeout_s=self.rpc_timeout_s,
+                start_timeout_s=self.start_timeout_s,
+                retry_policy=self.retry_policy)
         return ServingEngine(self.model, state=self._state,
                              seed=self.seed,
                              metrics_labels={"replica": str(i)},
@@ -323,20 +398,28 @@ class Router:
             digest_size=8).digest()
         return int.from_bytes(digest, "big") % self.num_replicas
 
-    def _placeable(self) -> List[int]:
+    def _placeable(self, phase: Optional[str] = None) -> List[int]:
         """Replica indices that take new placements: healthy first;
         suspect only when no healthy replica exists (a suspect replica
-        is probably alive — better than shedding the tier)."""
+        is probably alive — better than shedding the tier). When
+        ``phase`` is given ("prefill" / "decode"), replicas whose role
+        matches it (or is mixed) are PREFERRED — but a role mismatch
+        never strands work: if no role-compatible replica is placeable
+        the full candidate set is returned."""
         healthy = [i for i, r in enumerate(self._replicas)
                    if r.state == "healthy" and r.engine is not None
                    and not r.engine.closed]
-        if healthy:
-            return healthy
-        return [i for i, r in enumerate(self._replicas)
-                if r.state == "suspect" and r.engine is not None
-                and not r.engine.closed]
+        base = healthy or [i for i, r in enumerate(self._replicas)
+                           if r.state == "suspect" and r.engine is not None
+                           and not r.engine.closed]
+        if phase is None:
+            return base
+        pref = [i for i in base
+                if self._replicas[i].role in (phase, ReplicaRole.MIXED)]
+        return pref or base
 
-    def _placement_order(self, request: Request):
+    def _placement_order(self, request: Request,
+                         phase: Optional[str] = None):
         """(ordered candidate indices, policy): the affinity slot first
         unless its load exceeds ``affinity_overload_factor`` x the
         least-loaded candidate, then the rest by ascending load score
@@ -344,7 +427,7 @@ class Router:
         available) tie-broken by pool-block occupancy and queue
         depth, the same signals the ``serving.pool_blocks_*`` /
         ``serving.queue_depth`` gauges export."""
-        cands = self._placeable()
+        cands = self._placeable(phase)
         if not cands:
             return [], "none"
         loads = {}
@@ -392,7 +475,9 @@ class Router:
         if request.seed is None:
             request.seed = self.seed + self._seeds_issued
             self._seeds_issued += 1
-        order, policy = self._placement_order(request)
+        phase = ("decode" if getattr(request, "_resume_tokens", None)
+                 else "prefill")
+        order, policy = self._placement_order(request, phase)
         r = registry()
         if not order:
             self.router_stats["rejected_tier"] += 1
@@ -442,7 +527,10 @@ class Router:
     def _heartbeat(self, i: int, rep: _Replica):
         """One heartbeat probe: the ``router.heartbeat`` fault site
         (a raising fault IS a miss), then liveness (a closed engine is
-        definitively dead — no grace period)."""
+        definitively dead — no grace period). Cross-process replicas
+        add a WALL-CLOCK ping: a worker that does not answer inside
+        ``heartbeat_timeout_s`` — hung, not just dead — is a miss, and
+        an EOF (the process is gone) is declared dead immediately."""
         from paddle_tpu.observability import registry
         from paddle_tpu.resilience import faults as _faults
 
@@ -454,6 +542,11 @@ class Router:
         if rep.engine is None or rep.engine.closed:
             self._declare_dead(i, rep, "engine_closed")
             return
+        if ok and hasattr(rep.engine, "ping"):
+            ok = rep.engine.ping(timeout_s=self.heartbeat_timeout_s)
+            if rep.engine.closed:
+                self._declare_dead(i, rep, "worker_gone")
+                return
         if ok:
             rep.misses = 0
             if rep.state == "suspect":
@@ -490,6 +583,40 @@ class Router:
             if isinstance(v, (int, float)):
                 self._stats_base[k] = self._stats_base.get(k, 0) + v
 
+    def _restore_engine(self, i: int, rep: _Replica):
+        """Try to bring replica ``i`` back from its snapshot root.
+        Returns ``(engine_or_None, covered_rids, mode)`` where mode is
+        "restore" (the engine resumed its snapshotted slots/queue
+        token-exactly) or "redistribute" (nothing restored — the caller
+        re-places tracked work).  In processes mode the RESTORE RUNS IN
+        THE CHILD: a fresh worker is spawned with ``restore_root`` and
+        reports what it covered through the handshake, so the parent
+        never deserializes worker state."""
+        if self.processes:
+            try:
+                eng = self._new_engine(i, restore_root=rep.root)
+            except Exception:   # noqa: BLE001 — spawn/handshake failed
+                logger.warning("router: replica %d worker respawn "
+                               "failed", i, exc_info=True)
+                return None, set(), "redistribute"
+            if getattr(eng, "restored", False):
+                return eng, set(eng.covered), "restore"
+            return eng, set(), "redistribute"
+        try:
+            snap = ServingEngine.load_snapshot(rep.root)
+            eng = ServingEngine.restore(self.model, snap,
+                                        state=self._state,
+                                        **self._restore_overrides(i))
+            covered = {rs["request_id"]
+                       for rs in snap["slots"] + snap["queue"]}
+            return eng, covered, "restore"
+        except FileNotFoundError:
+            return None, set(), "redistribute"   # never snapshotted
+        except (RestoreError, ValueError, KeyError):
+            logger.warning("router: replica %d snapshot unusable; "
+                           "redistributing", i, exc_info=True)
+            return None, set(), "redistribute"
+
     def _failover(self, i: int):
         """Rebuild dead replica ``i`` zero-loss: restore from its last
         committed-and-verified snapshot when possible (the restored
@@ -513,22 +640,22 @@ class Router:
         covered = set()
         mode = "redistribute"
         if rep.root is not None:
+            eng, covered, mode = self._restore_engine(i, rep)
+        if eng is not None and mode != "restore" and not self.rebuild_dead:
+            # a fresh (nothing-restored) worker came up but the tier is
+            # configured to shrink on death rather than rebuild
             try:
-                snap = ServingEngine.load_snapshot(rep.root)
-                eng = ServingEngine.restore(self.model, snap,
-                                            state=self._state,
-                                            **self._restore_overrides(i))
-                covered = {rs["request_id"]
-                           for rs in snap["slots"] + snap["queue"]}
-                mode = "restore"
-            except FileNotFoundError:
-                eng = None      # never snapshotted — rebuild empty
-            except (RestoreError, ValueError, KeyError):
-                logger.warning("router: replica %d snapshot unusable; "
-                               "redistributing", i, exc_info=True)
-                eng = None
+                eng.close()
+            except Exception:   # noqa: BLE001 — best-effort release
+                pass
+            eng = None
         if eng is None and self.rebuild_dead:
-            eng = self._new_engine(i)
+            try:
+                eng = self._new_engine(i)
+            except Exception:   # noqa: BLE001 — spawn/build failed
+                logger.warning("router: replica %d rebuild failed; "
+                               "removing from tier", i, exc_info=True)
+                eng = None
         if eng is not None:
             rep.engine = eng
             rep.state = "healthy"
@@ -569,15 +696,23 @@ class Router:
         still = []
         for t in self._pending_replace:
             req = t.as_request()
-            order, _ = self._placement_order(req)
+            phase = "decode" if t.tokens else "prefill"
+            order, _ = self._placement_order(req, phase)
             if not order:
                 still.append(t)
                 continue
             idx = order[0]
             # admit_resumable bypasses the overload controls: this
             # request was ACCEPTED — shedding it now would be data loss
-            self._replicas[idx].engine.admit_resumable(
-                req, tokens=t.tokens)
+            try:
+                self._replicas[idx].engine.admit_resumable(
+                    req, tokens=t.tokens)
+            except Rejected:
+                # the worker became unreachable between the placement
+                # decision and the RPC — stay pending for the next tick
+                # (its failover runs first)
+                still.append(t)
+                continue
             t.replica = idx
             self.router_stats["replaced"] += 1
             registry().counter("serving.router.replaced").inc()
@@ -621,6 +756,7 @@ class Router:
                 continue
             self._collect(i, rep, out["finished"], finished)
         self._track_progress()
+        self._migrate_roles()
         self._heal_orphans()
         if self.journal is not None \
                 and self._tick % self.journal_progress_every == 0:
@@ -695,7 +831,8 @@ class Router:
 
         req = t.as_request()
         req._resume_tokens = [int(x) for x in res.tokens] or None
-        order, _ = self._placement_order(req)
+        phase = "decode" if req._resume_tokens else "prefill"
+        order, _ = self._placement_order(req, phase)
         for idx in order:
             if idx == exclude:
                 continue
@@ -754,6 +891,46 @@ class Router:
                 if t is not None and not t.finished:
                     t.tokens = toks
 
+    def _migrate_roles(self):
+        """Disaggregated role scheduling (PAPERS.md: prefill/decode
+        separation): a request on a PREFILL-role replica migrates to a
+        decode-capable replica at its first token, through the same
+        token-exact release → re-admit path failover uses. Roles are a
+        routing preference, never a correctness gate: with no
+        decode-capable replica placeable the request degrades in place
+        (the prefill replica keeps decoding it)."""
+        from paddle_tpu.observability import registry
+
+        if all(r.role == ReplicaRole.MIXED for r in self._replicas):
+            return
+        moved = 0
+        for t in self._requests.values():
+            if t.finished or t.replica is None or not t.tokens:
+                continue
+            rep = self._replicas[t.replica]
+            if rep.role != ReplicaRole.PREFILL or rep.engine is None \
+                    or rep.engine.closed:
+                continue
+            if not any(self._replicas[i].role in
+                       (ReplicaRole.DECODE, ReplicaRole.MIXED)
+                       for i in self._placeable()):
+                continue    # nowhere decode-capable — degrade in place
+            toks = rep.engine.release_request(t.rid)
+            if toks is None:
+                continue    # already finished/collected — not held
+            t.tokens = [int(x) for x in toks]
+            self._queue_replace(t)
+            moved += 1
+        if moved:
+            self.router_stats["role_migrations"] = \
+                self.router_stats.get("role_migrations", 0) + moved
+            registry().counter("serving.router.role_migrations").inc(moved)
+            self.flight.mark("role_migration", moved=moved)
+            # re-place NOW (journals "place" with the trace_id, so the
+            # accept→place→finish chain stays connected) rather than
+            # waiting a tick with the request in limbo
+            self._drain_pending_replacements()
+
     def _heal_orphans(self):
         """A tracked unfinished request held by NO live replica (e.g. a
         failover raced a retirement, or a kill dropped an uncollected
@@ -805,12 +982,17 @@ class Router:
             self.flight.mark("snapshot_failed", replica=i)
 
     # --------------------------------------------------------- elasticity
-    def drain_replica(self, i: int) -> List[int]:
+    def drain_replica(self, i: int,
+                      timeout_s: Optional[float] = None) -> List[int]:
         """Elastic drain: stop placement to replica ``i``, snapshot it
         (postmortem trail), migrate its in-flight and queued work onto
         the survivors via the token-exact resume path, and remove it.
         Returns the migrated request ids. Draining the last live
-        replica raises — the work would have nowhere to go."""
+        replica raises — the work would have nowhere to go. With
+        ``timeout_s`` a cross-process replica that does not answer a
+        liveness ping inside the budget raises :class:`DrainTimeout`
+        naming the stuck replica and its queue depth — a hung worker
+        must surface as a typed error, not an indefinite drain."""
         from paddle_tpu.observability import registry
         from paddle_tpu.resilience.retry import call_with_retry
 
@@ -823,6 +1005,17 @@ class Router:
             raise ValueError("cannot drain the last live replica — its "
                              "work would have nowhere to migrate "
                              "(add_replica first)")
+        if timeout_s is not None and hasattr(rep.engine, "ping") \
+                and not rep.engine.ping(timeout_s=timeout_s):
+            depth = 0
+            try:
+                depth = int(rep.engine.queued)
+            except Exception:   # noqa: BLE001 — best-effort depth
+                pass
+            raise DrainTimeout(
+                f"drain_replica({i}): worker did not answer a liveness "
+                f"ping within {timeout_s}s (queue depth {depth})",
+                replica=i, queue_depth=depth)
         rep.state = "draining"
         if rep.root is not None:
             try:
@@ -860,7 +1053,8 @@ class Router:
         self._update_gauges()
         return migrated
 
-    def add_replica(self, warm: bool = True) -> int:
+    def add_replica(self, warm: bool = True,
+                    role: str = ReplicaRole.MIXED) -> int:
         """Grow the tier by one replica; returns its index. With
         ``warm=True`` (default) a throwaway one-block request is run to
         completion first, so the replica's smallest prefill bucket and
@@ -878,8 +1072,12 @@ class Router:
 
         from paddle_tpu.observability import registry
 
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(choose from {REPLICA_ROLES})")
         idx = len(self._replicas)
-        rep = _Replica(self._new_engine(idx), self._replica_root(idx))
+        rep = _Replica(self._new_engine(idx), self._replica_root(idx),
+                       role=role)
         if warm:
             mesh = rep.engine.mesh
             with (mesh if mesh is not None else contextlib.nullcontext()):
@@ -906,23 +1104,37 @@ class Router:
         self._update_gauges()
         return idx
 
-    def kill_replica(self, i: int):
-        """Chaos hook: simulate abrupt replica death (the process-kill
-        analog). The engine's device state, queue, slots AND
-        uncollected results are dropped on the floor — no snapshot, no
-        goodbye. The router only finds out at the next tick's
-        heartbeat, exactly like a real crash; the zero-loss contract
-        must hold anyway (tests/test_serving_router.py,
-        examples/chaos_bench.py --kill_replica_every)."""
+    def kill_replica(self, i: int, mode: str = "close"):
+        """Chaos hook: simulate abrupt replica death. ``mode="close"``
+        (default, works for any tier) drops the engine's device state,
+        queue, slots AND uncollected results on the floor — no
+        snapshot, no goodbye. ``mode="sigkill"`` (cross-process tiers
+        only) sends a REAL ``SIGKILL`` to the worker process, armed to
+        land MID-STEP when the worker is busy — the kernel tears the
+        process down between a request's tokens, the hardest point in
+        a tick. Either way the router only finds out at the next
+        tick's heartbeat, exactly like a real crash; the zero-loss
+        contract must hold anyway (tests/test_serving_router.py,
+        examples/chaos_bench.py --kill_mode)."""
         from paddle_tpu.observability import registry
 
+        if mode not in ("close", "sigkill"):
+            raise ValueError(f"unknown kill mode {mode!r} "
+                             f"(choose 'close' or 'sigkill')")
         rep = self._replicas[i]
         if rep.engine is None or rep.engine.closed:
             raise ValueError(f"replica {i} is already gone")
+        if mode == "sigkill" and not hasattr(rep.engine, "kill"):
+            raise ValueError("kill_replica(mode='sigkill') needs a "
+                             "cross-process tier (Router(processes="
+                             "True)) — an in-process engine has no pid")
         self.router_stats["replica_kills"] += 1
         registry().counter("serving.router.replica_kills").inc()
-        self.flight.mark("replica_killed", replica=i)
-        rep.engine.close()      # drops everything, stats included
+        self.flight.mark("replica_killed", replica=i, mode=mode)
+        if mode == "sigkill":
+            rep.engine.kill(mid_step=True)
+        else:
+            rep.engine.close()  # drops everything, stats included
 
     # ------------------------------------------------- bench duck-typing
     _UNSET = object()
@@ -1043,15 +1255,47 @@ class Router:
     def pop_result(self, request_id: int) -> RequestResult:
         return self.results.pop(request_id)
 
-    def drain(self, max_steps: Optional[int] = None
+    def _stuck_replica(self):
+        """(index, queue depth) of the live replica holding the most
+        work — the best available name for WHO is stuck when a drain
+        times out — or ``(None, pending_replace depth)`` when nothing
+        live holds anything (the work is orphaned, not held)."""
+        best, best_depth = None, -1
+        for i, r in enumerate(self._replicas):
+            if r.engine is None or r.engine.closed:
+                continue
+            try:
+                depth = int(r.engine.active_slots) + int(r.engine.queued)
+            except Exception:   # noqa: BLE001 — unreachable counts as 0
+                continue
+            if depth > best_depth:
+                best, best_depth = i, depth
+        if best is None or best_depth <= 0:
+            return None, len(self._pending_replace)
+        return best, best_depth
+
+    def drain(self, max_steps: Optional[int] = None,
+              timeout_s: Optional[float] = None
               ) -> Dict[int, RequestResult]:
         """Step until every accepted request has finished (or
         ``max_steps``). A tier that makes no progress for several
         consecutive all-idle ticks raises ``RuntimeError`` instead of
         spinning (the router self-heals orphans each tick, so a real
-        stall means something structural)."""
+        stall means something structural). With ``timeout_s`` a drain
+        that outlives the wall-clock budget raises
+        :class:`DrainTimeout` naming the stuck replica and its queue
+        depth — the caller gets WHO, not just "too slow"."""
         steps = idle_spins = 0
+        t0 = time.perf_counter()
         while not self.idle:
+            if timeout_s is not None \
+                    and time.perf_counter() - t0 > timeout_s:
+                idx, depth = self._stuck_replica()
+                who = (f"replica {idx}" if idx is not None
+                       else "no live replica (orphaned work)")
+                raise DrainTimeout(
+                    f"drain exceeded {timeout_s}s: {who} still holds "
+                    f"{depth} request(s)", replica=idx, queue_depth=depth)
             out = self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -1167,6 +1411,23 @@ class Router:
         covered = set()
         for i, rep in enumerate(rt._replicas):
             if rep.root is None:
+                continue
+            if rt.processes:
+                from paddle_tpu.resilience import integrity as _integ
+                if not _integ.manifest_steps(rep.root):
+                    continue    # never committed a snapshot — keep fresh
+                try:
+                    rep.engine.close()
+                except Exception:   # noqa: BLE001 — being replaced
+                    pass
+                eng, cov, mode = rt._restore_engine(i, rep)
+                if eng is None:
+                    rep.engine = None
+                    rep.state = "removed"
+                    continue
+                rep.engine = eng
+                if mode == "restore":
+                    covered |= cov
                 continue
             try:
                 snap = ServingEngine.load_snapshot(rep.root)

@@ -91,6 +91,39 @@ def test_backoff_delays_sequence():
     np.testing.assert_allclose(list(backoff_delays(p)), [0.1, 0.3, 0.9, 1.0])
 
 
+def test_backoff_jitter_is_deterministic_seeded_and_bounded():
+    """The seeded jitter regression: the schedule is a PURE function of
+    the policy — same seed = same schedule (pinned numerically), every
+    rung inside [1-j, 1+j] x the unjittered rung (cap applied BEFORE
+    jitter), different seeds de-correlate, jitter=0 is byte-identical
+    to the unjittered sequence."""
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, backoff=3.0,
+                    max_delay_s=1.0, jitter=0.5, seed=7)
+    a = list(backoff_delays(p))
+    assert a == list(backoff_delays(p))     # reproducible, no PRNG state
+    base = [0.1, 0.3, 0.9, 1.0]
+    for got, b in zip(a, base):
+        assert 0.5 * b <= got <= 1.5 * b
+    # the pinned schedule for (seed=7, jitter=0.5) — a hash-fold change
+    # is a behavior change and must show up here
+    import zlib
+    expect = []
+    for k, b in enumerate(base, start=1):
+        u = zlib.crc32(f"7:{k}".encode()) / 0xFFFFFFFF
+        expect.append(b * (1.0 + 0.5 * (2.0 * u - 1.0)))
+    np.testing.assert_allclose(a, expect, rtol=1e-12, atol=0)
+    # de-correlation: a different seed yields a different schedule
+    b2 = list(backoff_delays(RetryPolicy(
+        max_attempts=5, base_delay_s=0.1, backoff=3.0, max_delay_s=1.0,
+        jitter=0.5, seed=8)))
+    assert a != b2
+    # jitter=0 keeps the legacy schedule exactly
+    np.testing.assert_allclose(
+        list(backoff_delays(RetryPolicy(
+            max_attempts=5, base_delay_s=0.1, backoff=3.0,
+            max_delay_s=1.0))), base)
+
+
 def test_call_with_retry_recovers_counts_and_sleeps():
     before = _counter("resilience.retries", op="flaky")
     calls, sleeps = [], []

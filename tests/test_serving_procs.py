@@ -1,0 +1,229 @@
+"""Cross-process serving tier (docs/SERVING.md §Cross-process tier).
+
+One OS process per replica behind ``Router(processes=True)``: the
+tier-1 smoke pins submit → step → drain through the RPC seam with
+tokens BIT-IDENTICAL to an in-process engine; the torn-snapshot test
+SIGKILLs a worker inside save_snapshot's torn window (engine.json
+written, manifest not) and pins that the respawn-restore walks back to
+the last COMMITTED snapshot; the hung-worker test pins that a
+live-but-unresponsive process (worker.tick hang) is driven through
+suspect → dead by the wall-clock heartbeat and typed
+``DrainTimeout`` — not waited on forever.
+
+Every router built here runs under a finalizer that SIGKILLs and joins
+(hard timeout) every worker unconditionally — a wedged child must
+never outlive the test session.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.resilience import integrity
+
+
+def tiny_factory():
+    """Module-level (picklable) factory: each worker rebuilds the model
+    itself; seed(0) makes every copy bit-identical."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return m
+
+
+ENGINE_KW = dict(max_slots=2, block_tokens=16, max_seq_len=64)
+
+
+@pytest.fixture
+def proc_router(request):
+    """Factory fixture for cross-process routers with unconditional
+    child reaping: close, then SIGKILL + hard-timeout join every
+    worker pid the router ever spawned."""
+    routers = []
+
+    def make(**kw):
+        for k, v in ENGINE_KW.items():
+            kw.setdefault(k, v)
+        rt = serving.Router(None, processes=True,
+                            model_factory=tiny_factory, **kw)
+        routers.append(rt)
+        return rt
+
+    def finalize():
+        for rt in routers:
+            procs = []
+            for i in range(rt.num_replicas):
+                eng = rt.replica_engine(i)
+                if eng is not None and hasattr(eng, "pid"):
+                    procs.append((eng.pid, eng._proc))
+            try:
+                rt.close()
+            except Exception:   # noqa: BLE001 — reaping follows anyway
+                pass
+            for pid, proc in procs:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+                proc.join(timeout=10.0)
+                assert not proc.is_alive(), \
+                    f"worker pid {pid} survived SIGKILL + join"
+
+    request.addfinalizer(finalize)
+    return make
+
+
+def _prompts(n, rng):
+    return [rng.randint(3, 500, (12,)) for _ in range(n)]
+
+
+def test_cross_process_smoke_token_parity(proc_router):
+    """submit → step → drain over 2 worker processes; tokens must be
+    bit-identical to an in-process engine (same prompts, same seeds —
+    tokens are a pure function of (prompt, seed, sampling config)),
+    trace ids survive the wire, and a real SIGKILL mid-flight loses
+    nothing."""
+    rng = np.random.RandomState(0)
+    prompts = _prompts(3, rng)
+
+    ref_eng = serving.ServingEngine(tiny_factory(), **ENGINE_KW)
+    ref = {}
+    for i, p in enumerate(prompts):
+        rid = ref_eng.submit(serving.Request(p, max_new_tokens=6, seed=i))
+        ref[i] = rid
+    ref_eng.drain()
+    ref_tokens = {i: list(ref_eng.results[r].tokens)
+                  for i, r in ref.items()}
+    ref_eng.close()
+
+    rt = proc_router(replicas=2)
+    reqs = [serving.Request(p, max_new_tokens=6, seed=i)
+            for i, p in enumerate(prompts)]
+    rids = [rt.submit(r) for r in reqs]
+    rt.step()                           # at least one explicit tick
+    rt.drain(timeout_s=600)
+    for i, rid in enumerate(rids):
+        res = rt.results[rid]
+        assert list(res.tokens) == ref_tokens[i]
+        assert res.finish in ("eos", "length")
+        # the trace chain crossed two process boundaries intact
+        assert res.trace_id == reqs[i].trace_id
+
+    # a REAL SIGKILL mid-flight: zero loss, parity preserved
+    rids2 = [rt.submit(serving.Request(p, max_new_tokens=6, seed=i))
+             for i, p in enumerate(prompts)]
+    rt.step()
+    victim = rt.live_replicas[0]
+    rt.kill_replica(victim, mode="sigkill")
+    rt.drain(timeout_s=600)
+    assert rt.router_stats["failovers"] >= 1
+    for i, rid in enumerate(rids2):
+        assert list(rt.results[rid].tokens) == ref_tokens[i]
+
+
+@pytest.mark.slow
+def test_torn_snapshot_under_sigkill_walks_back(proc_router, tmp_path):
+    """SIGKILL the worker INSIDE save_snapshot's torn window (armed via
+    the serving.snapshot 'hang' fault: engine.json replaced, manifest
+    not yet written). The half-commit must be invisible: the manifest
+    walk shows only the earlier committed step, and the respawned
+    worker restores from it token-exactly."""
+    root = str(tmp_path / "tier")
+    rt = proc_router(replicas=1, root=root, snapshot_every=None)
+    rng = np.random.RandomState(1)
+    prompts = _prompts(2, rng)
+    rids = [rt.submit(serving.Request(p, max_new_tokens=8, seed=i))
+            for i, p in enumerate(prompts)]
+    rt.step(); rt.step()
+    proxy = rt.replica_engine(0)
+    rep_root = rt.replica_snapshot_root(0)
+    proxy.save_snapshot(rep_root)               # committed step A
+    committed = integrity.manifest_steps(rep_root)
+    assert committed
+    rt.step()                                   # advance past A
+
+    # arm the hang INSIDE the worker, then watch save_snapshot time out
+    # (the op is deadline-bounded and NOT broken by a timeout — a hung
+    # snapshot is a liveness datum, not a transport verdict)
+    proxy.arm_faults([{"site": "serving.snapshot", "kind": "hang",
+                       "seconds": 120.0}])
+    from paddle_tpu.serving.transport import TransportTimeout
+    with pytest.raises(TransportTimeout):
+        proxy.save_snapshot(rep_root, timeout_s=0.75)
+    assert not proxy.closed
+
+    # the worker is asleep in the torn window: a NEW step dir exists,
+    # but the manifest (the commit marker) still names only step A
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        dirs = {d for d in os.listdir(rep_root) if d.startswith("step_")}
+        if len(dirs) > len(committed):
+            break
+        time.sleep(0.1)
+    torn = sorted(int(d.split("_")[1]) for d in dirs)[-1]
+    assert torn not in integrity.manifest_steps(rep_root)
+    assert integrity.manifest_steps(rep_root) == committed
+
+    os.kill(proxy.pid, signal.SIGKILL)          # die mid-window
+    rt.step()       # heartbeat discovers the EOF → dead → failover
+    assert rt.router_stats["failovers"] == 1
+    new_eng = rt.replica_engine(0)
+    assert new_eng is not proxy and new_eng.restored
+    # the walk-back skipped the uncommitted step: what the respawned
+    # worker restored is the COMMITTED step A coverage
+    assert set(new_eng.covered) == set(rids)
+    rt.drain(timeout_s=600)
+
+    # token parity: restore + recompute is bit-identical to no-crash
+    ref_eng = serving.ServingEngine(tiny_factory(), **ENGINE_KW)
+    for i, p in enumerate(prompts):
+        r = ref_eng.submit(serving.Request(p, max_new_tokens=8, seed=i))
+        ref_eng.drain()
+        assert list(ref_eng.results.pop(r).tokens) \
+            == list(rt.results[rids[i]].tokens)
+    ref_eng.close()
+
+
+@pytest.mark.slow
+def test_hung_worker_goes_suspect_dead_and_drain_times_out(proc_router):
+    """A live-but-hung worker (worker.tick 'hang' holds every reply
+    open) is NOT a dead pipe — only the wall-clock heartbeat can tell.
+    drain_replica(timeout_s=) surfaces it as a typed DrainTimeout
+    naming the replica; the heartbeat then drives suspect → dead and
+    zero-loss failover re-places the work."""
+    rt = proc_router(replicas=2, heartbeat_timeout_s=0.5,
+                     suspect_after=1, dead_after=1)
+    rng = np.random.RandomState(2)
+    prompts = _prompts(2, rng)
+    rids = [rt.submit(serving.Request(p, max_new_tokens=6, seed=i))
+            for i, p in enumerate(prompts)]
+    rt.step()
+
+    victim = rt.live_replicas[0]
+    rt.replica_engine(victim).arm_faults(
+        [{"site": "worker.tick", "kind": "hang", "seconds": 120.0}])
+    with pytest.raises(serving.DrainTimeout) as ei:
+        rt.drain_replica(victim, timeout_s=0.5)
+    assert ei.value.replica == victim
+
+    rt.step()   # wall-clock ping misses → dead (dead_after=1) → failover
+    assert rt.router_stats["failovers"] >= 1
+    rt.drain(timeout_s=600)
+    assert all(rid in rt.results for rid in rids)
+
+    ref_eng = serving.ServingEngine(tiny_factory(), **ENGINE_KW)
+    for i, p in enumerate(prompts):
+        r = ref_eng.submit(serving.Request(p, max_new_tokens=6, seed=i))
+        ref_eng.drain()
+        assert list(ref_eng.results.pop(r).tokens) \
+            == list(rt.results[rids[i]].tokens)
+    ref_eng.close()

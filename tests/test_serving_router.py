@@ -554,3 +554,63 @@ def test_engine_displacement_rescued_on_sibling_replica(model):
         assert res.tokens.tolist() == ref.tolist()
         assert rt._requests[victim].replica != home
         assert rt.router_stats["replaced"] >= 1
+
+
+# ------------------------------------------------------- role scheduling
+
+def test_roles_are_validated(model):
+    with pytest.raises(ValueError, match="unknown replica role"):
+        _router(model, replicas=2, roles=["prefill", "bogus"])
+    with pytest.raises(ValueError, match="one role per replica"):
+        _router(model, replicas=2, roles=["prefill"])
+    with _router(model, replicas=2) as rt:
+        with pytest.raises(ValueError, match="unknown replica role"):
+            rt.add_replica(role="bogus")
+
+
+def test_prefill_decode_roles_migrate_with_parity(model):
+    """Splitwise-style disaggregation: admissions land on the PREFILL
+    replica, every request migrates to the DECODE replica at its first
+    token, and the roled run is bit-identical to a mixed-role run —
+    roles are a routing preference riding the token-exact release →
+    re-admit path, never a correctness fork."""
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(3, 500, (10,)) for _ in range(4)]
+
+    with _router(model, replicas=2) as mixed:
+        m_rids = [mixed.submit(serving.Request(p, max_new_tokens=6,
+                                               seed=400 + i))
+                  for i, p in enumerate(prompts)]
+        mixed.drain(max_steps=300)
+        refs = [mixed.results[r].tokens.tolist() for r in m_rids]
+
+    with _router(model, replicas=2,
+                 roles=[serving.ReplicaRole.PREFILL,
+                        serving.ReplicaRole.DECODE]) as rt:
+        rids = [rt.submit(serving.Request(p, max_new_tokens=6,
+                                          seed=400 + i))
+                for i, p in enumerate(prompts)]
+        # fresh admissions prefer the prefill-role replica
+        assert all(rt._requests[r].replica == 0 for r in rids)
+        rt.drain(max_steps=300)
+        assert rt.router_stats.get("role_migrations", 0) >= len(rids)
+        for i, r in enumerate(rids):
+            res = rt.results[r]
+            # every request finished on the decode replica, bit-identical
+            assert rt._requests[r].replica == 1
+            assert res.tokens.tolist() == refs[i]
+        from paddle_tpu.observability import registry
+        assert registry().counter_total(
+            "serving.router.role_migrations") >= len(rids)
+
+
+def test_drain_timeout_is_typed_and_names_the_stuck_replica(model):
+    rng = np.random.RandomState(13)
+    with _router(model, replicas=2) as rt:
+        rt.submit(serving.Request(rng.randint(3, 500, (8,)),
+                                  max_new_tokens=40))
+        with pytest.raises(serving.DrainTimeout) as ei:
+            rt.drain(timeout_s=0.0)     # not idle -> immediate timeout
+        assert ei.value.replica in (0, 1)
+        assert ei.value.queue_depth >= 1
+        rt.drain(max_steps=400)         # no timeout: finishes clean
