@@ -31,6 +31,14 @@ Exit contract (the acceptance bar, enforced with a non-zero exit):
   ``--timeline out.json`` additionally exports the run as a
   Perfetto-loadable timeline (docs/OBSERVABILITY.md §Timelines).
 
+``--offload`` arms the hierarchical KV tier (docs/SERVING.md
+§Hierarchical KV): preemptions swap KV blocks to the host-RAM store
+and resume token-exact from a gather. ``--swap_fault_every M`` then
+fires ``offload.swap`` faults — raising faults must downgrade to the
+legacy recompute/replay resume, and hang faults dwell inside the swap
+window so ``--kill_mode sigkill`` lands MID-SWAP — all under the same
+zero-loss exit contract.
+
 Run::
 
     python examples/chaos_bench.py [--model llama-tiny] [--requests 40]
@@ -54,9 +62,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from load_bench import calibrate, gen_arrivals, make_requests
-from serving_bench import (add_mesh_args, add_timeline_arg,
-                           build_engine_mesh, build_model,
-                           build_speculate, mesh_fields, timeline_fields)
+from serving_bench import (add_mesh_args, add_offload_args,
+                           add_timeline_arg, build_engine_mesh,
+                           build_model, build_speculate, mesh_fields,
+                           offload_engine_kwargs, offload_fields,
+                           timeline_fields)
 
 
 def engine_kwargs(ns, flight_dump, speculate=None):
@@ -68,7 +78,8 @@ def engine_kwargs(ns, flight_dump, speculate=None):
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=speculate,
         mesh=build_engine_mesh(ns),
-        max_queue=ns.max_queue, shed_infeasible=True)
+        max_queue=ns.max_queue, shed_infeasible=True,
+        **offload_engine_kwargs(ns))
     if getattr(ns, "chunk_autotune", False):
         # crash/restore through AUTOTUNED fused chunk ticks: the chunk
         # size is re-chosen per admission, so a restore mid-prefill may
@@ -314,8 +325,19 @@ def main():
     ap.add_argument("--verify", type=int, default=3,
                     help="completed requests spot-checked token-exact "
                     "against isolated generate (greedy only)")
+    ap.add_argument("--swap_fault_every", type=int, default=0,
+                    help="fire an offload.swap fault every N swap "
+                    "attempts (needs --offload), up to "
+                    "--max_swap_faults: even slots inject a RAISING "
+                    "fault — the swap must downgrade to the legacy "
+                    "recompute / token-exact-replay resume with zero "
+                    "loss; odd slots a hang INSIDE the swap window, so "
+                    "a --kill_mode sigkill can land MID-SWAP (device "
+                    "and host tiers must both stay consistent)")
+    ap.add_argument("--max_swap_faults", type=int, default=4)
     ap.add_argument("--snapshot_dir", default=None)
     ap.add_argument("--flight_dump", default=None)
+    add_offload_args(ap)
     add_mesh_args(ap)
     add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
@@ -343,6 +365,8 @@ def main():
             r["deadline"] = None
 
     speculate = build_speculate(ns)
+    if ns.swap_fault_every and not ns.offload:
+        raise SystemExit("--swap_fault_every needs --offload")
     if ns.processes and ns.replicas < 2:
         raise SystemExit("--processes needs --replicas >= 2")
     if ns.kill_mode == "sigkill" and not ns.processes:
@@ -397,6 +421,17 @@ def main():
           f"~ {cap_rps:.2f} req/s; offering {ns.load:g}x",
           file=sys.stderr)
 
+    # offload.swap chaos (--swap_fault_every; needs --offload): even
+    # slots a RAISING fault, which the engine absorbs by downgrading
+    # that swap to the legacy recompute / token-exact-replay resume
+    # (never a step crash); odd slots a hang dwelling INSIDE the swap
+    # window — the spot where --kill_mode sigkill lands mid-swap
+    swap_specs = [
+        {"site": "offload.swap",
+         "kind": ("raise" if k % 2 == 0 else "hang"),
+         "at": (k + 1) * ns.swap_fault_every,
+         **({"seconds": 0.2} if k % 2 else {})}
+        for k in range(ns.max_swap_faults if ns.swap_fault_every else 0)]
     if ns.processes:
         # engine-level faults live IN the workers — ship the schedule
         # over the arm_faults RPC so each worker fires its own
@@ -410,7 +445,7 @@ def main():
              "at": (k + 1) * ns.fault_every}
             for k in range(ns.max_faults)]
         for ri in eng.live_replicas:
-            eng.replica_engine(ri).arm_faults(wspecs)
+            eng.replica_engine(ri).arm_faults(wspecs + swap_specs)
         pfaults = []
         if ns.transport_fault_every:
             from paddle_tpu.serving.transport import TransportCorruption
@@ -428,12 +463,16 @@ def main():
                         "injected: torn frame (chaos)")))
         plan = faults.FaultPlan(*pfaults)
     else:
-        plan = faults.FaultPlan(*[
-            faults.Fault("decode.dispatch",
-                         kind=("raise" if k % 2 == 0
-                               else "resource_exhausted"),
-                         at=(k + 1) * ns.fault_every)
-            for k in range(ns.max_faults)])
+        plan = faults.FaultPlan(
+            *([faults.Fault("decode.dispatch",
+                            kind=("raise" if k % 2 == 0
+                                  else "resource_exhausted"),
+                            at=(k + 1) * ns.fault_every)
+               for k in range(ns.max_faults)]
+              + [faults.Fault(s["site"], kind=s["kind"], at=s["at"],
+                              **{k2: v for k2, v in s.items()
+                                 if k2 not in ("site", "kind", "at")})
+                 for s in swap_specs]))
     faults.arm(plan)
     arrivals = gen_arrivals(ns.requests, ns.load * cap_rps, "poisson",
                             rng)
@@ -467,12 +506,22 @@ def main():
             finishes[f] = finishes.get(f, 0) + 1
     shed = rejected + finishes.get("shed", 0)
     fired = len(plan.fired())
+    # offload.swap faults are ABSORBED by design — the engine
+    # downgrades the faulted swap to the legacy recompute/replay resume
+    # instead of crashing the step — so they never demand a restore and
+    # must not trip the fired-but-no-restore gate below
+    absorbed = sum(1 for f in plan.fired() if f.site == "offload.swap")
     if ns.processes:
         # worker-side fires (decode.dispatch inside replicas). A killed
         # worker takes its count with it — telemetry undercount, never
         # an overcount, so the fired-but-no-restore gate stays sound.
         fired += sum(eng.replica_engine(ri).faults_fired()
                      for ri in eng.live_replicas)
+        if ns.swap_fault_every:
+            # the worker fire count is one opaque total (absorbed swap
+            # fires can't be separated out), so the crash-path gate is
+            # waived for this mode — the zero-loss gate still holds
+            absorbed = fired
     # whole-run marker census: the auto-dump file spans every engine
     # incarnation (each crash + each restore dumped); the live ring only
     # covers the last one
@@ -540,6 +589,24 @@ def main():
             parity_checked += 1
 
     reg = obs.registry()
+    ofields = offload_fields(eng, ns)
+    swaps = (0, 0)
+    if ofields:
+        if ns.replicas == 1:
+            # each restore rebuilds the engine with fresh stats — the
+            # whole-run swap byte totals ride the registry the way
+            # preemptions does (router mode absorbs retired-engine
+            # stats itself)
+            ofields.update(
+                swap_out_bytes=int(reg.counter_total(
+                    "serving.offload.swap_out_bytes")),
+                swap_in_bytes=int(reg.counter_total(
+                    "serving.offload.swap_in_bytes")))
+        st_all = eng.stats
+        swaps = (max(int(st_all.get("swap_outs", 0)),
+                     int(reg.counter_total("serving.offload.swap_outs"))),
+                 max(int(st_all.get("swap_ins", 0)),
+                     int(reg.counter_total("serving.offload.swap_ins"))))
     rec = obs.bench_record(
         f"{ns.model} chaos soak {ns.load:g}x survivors",
         float(len(accepted) - len(lost)), "requests",
@@ -561,6 +628,9 @@ def main():
             "serving.snapshot_roundtrips"),
         lost_requests=len(lost), finishes=finishes,
         flight_markers=markers, parity_checked=parity_checked,
+        **ofields,
+        **({"tier_prefix_hit_rate": round(eng.tier_prefix_hit_rate, 4)}
+           if ns.replicas > 1 else {}),
         **mesh_fields(ns, build_engine_mesh(ns)), **tfields,
         wall_s=round(wall, 3))
     print(json.dumps(rec))
@@ -572,7 +642,7 @@ def main():
         print(f"# LOST {len(lost)} accepted requests: {lost}",
               file=sys.stderr)
         sys.exit(1)
-    if fired and restores == 0:
+    if fired - absorbed > 0 and restores == 0:
         print("# faults fired but no restore happened — the chaos path "
               "was not exercised", file=sys.stderr)
         sys.exit(1)
@@ -593,6 +663,9 @@ def main():
               f"a request's journal events do not form one connected "
               f"trace_id chain", file=sys.stderr)
         sys.exit(4)
+    if ns.offload:
+        print(f"# offload: {swaps[0]} swap-outs / {swaps[1]} swap-ins "
+              f"({len(swap_specs)} swap faults armed)", file=sys.stderr)
     print(f"# zero loss across {restores} restores / {fired} faults"
           + (f" / {kills} replica kills" if kills else "")
           + f"; shed {shed}/{ns.requests}, parity x{parity_checked} OK",

@@ -75,10 +75,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from serving_bench import (add_mesh_args, add_timeline_arg,
-                           build_engine_mesh, build_model,
-                           build_speculate, mesh_fields, spec_fields,
-                           spec_hist_base, timeline_fields)
+from serving_bench import (add_mesh_args, add_offload_args,
+                           add_timeline_arg, build_engine_mesh,
+                           build_model, build_speculate, mesh_fields,
+                           offload_engine_kwargs, offload_fields,
+                           spec_fields, spec_hist_base, timeline_fields)
 
 
 def parse_priority_mix(spec):
@@ -330,6 +331,7 @@ def main():
                     "--slo_tpot_s (requires --chunk_tokens as the cold "
                     "default)")
     add_mesh_args(ap)
+    add_offload_args(ap)
     add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
@@ -359,7 +361,8 @@ def main():
         decode_per_chunk=ns.decode_per_chunk,
         speculate=build_speculate(ns),
         mesh=build_engine_mesh(ns),
-        sanitize=ns.sanitize)
+        sanitize=ns.sanitize,
+        **offload_engine_kwargs(ns))
     if ns.chunk_autotune:
         ekw.update(chunk_autotune=True, slo_tpot_s=ns.slo_tpot_s)
     if ns.replicas > 1:
@@ -431,6 +434,10 @@ def main():
                 st["decode_slot_dispatches"]
                 / max(st["decode_tokens"], 1), 4),
             **spec_fields(eng, ns, hist_base),
+            **offload_fields(eng, ns),
+            **({"tier_prefix_hit_rate":
+                round(eng.tier_prefix_hit_rate, 4)}
+               if ns.replicas > 1 else {}),
             **mesh_fields(ns, ekw["mesh"]), **rep.bench_fields())
         print(json.dumps(rec))
         curve.append(dict(load_mult=mult, offered_rps=round(rps, 4),

@@ -219,6 +219,62 @@ def mesh_fields(ns, mesh):
                             for k, v in mesh.shape.items()})
 
 
+def add_offload_args(ap):
+    """--offload flags shared by serving_bench/load_bench/chaos_bench:
+    arm the hierarchical KV tier (docs/SERVING.md §Hierarchical KV) —
+    a preempted request's KV blocks swap to a host-RAM block store
+    (D2H overlapped with serving ticks) and resume token-exact from a
+    gather-back instead of a re-prefill + replay recompute."""
+    ap.add_argument("--offload", action="store_true",
+                    help="swap preempted requests' KV blocks to a "
+                    "host-RAM block store and resume them bitwise from "
+                    "a gather (zero replay dispatches) instead of "
+                    "recomputing; records grow host_blocks_total/"
+                    "swap_out_bytes/swap_in_bytes/prefetch_hit_rate")
+    ap.add_argument("--host_pool_blocks", type=int, default=None,
+                    help="host-tier capacity in KV blocks per replica "
+                    "(default: 4x the device pool)")
+
+
+def offload_engine_kwargs(ns):
+    """Engine kwargs from the --offload flags ({} when unarmed)."""
+    if not getattr(ns, "offload", False):
+        return {}
+    kw = dict(offload=True)
+    if getattr(ns, "host_pool_blocks", None):
+        kw["host_pool_blocks"] = ns.host_pool_blocks
+    return kw
+
+
+def offload_fields(eng, ns):
+    """Typed-optional hierarchical-KV BENCH fields (schema.py). ``eng``
+    is a ServingEngine or the Router; a cross-process replica proxy has
+    no reachable host store, so its capacity contribution falls back to
+    the configured --host_pool_blocks."""
+    if not getattr(ns, "offload", False):
+        return {}
+    st = eng.stats
+    hits = int(st.get("prefetch_hits", 0))
+    probes = hits + int(st.get("prefetch_misses", 0))
+    if hasattr(eng, "replica_engine"):          # Router tier
+        total = 0
+        for i in range(eng.num_replicas):
+            rep = eng.replica_engine(i)
+            hs = getattr(rep, "host_store", None)
+            if hs is not None:
+                total += hs.capacity
+            elif rep is not None:
+                total += int(getattr(ns, "host_pool_blocks", 0) or 0)
+    else:
+        hs = getattr(eng, "host_store", None)
+        total = hs.capacity if hs is not None else 0
+    return dict(
+        host_blocks_total=int(total),
+        swap_out_bytes=int(st.get("swap_out_bytes", 0)),
+        swap_in_bytes=int(st.get("swap_in_bytes", 0)),
+        prefetch_hit_rate=round(hits / probes, 4) if probes else 0.0)
+
+
 def add_timeline_arg(ap):
     """--timeline flag shared by serving_bench/load_bench/chaos_bench."""
     ap.add_argument("--timeline", default=None, metavar="OUT.json",
@@ -319,7 +375,8 @@ def run_continuous(model, reqs, ns):
         chunk_tokens=getattr(ns, "chunk_tokens", None),
         speculate=build_speculate(ns),
         mesh=build_engine_mesh(ns),
-        sanitize=getattr(ns, "sanitize", False))
+        sanitize=getattr(ns, "sanitize", False),
+        **offload_engine_kwargs(ns))
     if getattr(ns, "chunk_autotune", False):
         ekw.update(chunk_autotune=True,
                    slo_tpot_s=getattr(ns, "slo_tpot_s", None) or 0.25)
@@ -418,6 +475,7 @@ def main():
                     "replicated tier (serving.Router over N engine "
                     "replicas) instead of one engine")
     add_mesh_args(ap)
+    add_offload_args(ap)
     add_timeline_arg(ap)
     ap.add_argument("--seed", type=int, default=0)
     ns = ap.parse_args()
@@ -522,9 +580,12 @@ def main():
         chunk_tokens=ns.chunk_tokens,
         prefill_chunks=st["prefill_chunks"],
         replicas=ns.replicas,
+        **({"tier_prefix_hit_rate": round(eng.tier_prefix_hit_rate, 4)}
+           if ns.replicas > 1 else {}),
         pool_blocks=(eng.pool_blocks_total if ns.replicas > 1
                      else eng.pool.num_blocks - 1),
         block_tokens=ns.block_tokens, **spec_fields(eng, ns),
+        **offload_fields(eng, ns),
         **mesh_fields(ns, build_engine_mesh(ns)),
         **timeline_fields(ns, eng),
         **slo.bench_fields(), **common)))

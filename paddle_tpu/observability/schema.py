@@ -105,6 +105,23 @@ _BENCH_OPTIONAL = {
     "mp_degree": numbers.Integral,
     "fsdp_degree": numbers.Integral,
     "mesh_shape": dict,
+    # hierarchical-KV offload fields (serving_bench/load_bench/
+    # chaos_bench --offload): host_blocks_total = host-RAM block-store
+    # capacity summed over replicas, swap_out_bytes / swap_in_bytes =
+    # KV bytes through the D2H / H2D swap paths over the measured pass,
+    # prefetch_hit_rate = swap-in admissions served from a
+    # prefetch-staged device buffer (vs staged on demand)
+    "host_blocks_total": numbers.Integral,
+    "swap_out_bytes": numbers.Integral,
+    "swap_in_bytes": numbers.Integral,
+    "prefetch_hit_rate": numbers.Real,
+    # prefix-reuse fields: prefix_hit_rate = block-aligned prefill
+    # blocks served from a prefix cache (tier-merged across live +
+    # retired engines under --replicas); tier_prefix_hit_rate = the
+    # router's TierPrefixStore cross-replica share rate (blocks COPIED
+    # from a sibling replica instead of recomputed)
+    "prefix_hit_rate": numbers.Real,
+    "tier_prefix_hit_rate": numbers.Real,
 }
 
 
@@ -130,7 +147,9 @@ def validate_bench(rec: Dict) -> Dict:
             problems.append(
                 f"field {field!r} must be {getattr(typ, '__name__', typ)} "
                 f"or null, got {type(v).__name__}")
-    for frac in ("goodput", "shed_rate", "acceptance_rate"):
+    for frac in ("goodput", "shed_rate", "acceptance_rate",
+                 "prefetch_hit_rate", "prefix_hit_rate",
+                 "tier_prefix_hit_rate"):
         g = rec.get(frac)
         if isinstance(g, numbers.Real) and not isinstance(g, bool) \
                 and not 0.0 <= g <= 1.0:

@@ -2401,6 +2401,34 @@ def paged_chunk_scatter(kv_pool, chunk_bids, chunk_kv):
     return kv_pool.at[:, chunk_bids].set(chunk_kv.astype(kv_pool.dtype))
 
 
+def paged_block_gather(kv_pool, bids):
+    """Gather whole physical blocks out of the paged pool — the
+    device-side half of a swap-out / prefix export (docs/SERVING.md
+    §Hierarchical KV).
+
+    ``bids`` (n,) int32 physical block ids (callers pad to a bucketed
+    length with the scratch block, exactly like a block table's
+    unallocated tail, so the swap compile set stays finite); returns
+    ``(L, n, BT, 2*nkv*hd)`` in the pool dtype. The result is a fresh
+    buffer, so the caller may free the source blocks the moment the
+    gather is DISPATCHED — the copy is ordered before any later pool
+    mutation on the same stream, and ``copy_to_host_async`` overlaps
+    the D2H leg with subsequent serving ticks."""
+    return kv_pool[:, bids]
+
+
+def paged_block_scatter(kv_pool, bids, vals):
+    """Scatter host-staged block payloads back into the paged pool —
+    the device-side half of a swap-in / tier-prefix promotion. Same
+    contract as :func:`paged_chunk_scatter` (donate the pool at the jit
+    boundary; entries past the real count target scratch); split out so
+    swap traffic shares one seam with chunk appends instead of growing
+    a second scatter idiom. The fused tick program never sees these
+    blocks mid-flight: they land in the pool BEFORE the dispatch that
+    first reads them, so compile-set and donation pins are untouched."""
+    return kv_pool.at[:, bids].set(vals.astype(kv_pool.dtype))
+
+
 def fused_paged_tick_step(x, params, kv_pool, block_tables, positions,
                           cos, sin, *, num_heads: int, num_kv_heads: int,
                           eps: float = 1e-5, rope_base: float = 10000.0,

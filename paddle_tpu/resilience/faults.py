@@ -56,6 +56,15 @@ site                        fired from / index
                             heartbeat deadline must convert into
                             suspect → dead, exactly as a live-but-hung
                             process would
+``offload.swap``            ``ServingEngine`` host-tier swap paths —
+                            call counter, fired BEFORE a swap-out
+                            gathers (the slot preempts down the legacy
+                            free+recompute path instead, zero loss) and
+                            BEFORE a swap-in scatters (the parked
+                            request falls back to the token-exact
+                            re-prefill+replay resume); kind='hang'
+                            sleeps ``seconds`` inside the swap window
+                            so chaos can SIGKILL a worker mid-swap
 ==========================  ================================================
 
 Zero-overhead contract: with no plan armed, ``maybe_fire`` is ONE global
@@ -99,7 +108,7 @@ COOPERATIVE_KINDS = ("nan_grads", "corrupt_checkpoint", "drop_heartbeat",
 KNOWN_SITES = ("train.step", "checkpoint.save", "elastic.heartbeat",
                "decode.dispatch", "kv.op", "serving.snapshot",
                "router.heartbeat", "transport.send", "transport.recv",
-               "worker.tick")
+               "worker.tick", "offload.swap")
 
 
 class SimulatedResourceExhausted(RuntimeError):

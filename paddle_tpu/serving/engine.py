@@ -109,7 +109,7 @@ import os
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -117,7 +117,8 @@ import numpy as np
 
 from paddle_tpu.ops.fused_decode import (mp_gather_kv_lastdim,
                                          mp_local_kv_lastdim)
-from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool, PoolExhausted,
+from paddle_tpu.serving.pool import (SCRATCH_BLOCK, BlockPool,
+                                     HostBlockStore, PoolExhausted,
                                      PrefixCache)
 from paddle_tpu.serving.spec import SpecConfig
 
@@ -425,6 +426,36 @@ class _Slot:
         self.dblocks: List[int] = []
 
 
+class _Parked:
+    """One swapped-out request's host-tier KV (docs/SERVING.md
+    §Hierarchical KV): the gathered device buffer until the background
+    drain lands it in the ``HostBlockStore`` (``dev`` → ``host_ids``),
+    plus the cursor state a swap-in rebuilds the slot from WITHOUT a
+    prefill program or a replay dispatch — the generated-position KV
+    comes back bitwise. Parked KV is a resume accelerator, not durable
+    state: the queue's serialized resume tokens remain the crash story
+    (restore re-prefills where a live engine would swap in)."""
+
+    __slots__ = ("rid", "dev", "host_ids", "n", "scales", "pos", "tok",
+                 "count", "tokens", "worst_blocks", "prefix_hit_blocks",
+                 "t_swap")
+
+    def __init__(self, rid, dev, n, scales, pos, tok, count, tokens,
+                 worst_blocks, prefix_hit_blocks):
+        self.rid = rid
+        self.dev = dev          # gathered (L, n_pad, BT, 2dkv) device buf
+        self.host_ids: Optional[List[int]] = None
+        self.n = int(n)         # real block count (rest of dev is pad)
+        self.scales = scales    # int8 per-slot scale row copy, or None
+        self.pos = int(pos)
+        self.tok = int(tok)
+        self.count = int(count)
+        self.tokens = tokens    # generated-so-far (owned copy)
+        self.worst_blocks = int(worst_blocks)
+        self.prefix_hit_blocks = int(prefix_hit_blocks)
+        self.t_swap = time.perf_counter()   # for the prefetch EWMA
+
+
 class _ChunkGroup:
     """A batch of same-bucket prefilling slots advancing ONE chunk per
     fused tick (the batched-chunk-rows half of the one-program tick):
@@ -572,6 +603,16 @@ class _Ewma:
                       + self.alpha * float(x))
 
 
+def _swap_bucket(n: int) -> int:
+    """Power-of-two bucket for whole-block gather/scatter widths —
+    bounds the swap-path compile set to O(log max_blocks_per_slot)
+    programs (pad entries target the scratch block)."""
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
 class ServingEngine:
     """Continuous-batching decode over a paged KV pool.
 
@@ -676,6 +717,9 @@ class ServingEngine:
                  chunk_autotune: bool = False,
                  slo_tpot_s: Optional[float] = None,
                  speculate: Optional[SpecConfig] = None,
+                 offload: bool = False,
+                 host_pool_blocks: Optional[int] = None,
+                 offload_prefetch: int = 2,
                  sanitize: bool = False,
                  mesh=None, layout=None,
                  state: Optional[Dict] = None):
@@ -776,6 +820,47 @@ class ServingEngine:
         # postmortem info only)
         self.prefix_cache = (PrefixCache(self.pool, prefix_cache_blocks)
                              if prefix_caching else None)
+
+        # ---- hierarchical KV: host-RAM block tier (docs/SERVING.md
+        # §Hierarchical KV). offload=True arms the swap paths: a
+        # preemption GATHERS the victim's blocks to host RAM instead of
+        # freeing them (background D2H drain overlapped with serving
+        # ticks), and resume SCATTERS them back — the generated-position
+        # KV is restored bitwise, so the token-exact resume path runs
+        # zero replay dispatches when the blocks survived.
+        self.offload = bool(offload)
+        if host_pool_blocks is not None and host_pool_blocks < 1:
+            raise ValueError(f"host_pool_blocks must be >= 1 or None, "
+                             f"got {host_pool_blocks}")
+        self.offload_prefetch = int(offload_prefetch)
+        if self.offload_prefetch < 0:
+            raise ValueError(f"offload_prefetch must be >= 0, got "
+                             f"{offload_prefetch}")
+        # tpu-lint: volatile(host KV never survives a crash by design —
+        # a restored engine's parked requests re-admit down the
+        # token-exact re-prefill+replay path, exactly like slot KV;
+        # host_pool_blocks rides the snapshot config)
+        self.host_store = (HostBlockStore(
+            host_pool_blocks if host_pool_blocks is not None
+            else 4 * num_blocks) if self.offload else None)
+        # in-flight and host-resident parked swap records, keyed by
+        # request_id: _Parked carries the gathered device buffer until
+        # the background drain lands it in host_store, then the host ids
+        # tpu-lint: volatile(parked KV is a resume ACCELERATOR — the
+        # queue's serialized resume tokens are the durable state, so
+        # restore simply re-prefills where a live engine would swap in)
+        self._parked: Dict[int, "_Parked"] = {}
+        # tpu-lint: volatile(compiled-program cache)
+        self._swap_fns: Dict = {}
+        # device-staged swap-in payloads keyed by request_id (prefetch
+        # landed ahead of admission) — see _offload_prefetch
+        # tpu-lint: volatile(prefetch staging re-warms from host tier)
+        self._staged: Dict[int, object] = {}
+        # EWMA of observed swap-in staging wall seconds: the prefetch
+        # policy's probe-and-observe estimate (chunk_autotune pattern)
+        # of how far ahead of admission staging must start
+        # tpu-lint: volatile(prefetch estimator re-learns)
+        self._ewma_swap_s = _Ewma()
 
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -1089,6 +1174,10 @@ class ServingEngine:
         # tpu-lint: volatile(per-tick flight marker)
         self._tick_resumed: List[int] = []
         # tpu-lint: volatile(per-tick flight marker)
+        self._tick_swapped_out: List[int] = []
+        # tpu-lint: volatile(per-tick flight marker)
+        self._tick_swapped_in: List[int] = []
+        # tpu-lint: volatile(per-tick flight marker)
         self._tick_shed: List = []      # (request_id, reason) pairs
         # tpu-lint: volatile(shed results land in results, which the
         # snapshot serializes; the tick report is per-incarnation)
@@ -1245,6 +1334,9 @@ class ServingEngine:
         r.gauge("serving.mp_degree").set(self._mp)
         r.gauge("serving.fsdp_degree").set(
             self.layout.fsdp if self.layout is not None else 1)
+        if self.host_store is not None:
+            r.gauge("serving.offload.host_blocks_total").set(
+                self.host_store.capacity)
         self._update_gauges()
 
     def _update_gauges(self):
@@ -1256,6 +1348,14 @@ class ServingEngine:
         if self.prefix_cache is not None:
             r.gauge("serving.prefix_hit_rate").set(
                 self.prefix_cache.hit_rate)
+        if self.host_store is not None:
+            r.gauge("serving.offload.host_blocks_used").set(
+                self.host_store.used_blocks)
+            probes = (self.stats["prefetch_hits"]
+                      + self.stats["prefetch_misses"])
+            if probes:
+                r.gauge("serving.offload.prefetch_hit_rate").set(
+                    self.stats["prefetch_hits"] / probes)
 
     def _fresh_stats(self) -> Dict:
         """The ONE definition of the cumulative stats dict — __init__
@@ -1273,6 +1373,9 @@ class ServingEngine:
                     sanitized_steps=0, decode_slot_dispatches=0,
                     spec_ticks=0, spec_proposed=0, spec_accepted=0,
                     spec_k_probes=0, roundtrip_checks=0,
+                    swap_outs=0, swap_ins=0,
+                    swap_out_bytes=0, swap_in_bytes=0,
+                    prefetch_hits=0, prefetch_misses=0,
                     step_admit_s=0.0, step_prefill_s=0.0,
                     step_dispatch_s=0.0, step_sync_s=0.0)
 
@@ -1318,6 +1421,7 @@ class ServingEngine:
         it already generated (like a deadline cut), not an empty
         result."""
         self._queue.remove(victim)
+        self._drop_parked(victim.request_id)
         toks = victim._resume_tokens or []
         ttft = (victim._t_first - victim._t_submit
                 if victim._t_first is not None
@@ -1546,6 +1650,11 @@ class ServingEngine:
         for req in list(self._queue.items()):
             if req.request_id == rid:
                 self._queue.remove(req)
+                # the KV is leaving this engine — any host-tier parked
+                # copy (including one the _preempt above just made) is
+                # dead weight here; the migration target re-prefills or
+                # receives the blocks through the tier prefix store
+                self._drop_parked(rid)
                 self._update_gauges()
                 return list(req._resume_tokens or [])
         return None
@@ -1568,6 +1677,96 @@ class ServingEngine:
         for r in self._queue.items():
             out[r.request_id] = list(r._resume_tokens or [])
         return out
+
+    # ----------------------------------- tier-wide prefix store surface
+    def export_prefix_blocks(self, keys: Sequence[str]
+                             ) -> Dict[str, Tuple[int, np.ndarray]]:
+        """Exact bf16 KV payloads for the requested prefix-chain keys
+        (hex) this replica's cache still holds — the tier-wide prefix
+        store's fetch path. bf16 pools gather the physical blocks out
+        of the pool in ONE bucketed dispatch; int8 pools return the
+        cache's exact bf16 host copies. Missing keys are silently
+        absent: the tier index is a hint, and a partial fetch just
+        shortens the copied run."""
+        out: Dict[str, Tuple[int, np.ndarray]] = {}
+        if self.prefix_cache is None or self._closed:
+            return out
+        want = []
+        for k in keys:
+            e = self.prefix_cache.entry(k)
+            if e is None:
+                continue
+            if e.kv_host is not None:
+                # tpu-lint: allow(host-sync): kv_host is a host copy
+                out[k] = (e.depth, np.asarray(e.kv_host))
+            elif e.block_id is not None:
+                want.append((k, e))
+        if want:
+            m = _swap_bucket(len(want))
+            bids = np.full(m, SCRATCH_BLOCK, np.int32)
+            bids[:len(want)] = [e.block_id for _, e in want]
+            buf = self._swap_fn("gather")(self.kv_pool, self._up(bids))
+            # tpu-lint: allow(host-sync): once-per-fetch D2H — prefix
+            # blocks ship across the tier as host arrays
+            buf = np.asarray(buf)
+            for c, (k, e) in enumerate(want):
+                # tpu-lint: allow(host-sync): host slice copy
+                out[k] = (e.depth, np.ascontiguousarray(buf[:, c]))
+        return out
+
+    def import_prefix_blocks(self, entries: Dict[str, Tuple]) -> int:
+        """Adopt another replica's prefix blocks into THIS replica's
+        cache — the tier-wide prefix store's delivery path. bf16 pools
+        allocate physical blocks and scatter the payloads in (one
+        bucketed dispatch; the cache owns the refs, so a later
+        admission shares them exactly like locally prefilled blocks);
+        int8 pools keep the exact bf16 host copies and requantize at
+        adoption — the cache's native int8 representation. Entries
+        already cached, or that the pool has no spare room for, are
+        skipped (a miss, not an error). Returns blocks added."""
+        cache = self.prefix_cache
+        if cache is None or self._closed or not entries:
+            return 0
+        added = 0
+        todo = []
+        for k, (depth, kv) in entries.items():
+            if self.kv_int8:
+                # tpu-lint: allow(host-sync): wire payloads are host
+                if cache.adopt_entry(k, depth,
+                                     kv_host=np.asarray(kv)):
+                    added += 1
+            elif cache.entry(k) is None:
+                todo.append((k, int(depth), kv))
+        if todo:
+            # never squeeze live work: only free-and-unreserved blocks
+            # (plus idle cache blocks) host imported prefixes
+            free = self.pool.free_blocks - self._reserved
+            if len(todo) > free:
+                cache.evict_free(len(todo) - free)
+                free = self.pool.free_blocks - self._reserved
+            todo = todo[:max(free, 0)]
+        if todo:
+            bids = self.pool.alloc(len(todo))
+            m = _swap_bucket(len(todo))
+            dbids = np.full(m, SCRATCH_BLOCK, np.int32)
+            dbids[:len(todo)] = bids
+            buf = np.zeros((self._num_layers, m, self.block_tokens,
+                            2 * self._dkv), jnp.dtype(self.cache_dtype))
+            for c, (_, _, kv) in enumerate(todo):
+                buf[:, c] = kv
+            dev = (self.layout.place(buf, self.layout.pool_spec())
+                   if self.layout is not None else jax.device_put(buf))
+            self.kv_pool = self._swap_fn("scatter")(
+                self.kv_pool, self._up(dbids), dev)
+            for bid, (k, depth, _) in zip(bids, todo):
+                if cache.adopt_entry(k, depth, block_id=bid):
+                    added += 1
+                else:       # raced into the cache meanwhile: give back
+                    self.pool.free(bid)
+        if added:
+            self._metrics.counter(
+                "serving.offload.prefix_import_blocks").inc(added)
+        return added
 
     # ------------------------------------------------------------- prefill
     def _prefill_wave_fn(self, R, s_pad, n):
@@ -2250,7 +2449,15 @@ class ServingEngine:
         tokens: frees its blocks (bf16: after donating its full
         immutable blocks to the prefix cache, so resume re-prefill
         adopts instead of recomputing), releases its reservation, and
-        requeues the request for a token-exact resume."""
+        requeues the request for a token-exact resume.
+
+        ``offload=True`` (docs/SERVING.md §Hierarchical KV): the
+        victim's blocks are GATHERED to a host-bound buffer before the
+        slot tears down, so preemption becomes a block-table remap plus
+        a background drain — resume scatters the bytes back instead of
+        re-prefilling and replaying. The resume tokens are STILL
+        captured: the parked KV is an accelerator, and any failure on
+        the swap path falls back to the token-exact replay resume."""
         s = self._slots[slot_idx]
         req = s.req
         if s.prefilling:
@@ -2262,8 +2469,10 @@ class ServingEngine:
         else:
             req._resume_tokens = list(s.tokens)
             req._t_first = s.t_first
+        swapped = (self.offload and not s.prefilling
+                   and self._swap_out(slot_idx, s))
         if self.prefix_cache is not None and not self.kv_int8 \
-                and not s.prefilling:
+                and not s.prefilling and not swapped:
             # feed = prompt + generated[:-1]: exactly the s.pos written
             # positions; its full blocks are append-proof and already
             # physically populated — cache them (the cache takes its own
@@ -2286,6 +2495,264 @@ class ServingEngine:
         self._tick_preempted.append(req.request_id)
         if self._dump_pending is None:
             self._dump_pending = "preemption"
+
+    # --------------------------- hierarchical KV: host-tier swap paths
+    def _swap_fn(self, kind: str):
+        """Jitted whole-block gather/scatter (the ONE seam the host
+        tier touches device KV through — ``ops.fused_decode.
+        paged_block_gather/scatter``; the fused tick program itself is
+        untouched, so every compile-set and donation pin holds)."""
+        fn = self._swap_fns.get(kind)
+        if fn is None:
+            from paddle_tpu.ops.fused_decode import (paged_block_gather,
+                                                     paged_block_scatter)
+            fn = (jax.jit(paged_block_gather) if kind == "gather"
+                  else jax.jit(paged_block_scatter, donate_argnums=(0,)))
+            self._swap_fns[kind] = fn
+        return fn
+
+    def _swap_out(self, slot_idx: int, s: "_Slot") -> bool:
+        """Gather the preemption victim's blocks into one device buffer
+        bound for the host tier. Returns False — the caller keeps the
+        legacy free(+donate)+recompute path — when the tier has no
+        room, a fault fires, or the engine runs a draft proposer (the
+        draft's own KV pages cannot be restored; recompute-on-resume
+        is the correct fallback there).
+
+        The gather output is an independent buffer, so the source
+        blocks are free to reuse the moment the gather is DISPATCHED:
+        single-stream ordering guarantees any later program's writes
+        into re-issued blocks execute after this read. The D2H leg
+        (``copy_to_host_async``) overlaps the following serving ticks;
+        :meth:`_drain_swaps` lands the bytes next tick."""
+        from paddle_tpu.resilience import faults as _faults
+        n = len(s.blocks)
+        if n == 0 or self._draft_tables is not None \
+                or not self.host_store.reserve(n):
+            return False
+        try:
+            fault = _faults.maybe_fire("offload.swap")
+        except BaseException:
+            # a raising fault downgrades to the legacy path — zero
+            # loss: the resume tokens were captured before the attempt
+            self.host_store.unreserve(n)
+            return False
+        m = _swap_bucket(n)
+        bids = np.full(m, SCRATCH_BLOCK, np.int32)
+        bids[:n] = s.blocks
+        buf = self._swap_fn("gather")(self.kv_pool, self._up(bids))
+        try:
+            buf.copy_to_host_async()
+        except Exception:   # noqa: BLE001 — overlap is best-effort
+            pass
+        if fault is not None and fault.kind == "hang":
+            # inside the swap window: chaos SIGKILLs land mid-swap here
+            time.sleep(float(fault.payload.get("seconds", 0.05)))
+        # tpu-lint: allow(host-sync): _kv_scales is a host mirror
+        pk = _Parked(s.req.request_id, buf, n,
+                     (np.array(self._kv_scales[:, slot_idx, :])
+                      if self.kv_int8 else None),
+                     s.pos, s.tok, s.count, list(s.tokens),
+                     s.worst_blocks, s.prefix_hit_blocks)
+        self._parked[s.req.request_id] = pk
+        self._tick_swapped_out.append(s.req.request_id)
+        self.stats["swap_outs"] += 1
+        self._metrics.counter("serving.offload.swap_outs").inc()
+        return True
+
+    def _drain_swaps(self):
+        """Land completed swap-out gathers in the host tier — called at
+        tick start, at least one dispatch after each gather, so the D2H
+        already overlapped with the tick that preempted (lazy drain:
+        the sync below observes a transfer that is effectively done)."""
+        for pk in self._parked.values():
+            if pk.dev is not None:
+                self._drain_one(pk)
+
+    def _drain_one(self, pk: "_Parked"):
+        # tpu-lint: allow(host-sync): the host tier's classified D2H
+        # seam — draining an async gather a previous tick dispatched
+        buf = np.asarray(pk.dev)
+        pk.dev = None
+        # tpu-lint: allow(host-sync): host slice copy of the drained buf
+        pk.host_ids = self.host_store.put(
+            [np.ascontiguousarray(buf[:, c]) for c in range(pk.n)])
+        nbytes = pk.n * self.block_bytes
+        self.stats["swap_out_bytes"] += nbytes
+        self._metrics.counter("serving.offload.swap_out_bytes").inc(
+            nbytes)
+
+    def _stage_parked(self, pk: "_Parked"):
+        """Assemble a parked request's host blocks into one bucketed
+        device upload (async ``device_put`` H2D — the scatter that
+        consumes it synchronizes). Every stage is timed into the swap
+        EWMA: the prefetch policy's probe-and-observe estimate."""
+        t0 = time.perf_counter()
+        m = _swap_bucket(pk.n)
+        buf = np.zeros((self._num_layers, m, self.block_tokens,
+                        2 * self._dkv), jnp.dtype(self.cache_dtype))
+        for c, p in enumerate(self.host_store.get(pk.host_ids)):
+            buf[:, c] = p
+        dev = (self.layout.place(buf, self.layout.pool_spec())
+               if self.layout is not None else jax.device_put(buf))
+        nbytes = pk.n * self.block_bytes
+        self.stats["swap_in_bytes"] += nbytes
+        self._metrics.counter("serving.offload.swap_in_bytes").inc(
+            nbytes)
+        self._ewma_swap_s.update(time.perf_counter() - t0)
+        return dev
+
+    def _offload_prefetch(self):
+        """Stage host-resident parked requests back to device AHEAD of
+        admission (EWMA prediction, the ``chunk_autotune``
+        probe-and-observe pattern): the base lookahead is
+        ``offload_prefetch`` queue positions, widened by the predicted
+        number of serving ticks one stage costs (swap EWMA / decode
+        step EWMA) — when staging is slow relative to a tick, it must
+        start earlier for the admit path to never block on a cold
+        copy."""
+        lead = self.offload_prefetch
+        if self._ewma_swap_s.value is not None and self._ewma_step.value:
+            lead += max(0, -(-int(self._ewma_swap_s.value * 1e6)
+                             // max(int(self._ewma_step.value * 1e6), 1))
+                        - 1)
+        lead = min(lead, self.max_slots + self.offload_prefetch)
+        for pos, req in enumerate(self._queue):
+            if pos >= lead:
+                break
+            pk = self._parked.get(req.request_id)
+            if pk is None or pk.host_ids is None \
+                    or req.request_id in self._staged:
+                continue
+            self._staged[req.request_id] = self._stage_parked(pk)
+
+    def _drop_parked(self, request_id: int):
+        """Invalidate a request's host-tier state (consumed / shed /
+        released / fault fallback): free its host blocks and staging.
+        Safe to call for requests that were never parked."""
+        pk = self._parked.pop(request_id, None)
+        self._staged.pop(request_id, None)
+        if pk is None:
+            return
+        if pk.dev is not None:
+            pk.dev = None       # un-drained gather: just drop the buf
+            self.host_store.unreserve(pk.n)
+        elif pk.host_ids is not None:
+            self.host_store.free(pk.host_ids)
+
+    def _swap_in_admit(self, req: Request, pk: "_Parked",
+                       wave_idx) -> str:
+        """Admit a parked request by scattering its host-tier blocks
+        into freshly allocated pool blocks and rebuilding the slot row
+        DIRECTLY — no prefill program, no replay dispatches: the
+        generated-position KV comes back bitwise (the parity matrix in
+        tests/test_serving_offload.py pins it against uninterrupted
+        generation). Returns ``"admitted"``, ``"blocked"``
+        (head-of-line: no slot/blocks this tick) or ``"fallback"``
+        (parked KV unusable — the caller runs the legacy token-exact
+        re-prefill + replay resume)."""
+        from paddle_tpu.resilience import faults as _faults
+        if pk.dev is not None:
+            # preempted and re-admitted inside one tick: the background
+            # drain has not seen this gather yet — land it now
+            self._drain_one(pk)
+        try:
+            fault = _faults.maybe_fire("offload.swap")
+        except BaseException:
+            self._drop_parked(req.request_id)
+            return "fallback"
+        worst = max(pk.worst_blocks, pk.n)
+        n = pk.n
+        while True:
+            short = worst - (self.pool.free_blocks - self._reserved)
+            if short <= 0:
+                break
+            # same reclaim ladder as the legacy admission path:
+            # cached-but-idle prefix blocks first, then strictly
+            # lower-priority victims
+            if self.prefix_cache is not None \
+                    and self.prefix_cache.evict_free(short):
+                continue
+            victim = self._preempt_victim(req.rank, wave_idx)
+            if victim is None:
+                return "blocked"
+            self._preempt(victim)
+        try:
+            slot_idx = self._slots.index(None)
+        except ValueError:
+            victim = self._preempt_victim(req.rank, wave_idx)
+            if victim is None:
+                return "blocked"
+            self._preempt(victim)
+            slot_idx = victim
+        self._queue.pop()
+        req._resume_tokens = None       # consumed; _preempt re-sets
+        staged = self._staged.pop(req.request_id, None)
+        if staged is not None:
+            buf = staged
+            self.stats["prefetch_hits"] += 1
+            self._metrics.counter("serving.offload.prefetch",
+                                  outcome="hit").inc()
+        else:
+            buf = self._stage_parked(pk)
+            self.stats["prefetch_misses"] += 1
+            self._metrics.counter("serving.offload.prefetch",
+                                  outcome="miss").inc()
+        if fault is not None and fault.kind == "hang":
+            # inside the swap window: chaos SIGKILLs land mid-swap here
+            time.sleep(float(fault.payload.get("seconds", 0.05)))
+        bids = self.pool.alloc(n)
+        dbids = np.full(buf.shape[1], SCRATCH_BLOCK, np.int32)
+        dbids[:n] = bids
+        self.kv_pool = self._swap_fn("scatter")(
+            self.kv_pool, self._up(dbids), buf)
+        s = _Slot(req, worst, pk.prefix_hit_blocks, req.prompt, None)
+        s.blocks = bids
+        s.ntab = n
+        s.pos = pk.pos
+        s.tok = pk.tok
+        s.count = pk.count
+        s.tokens = list(pk.tokens)
+        s.t_first = req._t_first
+        row = self._tables[slot_idx]
+        row[:] = SCRATCH_BLOCK
+        row[:n] = bids
+        self._positions[slot_idx] = s.pos
+        self._toks[slot_idx] = s.tok
+        self._seeds[slot_idx] = np.uint32(req.seed)
+        self._counts[slot_idx] = s.count
+        if self.kv_int8 and pk.scales is not None:
+            self._kv_scales[:, slot_idx, :] = pk.scales
+        if req.deadline_s is not None:
+            s.deadline_at = req._t_submit + req.deadline_s
+        if self._history is not None:
+            # ngram proposer: same priming as _adopt_slot's resume
+            # branch — prompt + generated[:-1], current last token
+            # tpu-lint: allow(host-sync): host token-list concat
+            hist = np.concatenate(
+                [req.prompt, np.asarray(pk.tokens[:-1], np.int32)])
+            self._history[slot_idx][:] = 0
+            self._history[slot_idx, :len(hist)] = hist
+            self._history[slot_idx,
+                          min(len(hist), self.max_seq_len - 1)] = s.tok
+        self._reserved += worst - n
+        self._slots[slot_idx] = s
+        self._dirty = True
+        wave_idx.add(slot_idx)
+        self._drop_parked(req.request_id)
+        self._tick_admitted.append(req.request_id)
+        self._tick_swapped_in.append(req.request_id)
+        self.stats["requests_admitted"] += 1
+        self.stats["requests_resumed"] += 1
+        self.stats["swap_ins"] += 1
+        # tpu-lint: allow(journal-coverage): swap-in resume is not
+        # terminal; the router already journaled the re-placement
+        # ("place") that queued this resume
+        self._tick_resumed.append(req.request_id)
+        r = self._metrics
+        r.counter("serving.resumed").inc()
+        r.counter("serving.offload.swap_ins").inc()
+        return "admitted"
 
     def _admit(self):
         """Priority admission: while a slot and the head request's
@@ -2370,6 +2837,16 @@ class ServingEngine:
         BT = self.block_tokens
         while self._queue:
             req = self._queue.peek()
+            if self._parked:
+                pk = self._parked.get(req.request_id)
+                if pk is not None:
+                    st = self._swap_in_admit(req, pk, wave_idx)
+                    if st == "blocked":
+                        break
+                    if st == "admitted":
+                        continue
+                    # "fallback": the parked KV is gone — the legacy
+                    # token-exact re-prefill + replay resume below
             rank = req.rank
             resume = req._resume_tokens
             # a resume prefills the PROMPT only — the same program and
@@ -3340,6 +3817,8 @@ class ServingEngine:
         self._tick_prefill_s = 0.0
         self._tick_preempted = []
         self._tick_resumed = []
+        self._tick_swapped_out = []
+        self._tick_swapped_in = []
         self._tick_spec = None
         # _tick_shed keeps accumulating across submit() calls between
         # ticks; _record_flight drains it into this tick's event
@@ -3363,6 +3842,14 @@ class ServingEngine:
         from paddle_tpu.resilience import faults as _faults
         from paddle_tpu.resilience import record_event
 
+        # host-tier housekeeping BEFORE admission: land last tick's
+        # swap-out gathers and stage predicted swap-ins (both gated on
+        # parked work existing, so an offload-enabled engine with
+        # nothing parked runs the exact steady tick — the 0-H2D pin in
+        # tests/test_analysis.py covers offload=True idle ticks)
+        if self._parked:
+            self._drain_swaps()
+            self._offload_prefetch()
         # every _retire this tick (deadline sweep, instant finish on the
         # prefill sample inside _admit, decode finish) lands in
         # _finished_tick, so the returned `finished` list is complete
@@ -3814,6 +4301,11 @@ class ServingEngine:
                "retired": [[rid, fin] for rid, fin in self._tick_retired],
                "preempted": list(self._tick_preempted),
                "resumed": list(self._tick_resumed),
+               "swapped_out": list(self._tick_swapped_out),
+               "swapped_in": list(self._tick_swapped_in),
+               "host_blocks_used": (self.host_store.used_blocks
+                                    if self.host_store is not None
+                                    else None),
                "shed": [[rid, reason] for rid, reason in self._tick_shed],
                "prefills": [[R, s_pad, n]
                             for R, s_pad, n in self._tick_prefills],
@@ -3921,6 +4413,11 @@ class ServingEngine:
         self._dev_prop = None
         self._dev_cap = None
         self._jit_cache.clear()
+        self._swap_fns = {}
+        self._parked = {}
+        self._staged = {}
+        if self.host_store is not None:
+            self.host_store.clear()
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
         self._slots = [None] * self.max_slots
@@ -4022,6 +4519,11 @@ class ServingEngine:
                   "slo_tpot_s": self.slo_tpot_s,
                   "speculate": (self.speculate.to_config()
                                 if self.speculate is not None else None),
+                  "offload": self.offload,
+                  "host_pool_blocks": (self.host_store.capacity
+                                       if self.host_store is not None
+                                       else None),
+                  "offload_prefetch": self.offload_prefetch,
                   "sanitize": self._sanitize_mode}
         fingerprint = {"arch": self.arch, "num_layers": self._num_layers,
                        "dkv": self._dkv}

@@ -55,6 +55,12 @@ KNOWN_EVENTS = {
     "add_replica": "tier grew by one (warm-joined) replica slot",
     "close": "router closed cleanly (no recovery needed past here)",
     "recover": "router process rebuilt from this journal",
+    "prefix_share": "tier prefix store copied finished prefill blocks "
+                    "from one replica's cache into another's ahead of a "
+                    "placement (block copy instead of recompute)",
+    "migrate_blocks": "prefill→decode role migration shipped the "
+                      "released request's finished KV blocks to the "
+                      "decode side before re-placement",
 }
 
 #: request-scoped event kinds whose payload MUST carry ``trace_id`` —

@@ -26,12 +26,13 @@ scales — the prefill FLOPs are still skipped, which is the point.
 """
 
 import hashlib
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-__all__ = ["BlockPool", "PoolExhausted", "PrefixCache", "PrefixEntry",
-           "SCRATCH_BLOCK"]
+__all__ = ["BlockPool", "HostBlockStore", "PoolExhausted", "PrefixCache",
+           "PrefixEntry", "SCRATCH_BLOCK", "TierPrefixStore",
+           "chain_keys"]
 
 # physical block id 0: never allocated, target of every masked table entry
 SCRATCH_BLOCK = 0
@@ -113,12 +114,120 @@ class BlockPool:
         return False
 
 
+class HostBlockStore:
+    """Host-RAM second tier of the paged KV pool (docs/SERVING.md
+    §Hierarchical KV).
+
+    Holds evicted/parked KV block payloads — numpy arrays of shape
+    ``(L, block_tokens, 2*nkv*hd)`` in the pool's cache dtype — keyed by
+    a store-minted integer id. The device :class:`BlockPool` stays the
+    only authority over physical HBM blocks; this store is where a
+    preempted slot's blocks LAND (swap-out) and where resume gathers
+    them back FROM (swap-in), so parking costs host DRAM instead of
+    either HBM residency or a full re-prefill.
+
+    Capacity is counted in blocks, like the device pool. ``reserve``
+    makes admission-style feasibility explicit: the engine reserves
+    before it dispatches the device→host gather, so an overfull tier
+    falls back to the legacy free+recompute path instead of partially
+    swapping. int8 pools store the per-slot scale rows alongside the
+    payload (quantized blocks are meaningless without them).
+    """
+
+    def __init__(self, capacity_blocks: int):
+        if capacity_blocks < 1:
+            raise ValueError(
+                f"need >= 1 host block, got {capacity_blocks}")
+        self.capacity = int(capacity_blocks)
+        self._payloads: Dict[int, np.ndarray] = {}
+        self._next_id = 1
+        self._reserved = 0
+        self.bytes_in = 0           # cumulative D2H traffic landed here
+        self.bytes_out = 0          # cumulative H2D traffic served
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._payloads) + self._reserved
+
+    @property
+    def free_blocks(self) -> int:
+        return self.capacity - self.used_blocks
+
+    @property
+    def bytes_used(self) -> int:
+        return sum(p.nbytes for p in self._payloads.values())
+
+    def reserve(self, n: int) -> bool:
+        """Claim capacity for ``n`` blocks ahead of an async swap-out;
+        False (never raises) when the tier cannot take them — the
+        caller keeps the legacy drop path."""
+        if n > self.free_blocks:
+            return False
+        self._reserved += n
+        return True
+
+    def unreserve(self, n: int):
+        self._reserved -= n
+        assert self._reserved >= 0, "unreserve below zero"
+
+    def put(self, payloads: Sequence[np.ndarray],
+            reserved: bool = True) -> List[int]:
+        """Land drained block payloads; returns their host ids. With
+        ``reserved=True`` consumes a prior :meth:`reserve` claim."""
+        if reserved:
+            self.unreserve(len(payloads))
+        elif len(payloads) > self.free_blocks:
+            raise PoolExhausted(
+                f"host tier needs {len(payloads)} blocks, has "
+                f"{self.free_blocks} free of {self.capacity}")
+        out = []
+        for p in payloads:
+            hid = self._next_id
+            self._next_id += 1
+            self._payloads[hid] = p
+            self.bytes_in += p.nbytes
+            out.append(hid)
+        return out
+
+    def get(self, host_ids: Sequence[int]) -> List[np.ndarray]:
+        """Read payloads for swap-in (ids stay resident until freed —
+        a failed swap-in must be retryable)."""
+        out = [self._payloads[h] for h in host_ids]
+        self.bytes_out += sum(p.nbytes for p in out)
+        return out
+
+    def free(self, host_ids: Sequence[int]):
+        for h in host_ids:
+            del self._payloads[h]
+
+    def clear(self):
+        self._payloads.clear()
+        self._reserved = 0
+
+
 def _chain_hash(parent: bytes, tokens: np.ndarray) -> bytes:
     h = hashlib.blake2b(digest_size=16)
     h.update(parent)
     # tpu-lint: allow(host-sync): hashing host token ids (never device)
     h.update(np.ascontiguousarray(tokens, dtype=np.int64).tobytes())
     return h.digest()
+
+
+def chain_keys(tokens: Sequence[int], block_tokens: int) -> List[str]:
+    """Hex chain keys of every FULL block of ``tokens`` — the same hash
+    walk :class:`PrefixCache` uses, exposed so the Router can name a
+    prompt's blocks without owning a pool (tier-wide prefix store)."""
+    # tpu-lint: allow(host-sync): prompts/token lists arrive as host ids
+    tokens = np.asarray(tokens)
+    out, parent = [], b""
+    for c in range(len(tokens) // block_tokens):
+        parent = _chain_hash(
+            parent, tokens[c * block_tokens:(c + 1) * block_tokens])
+        out.append(parent.hex())
+    return out
 
 
 class PrefixEntry:
@@ -283,6 +392,36 @@ class PrefixCache:
                 freed += 1
         return freed
 
+    def entry(self, key_hex: str) -> Optional[PrefixEntry]:
+        """The cached entry for one hex chain key (the tier-wide prefix
+        store's fetch path) — refreshes its LRU tick: a block another
+        replica asks for is a hot block."""
+        e = self._entries.get(bytes.fromhex(key_hex))
+        if e is not None:
+            self._tick += 1
+            e.tick = self._tick
+        return e
+
+    def adopt_entry(self, key_hex: str, depth: int,
+                    block_id: Optional[int] = None,
+                    kv_host: Optional[np.ndarray] = None) -> bool:
+        """Register one externally-supplied chain entry (tier-wide
+        prefix imports: the payload was prefilled on ANOTHER replica
+        and block-copied here). Unlike :meth:`insert`, ownership of
+        ``block_id``'s pool reference TRANSFERS to the cache — the
+        engine allocates, scatters, then adopts. Returns False when
+        the key is already cached (the caller frees its block)."""
+        key = bytes.fromhex(key_hex)
+        if key in self._entries:
+            return False
+        e = PrefixEntry(key, int(depth), block_id=block_id,
+                        kv_host=kv_host)
+        self._tick += 1
+        e.tick = self._tick
+        self._entries[key] = e
+        self._evict()
+        return True
+
     def keys(self) -> List[str]:
         """Hex digests of every cached chain key (engine snapshots carry
         them so a postmortem can see what was shared at crash time; the
@@ -300,3 +439,105 @@ class PrefixCache:
     def hit_rate(self) -> float:
         return self.hit_blocks / self.lookup_blocks if self.lookup_blocks \
             else 0.0
+
+
+class TierPrefixStore:
+    """Tier-wide prefix index + host payload cache, owned by the Router
+    (docs/SERVING.md §Hierarchical KV).
+
+    Per-replica :class:`PrefixCache` instances only ever reuse work
+    their OWN replica did; the router's affinity hash merely hopes that
+    repeats land together. This store closes the gap: it maps chain
+    keys (hex, :func:`chain_keys`) to the set of replicas believed to
+    hold them, plus an LRU host cache of the exact bf16 payloads, so a
+    prefix prefilled on replica A becomes a block copy — not a
+    recompute — on replica B.
+
+    The router is the only writer (single-threaded step loop), and the
+    index is a HINT, not truth: a replica may have evicted an entry the
+    index still names, in which case the fetch returns a subset and
+    :meth:`forget` trims the hint. Losing the whole store costs only
+    future copies — it is rebuilt organically from placements — so it
+    is volatile state outside the journal/snapshot protocol.
+    """
+
+    def __init__(self, capacity_blocks: int = 256):
+        self.capacity = int(capacity_blocks)
+        self._owners: Dict[str, Set[int]] = {}
+        self._payloads: Dict[str, Tuple[int, np.ndarray]] = {}
+        self._ticks: Dict[str, int] = {}
+        self._tick = 0
+        self.hit_blocks = 0         # blocks served by cross-replica copy
+        self.lookup_blocks = 0      # blocks probed at placement time
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hit_blocks / self.lookup_blocks if self.lookup_blocks \
+            else 0.0
+
+    def note_owner(self, keys: Sequence[str], replica: int):
+        """Record that ``replica`` (just placed / just shared-to) will
+        hold these chain keys."""
+        for k in keys:
+            self._owners.setdefault(k, set()).add(replica)
+
+    def forget(self, keys: Sequence[str], replica: int):
+        for k in keys:
+            owners = self._owners.get(k)
+            if owners is not None:
+                owners.discard(replica)
+                if not owners:
+                    del self._owners[k]
+
+    def forget_replica(self, replica: int):
+        """Drop a dead/drained replica from every hint."""
+        for k in list(self._owners):
+            self.forget((k,), replica)
+
+    def missing_run(self, keys: Sequence[str], replica: int
+                    ) -> List[str]:
+        """The leading run of chain keys ``replica`` lacks but some
+        OTHER replica (or the host cache) can supply — chain order
+        matters because a prefix lookup stops at the first missing
+        link, so a non-contiguous copy would never be hit."""
+        out: List[str] = []
+        for k in keys:
+            owners = self._owners.get(k, ())
+            if replica in owners:
+                if out:
+                    break       # replica's own coverage resumes: stop
+                continue        # replica already holds the chain so far
+            if k not in self._payloads and not owners:
+                break           # nobody can supply this link
+            out.append(k)
+        return out
+
+    def owner_of(self, key: str, exclude: int) -> Optional[int]:
+        for o in sorted(self._owners.get(key, ())):
+            if o != exclude:
+                return o
+        return None
+
+    def cached(self, key: str) -> Optional[Tuple[int, np.ndarray]]:
+        hit = self._payloads.get(key)
+        if hit is not None:
+            self._tick += 1
+            self._ticks[key] = self._tick
+        return hit
+
+    def put(self, key: str, depth: int, kv: np.ndarray):
+        self._tick += 1
+        self._payloads[key] = (int(depth), kv)
+        self._ticks[key] = self._tick
+        while len(self._payloads) > self.capacity:
+            lru = min(self._ticks, key=self._ticks.get)
+            del self._payloads[lru]
+            del self._ticks[lru]
+
+    def clear(self):
+        self._owners.clear()
+        self._payloads.clear()
+        self._ticks.clear()

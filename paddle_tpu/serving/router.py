@@ -73,7 +73,8 @@ from paddle_tpu.serving.engine import (DrainTimeout, Rejected, Request,
                                        ServingEngine)
 from paddle_tpu.serving.journal import (ROUTER_JOURNAL_SCHEMA,
                                         RouterJournal)
-from paddle_tpu.serving.pool import PoolExhausted
+from paddle_tpu.serving.pool import (PoolExhausted, TierPrefixStore,
+                                     chain_keys)
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -190,6 +191,7 @@ class Router:
                  rpc_timeout_s: float = 180.0,
                  heartbeat_timeout_s: float = 10.0,
                  start_timeout_s: float = 300.0,
+                 tier_prefix_blocks: Optional[int] = 256,
                  seed: int = 0, **engine_kwargs):
         from paddle_tpu.inference import _inference_state
         from paddle_tpu.observability.flight import FlightRecorder
@@ -291,10 +293,24 @@ class Router:
         self.router_stats = dict(
             placed=0, rejected_tier=0, heartbeat_misses=0,
             replica_deaths=0, failovers=0, replaced=0, drains=0,
-            replica_kills=0, snapshots=0)
+            replica_kills=0, snapshots=0, prefix_shared_blocks=0)
         # tpu-lint: volatile(absorbed stats of retired engines —
         # telemetry, not protocol state)
         self._stats_base: Dict[str, float] = {}
+        # tpu-lint: volatile(absorbed prefix hit/lookup counters of
+        # retired engines — telemetry, not protocol state)
+        self._prefix_base = [0, 0]
+        # the tier-wide prefix index + host payload cache
+        # (docs/SERVING.md §Hierarchical KV). Losing it costs only
+        # future block copies — it is rebuilt organically from
+        # placements, so it lives outside the journal/snapshot protocol.
+        # tpu-lint: volatile(hint index + host cache; recover() and
+        # failover repopulate it from live placements)
+        self._tier_prefix = (TierPrefixStore(int(tier_prefix_blocks))
+                             if tier_prefix_blocks else None)
+        # tpu-lint: volatile(rids mid role-migration this tick — picks
+        # the journal kind for the block share at re-placement)
+        self._migrating: set = set()
         if self.journal is not None:
             self.journal.append("header", schema=ROUTER_JOURNAL_SCHEMA,
                                 replicas=replicas, seed=self.seed)
@@ -374,6 +390,16 @@ class Router:
         r = registry()
         r.gauge("serving.router.replicas_live").set(
             len(self.live_replicas))
+        # tier-merged prefix reuse: every replica's counters (incl.
+        # retired engines' absorbed base) folded into ONE rate, plus
+        # the cross-replica share rate of the tier store — the numbers
+        # router-mode benches report (per-replica rates alone hid the
+        # tier-level reuse picture)
+        r.gauge("serving.router.prefix_hit_rate").set(
+            self.prefix_hit_rate)
+        if self._tier_prefix is not None:
+            r.gauge("serving.router.tier_prefix_hit_rate").set(
+                self._tier_prefix.hit_rate)
         for i, rep in enumerate(self._replicas):
             r.gauge("serving.router.replica_state",
                     replica=str(i)).set(_STATE_RANK[rep.state])
@@ -453,8 +479,106 @@ class Router:
         if la > self.affinity_overload_factor * (lmin + 1e-3):
             # the affinity target is drowning while someone else is
             # near-idle: prefix reuse is not worth the queueing delay
+            # (the tier prefix store then turns the lost affinity into
+            # a block copy instead of a recompute — _share_prefix)
             return by_load, "least_loaded"
         return ([aff] + [i for i in by_load if i != aff]), "affinity"
+
+    def _share_prefix(self, idx: int, prompt, *, rid=None,
+                      event: str = "prefix_share") -> int:
+        """Stage finished prefill blocks from the tier onto replica
+        ``idx`` ahead of a placement (docs/SERVING.md §Hierarchical
+        KV): the prompt's block-aligned chain keys are probed against
+        the :class:`TierPrefixStore`; the leading run replica ``idx``
+        lacks but a sibling (or the store's host cache) can supply is
+        fetched — in-process via ``export_prefix_blocks``, cross-
+        process via the ``block_fetch`` RPC — cached host-side, and
+        delivered via ``import_prefix_blocks`` so the admission-time
+        prefix lookup hits blocks prefilled on ANOTHER replica.
+        Best-effort by construction: an evicted entry, a dead owner or
+        a full pool just shortens the copied run (and trims the hint);
+        the placement itself never depends on the share."""
+        from paddle_tpu.observability import registry
+
+        store = self._tier_prefix
+        eng = self._replicas[idx].engine
+        if store is None or eng is None or eng.closed \
+                or not hasattr(eng, "import_prefix_blocks"):
+            return 0
+        bt = eng.block_tokens
+        n_full = (len(prompt) - 1) // bt    # the PrefixCache lookup cap
+        if n_full <= 0:
+            return 0
+        # tpu-lint: allow(host-sync): prompts are host token ids
+        keys = chain_keys(np.asarray(prompt)[:n_full * bt], bt)
+        store.lookup_blocks += len(keys)
+        missing = store.missing_run(keys, idx)
+        # the placed request prefills (or copy-adopts) these blocks on
+        # idx either way — record the hint AFTER the missing-run probe
+        store.note_owner(keys, idx)
+        if not missing:
+            return 0
+        payloads: Dict[str, tuple] = {}
+        fetch: List[str] = []
+        for k in missing:
+            hit = store.cached(k)
+            if hit is not None:
+                payloads[k] = hit
+            else:
+                fetch.append(k)
+        if fetch:
+            by_owner: Dict[int, List[str]] = {}
+            for k in fetch:
+                o = store.owner_of(k, exclude=idx)
+                if o is not None:
+                    by_owner.setdefault(o, []).append(k)
+            for o, ks in sorted(by_owner.items()):
+                src = self._replicas[o].engine
+                if src is None or src.closed \
+                        or not hasattr(src, "export_prefix_blocks"):
+                    continue
+                try:
+                    out = src.export_prefix_blocks(ks)
+                except Exception:   # noqa: BLE001 — best-effort fetch
+                    logger.warning("router: tier prefix fetch from "
+                                   "replica %d failed", o, exc_info=True)
+                    continue
+                for k, (depth, kv) in out.items():
+                    store.put(k, depth, kv)
+                    payloads[k] = (depth, kv)
+                gone = [k for k in ks if k not in out]
+                if gone:
+                    # the owner evicted these — trim the stale hint
+                    store.forget(gone, o)
+        run: Dict[str, tuple] = {}
+        for k in missing:
+            if k not in payloads:
+                break   # chain broken: a gapped copy is never hit
+            run[k] = payloads[k]
+        if not run:
+            return 0
+        try:
+            imported = int(eng.import_prefix_blocks(run))
+        except Exception:   # noqa: BLE001 — best-effort delivery
+            logger.warning("router: tier prefix import into replica %d "
+                           "failed", idx, exc_info=True)
+            return 0
+        if imported:
+            store.hit_blocks += imported
+            self.router_stats["prefix_shared_blocks"] = \
+                self.router_stats.get("prefix_shared_blocks", 0) + imported
+            registry().counter("serving.router.prefix_shared_blocks",
+                               event=event).inc(imported)
+            self.flight.mark(event, replica=idx, blocks=imported,
+                             rid=rid)
+            if self.journal is not None:
+                if event == "migrate_blocks":
+                    self.journal.append("migrate_blocks", rid=rid,
+                                        replica=idx, blocks=imported)
+                else:
+                    self.journal.append("prefix_share", rid=rid,
+                                        replica=idx, blocks=imported)
+        return imported
 
     def submit(self, request) -> int:
         """Place a request on the tier (accepts a :class:`Request` or a
@@ -513,6 +637,11 @@ class Router:
                     max_new_tokens=request.max_new_tokens,
                     seed=request.seed, priority=request.priority,
                     deadline_s=request.deadline_s, replica=idx)
+            try:
+                self._share_prefix(idx, request.prompt, rid=rid)
+            except Exception:   # noqa: BLE001 — share is best-effort
+                logger.warning("router: tier prefix share failed",
+                               exc_info=True)
             return rid
         if n_pool_exhausted == len(order):
             # every replica said never-fits — structural, not load
@@ -569,6 +698,9 @@ class Router:
         rep.state = "dead"
         self.router_stats["replica_deaths"] += 1
         registry().counter("serving.router.replica_deaths").inc()
+        if self._tier_prefix is not None:
+            # its cached blocks died with it — drop every stale hint
+            self._tier_prefix.forget_replica(i)
         self.flight.mark("replica_dead", replica=i, why=why)
         logger.warning("router: replica %d declared dead (%s)", i, why)
 
@@ -582,6 +714,13 @@ class Router:
         for k, v in eng.stats.items():
             if isinstance(v, (int, float)):
                 self._stats_base[k] = self._stats_base.get(k, 0) + v
+        pc = getattr(eng, "prefix_cache", None)
+        if pc is not None:
+            try:
+                self._prefix_base[0] += int(pc.hit_blocks)
+                self._prefix_base[1] += int(pc.lookup_blocks)
+            except Exception:   # noqa: BLE001 — telemetry best-effort
+                pass
 
     def _restore_engine(self, i: int, rep: _Replica):
         """Try to bring replica ``i`` back from its snapshot root.
@@ -702,6 +841,20 @@ class Router:
                 still.append(t)
                 continue
             idx = order[0]
+            # ship the prompt's finished prefill blocks ahead of the
+            # resume so its re-prefill is a block copy, not a recompute
+            # — for a role migration this IS the block-transfer path
+            # PR 19 left open (journaled as "migrate_blocks")
+            try:
+                self._share_prefix(
+                    idx, t.prompt, rid=t.rid,
+                    event=("migrate_blocks"
+                           if t.rid in self._migrating
+                           else "prefix_share"))
+            except Exception:   # noqa: BLE001 — share is best-effort
+                logger.warning("router: tier prefix share failed",
+                               exc_info=True)
+            self._migrating.discard(t.rid)
             # admit_resumable bypasses the overload controls: this
             # request was ACCEPTED — shedding it now would be data loss
             try:
@@ -920,6 +1073,11 @@ class Router:
                 continue    # already finished/collected — not held
             t.tokens = [int(x) for x in toks]
             self._queue_replace(t)
+            # the prefill replica's cache still holds the prompt's
+            # finished blocks (its own refs survive the release) — mark
+            # the re-placement a migration so the share journals
+            # "migrate_blocks" when the decode side adopts them
+            self._migrating.add(t.rid)
             moved += 1
         if moved:
             self.router_stats["role_migrations"] = \
@@ -1043,6 +1201,8 @@ class Router:
             pass
         rep.engine = None
         rep.state = "removed"
+        if self._tier_prefix is not None:
+            self._tier_prefix.forget_replica(i)
         self._drain_pending_replacements()
         self.router_stats["drains"] += 1
         registry().counter("serving.router.drains").inc()
@@ -1184,12 +1344,20 @@ class Router:
         a per-replica one is a dashboard row). The merged registry is
         a detached point-in-time copy with the full export surface
         (``export_jsonl`` / ``prometheus_text``); mutating it does not
-        touch the live series (docs/OBSERVABILITY.md §Tier metrics)."""
+        touch the live series (docs/OBSERVABILITY.md §Tier metrics).
+        The tier-merged prefix gauges (``serving.router.
+        prefix_hit_rate`` / ``tier_prefix_hit_rate``) are refreshed
+        first so the snapshot carries them even between ticks."""
         from paddle_tpu.observability import registry
+        self._update_gauges()
         return registry().merged_across("replica")
 
     def reset_stats(self):
         self._stats_base = {}
+        self._prefix_base = [0, 0]
+        if self._tier_prefix is not None:
+            self._tier_prefix.hit_blocks = 0
+            self._tier_prefix.lookup_blocks = 0
         for rep in self._replicas:
             if rep.engine is not None and not rep.engine.closed:
                 rep.engine.reset_stats()
@@ -1204,8 +1372,13 @@ class Router:
 
     @property
     def prefix_hit_rate(self) -> float:
-        """Block-weighted prefix hit rate over live replicas."""
-        hits = lookups = 0
+        """Block-weighted prefix hit rate over the WHOLE tier: live
+        replicas plus the absorbed counters of engines retired by
+        failover/drain — a router-mode bench that killed a replica
+        mid-run must not lose that replica's reuse accounting (the
+        per-replica-only rate this replaces under-reported exactly
+        when the tier was doing its job)."""
+        hits, lookups = self._prefix_base
         for r in self._replicas:
             if r.engine is None or r.engine.closed \
                     or r.engine.prefix_cache is None:
@@ -1214,11 +1387,25 @@ class Router:
             lookups += r.engine.prefix_cache.lookup_blocks
         return hits / lookups if lookups else 0.0
 
+    @property
+    def tier_prefix_hit_rate(self) -> float:
+        """Fraction of placement-probed prefix blocks served by a
+        CROSS-REPLICA block copy through the tier store — the reuse
+        the per-replica caches cannot see (0.0 with the store off)."""
+        return (self._tier_prefix.hit_rate
+                if self._tier_prefix is not None else 0.0)
+
+    @property
+    def tier_prefix_store(self) -> Optional[TierPrefixStore]:
+        return self._tier_prefix
+
     def clear_prefix_caches(self):
         for r in self._replicas:
             if r.engine is not None and not r.engine.closed \
                     and r.engine.prefix_cache is not None:
                 r.engine.prefix_cache.clear()
+        if self._tier_prefix is not None:
+            self._tier_prefix.clear()
 
     @property
     def active_slots(self) -> int:
@@ -1339,6 +1526,8 @@ class Router:
                     pass
                 rep.engine = None
             rep.state = "removed"
+        if self._tier_prefix is not None:
+            self._tier_prefix.clear()
         if self.journal is not None:
             self.journal.append("close")
 

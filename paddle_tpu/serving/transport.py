@@ -39,6 +39,7 @@ queued one — the retry observes the same world a real transient would
 leave behind.
 """
 
+import base64
 import json
 import struct
 import zlib
@@ -51,9 +52,10 @@ from paddle_tpu.resilience import faults as _faults
 __all__ = [
     "MAGIC", "PROTOCOL_VERSION", "Channel", "RemoteError",
     "TransportClosed", "TransportCorruption", "TransportError",
-    "TransportTimeout", "decode_frame", "decode_request",
-    "decode_result", "encode_error", "encode_frame", "encode_request",
-    "encode_result", "raise_remote",
+    "TransportTimeout", "decode_block_entries", "decode_frame",
+    "decode_request", "decode_result", "encode_block_entries",
+    "encode_error", "encode_frame", "encode_request", "encode_result",
+    "raise_remote",
 ]
 
 MAGIC = b"PTRW"                 # Paddle_Tpu Replica Worker
@@ -254,6 +256,48 @@ def decode_result(d: Dict[str, Any]):
         np.asarray(d["tokens"], np.int32), int(d["gen_len"]), d["finish"],
         d.get("ttft_s"), d.get("tpot_s"), int(d.get("prefix_hit_blocks", 0)),
         trace_id=d.get("trace_id"))
+
+
+# ---- prefix-block payloads (tier store, docs/SERVING.md §Hierarchical KV) --
+#
+# KV block payloads ride the same JSON frames as every other RPC:
+# base64 bytes + dtype/shape, so the CRC framing, fault sites and
+# greppability are inherited unchanged.  The codec round-trips bf16
+# exactly (raw bytes, never a float cast) — a copied prefix block must
+# be BITWISE the producing replica's block or the parity contract of
+# the hierarchical KV tier breaks.
+
+def encode_block_entries(entries: Dict[str, Tuple[int, Any]]
+                         ) -> Dict[str, Dict[str, Any]]:
+    """``{chain_key_hex: (depth, kv_array)}`` -> JSON-safe wire dict
+    (the ``block_fetch`` reply / ``block_put`` request payload)."""
+    out = {}
+    for k, (depth, kv) in entries.items():
+        # tpu-lint: allow(host-sync): wire payloads are host arrays
+        kv = np.ascontiguousarray(kv)
+        out[k] = {"d": int(depth), "dtype": str(kv.dtype),
+                  "shape": [int(s) for s in kv.shape],
+                  "b": base64.b64encode(kv.tobytes()).decode("ascii")}
+    return out
+
+
+def decode_block_entries(d: Dict[str, Dict[str, Any]]
+                         ) -> Dict[str, Tuple[int, np.ndarray]]:
+    """Inverse of :func:`encode_block_entries`. ``bfloat16`` resolves
+    through ``ml_dtypes`` (jax's numpy dtype extensions) — imported
+    lazily so the transport stays importable without an accelerator
+    stack."""
+    entries = {}
+    for k, v in d.items():
+        try:
+            dt = np.dtype(v["dtype"])
+        except TypeError:
+            import ml_dtypes  # noqa: F401 — registers bf16 et al.
+            dt = np.dtype(v["dtype"])
+        kv = np.frombuffer(base64.b64decode(v["b"]),
+                           dtype=dt).reshape(v["shape"])
+        entries[k] = (int(v["d"]), kv)
+    return entries
 
 
 # ---- remote error envelope --------------------------------------------------

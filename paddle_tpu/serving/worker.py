@@ -45,8 +45,9 @@ from typing import Any, Dict, List, Optional
 from paddle_tpu.serving.engine import Rejected
 from paddle_tpu.serving.transport import (
     Channel, PROTOCOL_VERSION, TransportClosed, TransportCorruption,
-    TransportError, TransportTimeout, decode_request, decode_result,
-    encode_error, encode_request, encode_result, raise_remote)
+    TransportError, TransportTimeout, decode_block_entries,
+    decode_request, decode_result, encode_block_entries, encode_error,
+    encode_request, encode_result, raise_remote)
 
 logger = logging.getLogger("paddle_tpu.serving")
 
@@ -56,7 +57,11 @@ __all__ = ["ReplicaProxy", "worker_main"]
 #: writes that converge (re-arming the same faults, re-saving the same
 #: step's snapshot).  submit/step/drain are NOT here — a lost reply
 #: leaves the worker's state unknown, so those mark the proxy broken
-#: and let the router's failover machinery decide.
+#: and let the router's failover machinery decide.  block_fetch /
+#: block_put (tier prefix store) are NOT here either: a fetch gathers
+#: live device blocks and a put adopts pool references — a replayed
+#: half-delivered transfer would double-commit pool state, so the
+#: router's best-effort share just drops the copy instead.
 _IDEMPOTENT_OPS = frozenset({
     "ping", "status", "stats", "inflight", "estimated_ttft",
     "faults_fired", "save_snapshot", "snapshot_roundtrip",
@@ -185,6 +190,12 @@ def _dispatch(eng, op: str, args: Dict[str, Any]):
         if eng.prefix_cache is not None:
             eng.prefix_cache.clear()
         return True
+    if op == "block_fetch":
+        return encode_block_entries(
+            eng.export_prefix_blocks(args.get("keys") or []))
+    if op == "block_put":
+        return int(eng.import_prefix_blocks(
+            decode_block_entries(args.get("entries") or {})))
     if op == "arm_faults":
         return _arm_worker_faults(args.get("faults") or [])
     if op == "disarm_faults":
@@ -613,6 +624,28 @@ class ReplicaProxy:
         (the twin engine must live beside the real one); drift raises
         through the typed-error envelope."""
         return self._rpc("snapshot_roundtrip")
+
+    def export_prefix_blocks(self, keys) -> Dict[str, Any]:
+        """Fetch exact prefix-block payloads out of the worker's cache
+        (tier store's ``block_fetch`` RPC — NOT idempotent, see
+        ``_IDEMPOTENT_OPS``). Best-effort: a broken transport returns
+        an empty dict and the router's share just shortens."""
+        try:
+            out = self._rpc("block_fetch", {"keys": list(keys)})
+        except TransportError:
+            return {}
+        return decode_block_entries(out or {})
+
+    def import_prefix_blocks(self, entries) -> int:
+        """Deliver prefix-block payloads into the worker's cache (the
+        ``block_put`` RPC — NOT idempotent). Best-effort: a broken
+        transport imports nothing (returns 0)."""
+        try:
+            return int(self._rpc(
+                "block_put",
+                {"entries": encode_block_entries(entries)}))
+        except TransportError:
+            return 0
 
     def arm_faults(self, fault_specs: List[Dict[str, Any]]) -> int:
         """Arm a fault plan inside the worker process — chaos drives

@@ -80,6 +80,7 @@ def test_grad_scaler_dynamics():
     assert float(st4["scale"]) == 1024.0
 
 
+@pytest.mark.slow
 def test_fp16_overflow_step_skips_update():
     s = _strategy("float16")
     fleet.init(is_collective=True, strategy=s)

@@ -174,6 +174,7 @@ def test_metric_drift_skipped_without_docs_file(tmp_path):
     assert res.ok
 
 
+@pytest.mark.slow
 def test_filtered_run_reports_no_stale_baseline():
     """--rules/--paths runs see a subset of findings; out-of-scope
     pins are unobserved, not stale."""
@@ -780,6 +781,7 @@ def test_burned_down_dirs_have_no_baseline_entries():
     assert res.ok, res.findings
 
 
+@pytest.mark.slow
 def test_update_baseline_deterministic_and_committed():
     """Two regenerations are byte-identical, and match the checked-in
     baseline.json — the pin cannot drift silently."""
@@ -936,6 +938,35 @@ def test_serving_steady_state_zero_h2d_zero_recompiles():
         eng.drain()
 
 
+def test_offload_idle_steady_state_zero_h2d_zero_recompiles():
+    """Arming the hierarchical KV tier must cost NOTHING while idle:
+    with ``offload=True`` and no preemption in flight, steady ticks run
+    the exact same program as the unarmed engine — 0 H2D transfers, 0
+    compiles (the swap hooks are gated on parked work existing)."""
+    if not rt.compile_events_supported():
+        pytest.skip("jax.monitoring compile events unavailable")
+    from paddle_tpu import serving
+    m = _tiny_llama()
+    rng = np.random.RandomState(0)
+    with serving.ServingEngine(m, max_slots=2, block_tokens=32,
+                               max_seq_len=128, sanitize=True,
+                               offload=True) as eng:
+        for _ in range(2):
+            eng.submit(serving.Request(rng.randint(3, 500, (12,)),
+                                       max_new_tokens=16))
+        eng.step()          # admission: prefill + first dispatch compile
+        guarded = 0
+        while eng.active_slots and guarded < 8:
+            with rt.no_transfer(what="steady offload-idle tick"), \
+                    rt.count_compiles() as c:
+                eng.step()
+            assert c.count == 0
+            guarded += 1
+        assert guarded == 8
+        assert eng.stats["swap_outs"] == 0
+        eng.drain()
+
+
 def test_join_leave_compile_set_is_exactly_prefill_shapes():
     """Join/leave churn compiles exactly the expected programs: the
     first admission pays one prefill program + one step program; a
@@ -968,6 +999,7 @@ def test_join_leave_compile_set_is_exactly_prefill_shapes():
         assert c.count == 1, c.events
 
 
+@pytest.mark.slow
 def test_chunked_compile_set_is_exactly_chunk_buckets():
     """The one-program tick keeps the compile set small and EXACTLY
     pinned: each chunk tick dispatches ONE fused program (chunk half +
@@ -1238,6 +1270,7 @@ def test_donation_report_sharded_pool_step():
         eng.drain(max_steps=100)
 
 
+@pytest.mark.slow
 def test_donation_report_serving_pool_step_and_chunk_programs():
     """THE donation pins: the serving pool-step program aliases its KV
     pool input into the pool output (every leaf); the bf16 fused chunk
@@ -1304,6 +1337,7 @@ def test_donation_report_serving_pool_step_and_chunk_programs():
         eng.drain(max_steps=200)
 
 
+@pytest.mark.slow
 def test_chunk_autotune_transitions_compile_exactly_new_buckets():
     """The chunk autotuner re-evaluates ONLY at admission boundaries,
     so the compile set stays pinnable: a stable pick reuses its
@@ -1353,6 +1387,7 @@ def test_chunk_autotune_transitions_compile_exactly_new_buckets():
         assert registry().gauge("serving.chunk_autotune").value == 64
 
 
+@pytest.mark.slow
 def test_donation_report_spec_verify_history():
     """The speculative verify program donates BOTH RMW'd inputs: the
     KV pool and the ngram history buffer — the donation lint rule's

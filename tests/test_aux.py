@@ -17,6 +17,7 @@ from paddle_tpu.parallel.topology import (
 )
 
 
+@pytest.mark.slow
 def test_gradient_merge_matches_full_batch():
     """k-step accumulation over a homogeneous batch == full-batch step."""
     cfg = LlamaConfig.tiny()

@@ -113,6 +113,7 @@ def test_plan_gates_on_quantized_state():
     assert m.fused_decode_plan(bad) is None
 
 
+@pytest.mark.slow
 def test_gpt_fused_reference_matches_unfused():
     """arch='gpt' jnp twin == the layered GPT decode, token for token."""
     from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
@@ -256,6 +257,7 @@ def test_int8_cache_reference_cosine_parity():
         assert cossim > 0.99, cossim
 
 
+@pytest.mark.slow
 def test_generate_int8_cache_matches_bf16():
     """generate(cache_dtype=int8): greedy tokens match the bf16-cache run
     (tiny model; int8 cache noise stays below the argmax margin)."""
@@ -306,6 +308,7 @@ class TestInterpretKernelParity:
         out_k = generate(m, prompt, max_new_tokens=12, temperature=0.0)
         assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
 
+    @pytest.mark.slow
     def test_llama_int8_cache_token_exact(self):
         cfg, m = tiny_model()
         rng = np.random.RandomState(2)
@@ -319,6 +322,7 @@ class TestInterpretKernelParity:
                          cache_dtype=jnp.int8)
         assert np.asarray(out_ref).tolist() == np.asarray(out_k).tolist()
 
+    @pytest.mark.slow
     def test_gpt_generate_token_exact(self):
         from paddle_tpu.models.gpt import GPTConfig, GPTPretrainModel
 
@@ -427,6 +431,7 @@ class TestInterpretKernelParity:
                                    np.asarray(xr, np.float32),
                                    rtol=5e-2, atol=5e-2)
 
+    @pytest.mark.slow
     def test_moe_generate_int8_cache_token_exact(self):
         """generate(cache_dtype=int8) on Mixtral through the interpret-mode
         kernel == the jnp-reference int8 run, token for token."""

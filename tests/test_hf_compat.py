@@ -74,6 +74,7 @@ def test_hf_gpt2_logits_match():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_hf_mixtral_logits_match():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu
 from paddle_tpu.incubate.nn import (
@@ -27,6 +28,7 @@ def test_fused_mha_and_ffn_shapes():
     assert np.isfinite(np.asarray(y)).all()
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_full_vs_cached():
     paddle_tpu.seed(0)
     fmt = FusedMultiTransformer(embed_dim=32, num_heads=4,

@@ -125,6 +125,7 @@ def test_gpt_generate_greedy_replay():
     assert (pred[:, 6:-1] == np.asarray(out)[:, 7:]).all()
 
 
+@pytest.mark.slow
 def test_mixtral_generate_greedy_replay():
     """Mixtral decode path (round 3): MoE inference — per-token routing
     through the cached decoder matches teacher forcing."""
@@ -140,6 +141,7 @@ def test_mixtral_generate_greedy_replay():
     assert (pred[:, 6:-1] == np.asarray(out)[:, 7:]).all()
 
 
+@pytest.mark.slow
 def test_mixtral_fused_plan_matches_layered():
     """arch="moe" fused decode (reference twin on CPU): greedy tokens
     from the fused plan path equal the layered scan path, and the
@@ -176,6 +178,7 @@ def test_mixtral_fused_plan_matches_layered():
     assert m4.fused_decode_plan(m4.trainable_state(), probe=True) is None
 
 
+@pytest.mark.slow
 def test_mixtral_train_loss_chunked():
     """CausalLMBase.train_loss handles MoE (hidden, aux) bodies, chunked
     and unchunked, matching forward+loss."""
@@ -196,6 +199,7 @@ def test_mixtral_train_loss_chunked():
     np.testing.assert_allclose(got4, ref, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_deepseek_shared_experts_fused_plan_matches_layered():
     """DeepSeekMoE decode (round 5): shared experts ride the fused plan
     (dense SwiGLU folded next to the routed top-k) — greedy tokens equal

@@ -81,6 +81,7 @@ def test_mp_sharded_matches_dense():
     np.testing.assert_allclose(sharded_loss, ref_loss, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_recompute_granularity_grads_match():
     """recompute_granularity (reference fleet recompute) must not change
     the math: loss + grads identical across full / full_attn / core_attn."""
@@ -155,6 +156,7 @@ def test_param_count_7b_config():
     assert 6.5e9 < total < 7.5e9
 
 
+@pytest.mark.slow
 def test_sliding_window_training_and_decode():
     """Mistral-style sliding_window: the training forward masks beyond the
     window (differs from full causal), and cached greedy decode replays

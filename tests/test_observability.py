@@ -202,6 +202,7 @@ def _mp_log_lines(rank, path, n):
         ml.log(rank=rank, step=i, pad=pad)
 
 
+@pytest.mark.slow
 def test_metrics_logger_multiprocess_lines(tmp_path):
     """Concurrent per-rank writers on ONE path: every line must parse
     (single O_APPEND write per line — no interleaved partial JSON)."""
@@ -604,6 +605,7 @@ def test_decode_bench_smoke_emits_valid_schema(tmp_path):
 
 # ---- serving_bench smoke (continuous-batching A/B, BENCH schema) ------------
 
+@pytest.mark.slow
 def test_serving_bench_smoke_emits_valid_schema(tmp_path):
     """`not slow` CI smoke: serving_bench in tiny-CPU mode must emit TWO
     schema-valid BENCH records — static first, then continuous carrying

@@ -60,6 +60,7 @@ def test_pipeline_matches_single_device(pp_fleet):
     assert float(loss) < float(loss0)
 
 
+@pytest.mark.slow
 def test_pipeline_with_recompute_matches(pp_fleet):
     f, s = pp_fleet
     s.recompute = True
@@ -95,6 +96,7 @@ def test_pipeline_tied_embeddings_matches(pp_fleet):
     np.testing.assert_allclose(float(loss0), ref_loss, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_zero2_matches_single_device():
     """North-star combination (BASELINE.json metric): mp2 × pp2 × ZeRO
     sharding stage-2 — first-step loss equals the single-device loss, and
@@ -310,6 +312,7 @@ def test_lazy_guard_aot_matches_eager():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_lazy_guard_generic_path_lower_and_guard():
     """The non-pipeline make_train_step also serves LazyGuard models:
     lower() works (== eager accounting), init_fn raises the explicit

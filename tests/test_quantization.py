@@ -2,6 +2,7 @@
 weight_only_linear, fused_multi_transformer_int8)."""
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -36,6 +37,7 @@ def test_weight_only_linear_matches_fp():
     assert rel < 0.02, rel
 
 
+@pytest.mark.slow
 def test_quantize_model_preserves_logits_and_decodes():
     paddle_tpu.seed(0)
     cfg = LlamaConfig.tiny()

@@ -222,6 +222,7 @@ def test_eos_retires_slot_and_frees_blocks():
 
 # ------------------------------------------------------------ prefix reuse
 
+@pytest.mark.slow
 def test_prefix_reuse_parity_and_cow_isolation():
     """Two requests sharing a 40-token system prefix: the second reuses
     the cached full blocks (prefill FLOPs skipped), tokens still match

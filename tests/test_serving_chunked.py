@@ -76,11 +76,13 @@ def _run_parity(m, cache_dtype, temperature, chunk_tokens=32):
     eng.close()
 
 
+@pytest.mark.slow
 def test_chunked_parity_bf16_greedy():
     cfg, m = tiny_llama()
     _run_parity(m, jnp.bfloat16, 0.0)
 
 
+@pytest.mark.slow
 def test_chunked_parity_int8_sampled():
     cfg, m = tiny_llama()
     _run_parity(m, jnp.int8, 0.8)
@@ -118,6 +120,7 @@ def test_chunked_parity_gpt():
     eng.close()
 
 
+@pytest.mark.slow
 def test_chunked_prefix_cow_parity():
     """Prefix CoW through chunks: the CoW gather happens on chunk 0
     only, the second request reuses the cached full blocks, tokens
@@ -237,6 +240,7 @@ def test_batched_chunk_rows_int8_sampled_parity():
     eng.close()
 
 
+@pytest.mark.slow
 def test_group_compaction_on_mid_prefill_preemption():
     """Preempting ONE row of an n=2 chunk group mid-prefill compacts
     the group (device inputs — and on int8 pools the resident carry —
@@ -369,6 +373,7 @@ def test_chunk_autotune_ladder_clamped_and_probe_budgeted():
 
 # ----------------------------------------- preemption through the chunks
 
+@pytest.mark.slow
 def test_preempt_resume_through_chunks():
     """A mid-DECODE victim's token-exact resume rides the chunk path:
     re-prefill of prompt+generated runs chunk-by-chunk interleaved with
@@ -424,6 +429,7 @@ def test_preempt_mid_prefill_parity():
 
 # --------------------------------------------- decode-interleave liveness
 
+@pytest.mark.slow
 def test_decode_interleave_liveness():
     """While a long prompt prefills chunk-by-chunk, an active decode
     slot gains a token EVERY tick — prefill never starves decode for
@@ -463,6 +469,7 @@ def test_decode_interleave_liveness():
     eng.close()
 
 
+@pytest.mark.slow
 def test_decode_per_chunk_budget_paces_chunks():
     """decode_per_chunk=2: while decode-ready slots exist, chunks run
     at most every other tick (each decode slot gets >= 2 tokens per
@@ -554,6 +561,7 @@ def test_short_last_chunk_does_not_inflate_token_ewma():
     eng.close()
 
 
+@pytest.mark.slow
 def test_first_plain_step_compile_not_fed_to_step_ewma():
     """A chunked engine's FIRST dispatch is a fused chunk tick, which
     flips the generic first-dispatch warm flag long before the
@@ -605,6 +613,7 @@ def test_estimator_chunked_prices_interleave():
 
 # ------------------------------------- snapshot: the chunk cursor rides
 
+@pytest.mark.slow
 def test_mid_prefill_snapshot_restore_lossless(tmp_path):
     """An engine snapshotted while a slot is MID-CHUNK restores with
     zero loss: the slot rides the snapshot as a resumable request (the

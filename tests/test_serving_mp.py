@@ -110,33 +110,39 @@ def test_mp2_parity_greedy_bf16():
     assert_mp_parity(m)
 
 
+@pytest.mark.slow
 def test_mp2_parity_sampled_bf16():
     _, m = tiny_llama()
     assert_mp_parity(m, temperature=0.8, top_k=40)
 
 
+@pytest.mark.slow
 def test_mp2_parity_greedy_int8():
     _, m = tiny_llama()
     assert_mp_parity(m, cache_dtype=jnp.int8)
 
 
+@pytest.mark.slow
 def test_mp2_parity_chunked_bf16():
     _, m = tiny_llama()
     assert_mp_parity(m, chunk_tokens=16)
 
 
+@pytest.mark.slow
 def test_mp2_parity_ngram_spec():
     _, m = tiny_llama()
     assert_mp_parity(m, speculate=serving.SpecConfig(k=3,
                                                      proposer="ngram"))
 
 
+@pytest.mark.slow
 def test_mp2_parity_gpt():
     _, g = tiny_gpt()
     assert_mp_parity(g, prompts=[[1, 2, 3, 4, 5], [7, 8, 9],
                                  list(range(20, 45))])
 
 
+@pytest.mark.slow
 def test_fsdp2_parity_chunked():
     # fsdp shards the layer dim, so L must divide
     _, m = tiny_llama(L=4)
@@ -178,6 +184,7 @@ def test_mp4_fsdp2_parity():
 
 # -------------------------------------------- scheduling events, sharded
 
+@pytest.mark.slow
 def test_mp2_preempt_resume_parity():
     """A priority preemption + token-exact resume at mp=2 replays the
     same schedule (and the same tokens) as the mp=1 engine — resume
@@ -208,6 +215,7 @@ def test_mp2_preempt_resume_parity():
     assert preempt_run(None) == preempt_run(mesh_of({"mp": 2}))
 
 
+@pytest.mark.slow
 def test_mp2_snapshot_restore_cross_mesh():
     """Snapshots are MESH-FREE: a mid-flight mp=2 snapshot restores
     byte-compatibly onto mp=1, onto fsdp=2, and back onto mp=2 — each
@@ -242,6 +250,7 @@ def test_mp2_snapshot_restore_cross_mesh():
             and "mesh" not in snap2["config"]
 
 
+@pytest.mark.slow
 def test_router_replicas_ride_the_mesh():
     """Router(mesh=...) hands every replica (initial AND add_replica'd)
     the same mesh; the warmup runs under the replica's own mesh context
@@ -334,6 +343,7 @@ def test_mismatched_layout_mesh_rejected():
 
 # ------------------------------------------------- draft embedding share
 
+@pytest.mark.slow
 def test_draft_shares_target_embedding_table():
     """satellite: a same-shape draft rebinds its embedding table to the
     TARGET's array (one device buffer; through tied_unembed it is the

@@ -1,0 +1,342 @@
+"""Hierarchical KV tier (docs/SERVING.md §Hierarchical KV).
+
+Host-RAM block offload: a preempted request's KV blocks GATHER to the
+``HostBlockStore`` instead of being freed, and resume SCATTERS them
+back bitwise — the token-exact resume runs ZERO replay dispatches.
+The parity matrix pins preempt → swap-out → resume against an
+uninterrupted run (bf16+int8 × greedy+sampled; the non-default combos
+and the fault-fallback test ride ``slow`` — the bf16/greedy
+representative stays tier-1). The tier-wide prefix store pins that a prefix
+prefilled on replica A is a BLOCK COPY on replica B: the second
+replica runs zero prefill work for the shared span (counter
+assertion), in-process and over the cross-process RPC seam.
+
+Every cross-process router here runs under the same unconditional
+SIGKILL + join finalizer as tests/test_serving_procs.py.
+"""
+
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import serving
+from paddle_tpu.resilience import faults
+
+
+def tiny_factory():
+    """Module-level (picklable) factory: worker processes rebuild the
+    model themselves; seed(0) makes every copy bit-identical."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                      num_heads=4, num_kv_heads=4, intermediate_size=256,
+                      max_position_embeddings=512)
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(cfg).bfloat16()
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_factory()
+
+
+@pytest.fixture
+def proc_router(request):
+    """Cross-process routers with unconditional child reaping (the
+    test_serving_procs.py contract): close, then SIGKILL + hard-timeout
+    join every worker pid the router ever spawned."""
+    routers = []
+
+    def make(**kw):
+        rt = serving.Router(None, processes=True,
+                            model_factory=tiny_factory, **kw)
+        routers.append(rt)
+        return rt
+
+    def finalize():
+        for rt in routers:
+            procs = []
+            for i in range(rt.num_replicas):
+                eng = rt.replica_engine(i)
+                if eng is not None and hasattr(eng, "pid"):
+                    procs.append((eng.pid, eng._proc))
+            try:
+                rt.close()
+            except Exception:   # noqa: BLE001 — reaping follows anyway
+                pass
+            for pid, proc in procs:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+                proc.join(timeout=10.0)
+                assert not proc.is_alive(), \
+                    f"worker pid {pid} survived SIGKILL + join"
+
+    request.addfinalizer(finalize)
+    return make
+
+
+# ---------------------------------------------------- swap parity matrix
+
+_PROMPTS = [np.arange(1, 13, dtype=np.int32),
+            np.arange(20, 29, dtype=np.int32)]
+_MAX_NEW = 12
+
+
+def _run(model, offload, preempt_at, dtype, temperature, fault=None):
+    """Drive both prompts to completion, preempting slot 0 at step
+    ``preempt_at`` (once past prefill). Returns (tokens, stats)."""
+    kw = dict(max_slots=2, block_tokens=8, max_seq_len=64,
+              temperature=temperature,
+              cache_dtype=jnp.int8 if dtype == "int8" else jnp.bfloat16)
+    eng = serving.ServingEngine(model, offload=offload, **kw)
+    if fault is not None:
+        faults.arm(faults.FaultPlan(fault))
+    try:
+        rids = [eng.submit(serving.Request(p, max_new_tokens=_MAX_NEW,
+                                           seed=7 + i))
+                for i, p in enumerate(_PROMPTS)]
+        steps = 0
+        while not eng.idle and steps < 200:
+            eng.step()
+            steps += 1
+            if steps == preempt_at and eng._slots[0] is not None \
+                    and not eng._slots[0].prefilling:
+                eng._preempt(0)
+        toks = [list(eng.results[r].tokens) for r in rids]
+        st = dict(eng.stats)
+    finally:
+        faults.disarm()
+        eng.close()
+    return toks, st
+
+
+@pytest.mark.parametrize("dtype,temp", [
+    ("bfloat16", 0.0),
+    pytest.param("int8", 0.0, marks=pytest.mark.slow),
+    pytest.param("bfloat16", 0.8, marks=pytest.mark.slow),
+    pytest.param("int8", 0.8, marks=pytest.mark.slow),
+])
+def test_swap_resume_token_exact(model, dtype, temp):
+    """THE offload claim: preempt → swap-out → host tier → swap-in
+    resume is bit-identical to the uninterrupted run AND runs zero
+    replay dispatches (the KV came back bitwise, so there is nothing to
+    recompute) — where the legacy preempt path replays. Greedy and
+    sampled alike: sampling consumes the same per-request stream."""
+    base, _ = _run(model, False, -1, dtype, temp)
+    off, st = _run(model, True, 3, dtype, temp)
+    leg, st_leg = _run(model, False, 3, dtype, temp)
+    assert st["swap_outs"] >= 1 and st["swap_ins"] >= 1, st
+    assert st["swap_out_bytes"] > 0 and st["swap_in_bytes"] > 0, st
+    assert st["replay_tokens"] == 0, st["replay_tokens"]
+    assert st_leg["replay_tokens"] > 0, st_leg["replay_tokens"]
+    assert off == base
+    assert leg == base
+
+
+@pytest.mark.slow
+def test_swap_fault_downgrades_token_exact(model):
+    """A raising ``offload.swap`` fault at the swap-out gather must
+    downgrade that preemption to the legacy free+recompute path; one at
+    the swap-in scatter must drop the parked blocks and resume down the
+    token-exact replay path — both still bit-identical, zero loss."""
+    base, _ = _run(model, False, -1, "bfloat16", 0.0)
+
+    # fire #0 = the swap-OUT attempt: no swap happens at all
+    out, st = _run(model, True, 3, "bfloat16", 0.0,
+                   fault=faults.Fault("offload.swap", kind="raise", at=0))
+    assert out == base
+    assert st["swap_outs"] == 0, st
+    assert st["replay_tokens"] > 0, st     # legacy recompute resume
+
+    # fire #1 = the swap-IN attempt: parked blocks drop, replay resumes
+    out, st = _run(model, True, 3, "bfloat16", 0.0,
+                   fault=faults.Fault("offload.swap", kind="raise", at=1))
+    assert out == base
+    assert st["swap_outs"] >= 1 and st["swap_ins"] == 0, st
+    assert st["replay_tokens"] > 0, st
+
+
+# ------------------------------------------- snapshot with a parked request
+
+def test_snapshot_restore_with_host_resident_blocks(model, tmp_path):
+    """Host KV is a resume ACCELERATOR, never protocol state: with a
+    request parked in the host tier, snapshot → restore comes back
+    token-exact through the durable resume-tokens path (the restored
+    engine re-prefills where a live engine would have swapped in), and
+    the mid-flight snapshot_roundtrip sanitizer sees no drift."""
+    from paddle_tpu.analysis import runtime as rt_guard
+
+    base, _ = _run(model, False, -1, "bfloat16", 0.0)
+
+    eng = serving.ServingEngine(model, offload=True, max_slots=2,
+                                block_tokens=8, max_seq_len=64)
+    try:
+        rids = [eng.submit(serving.Request(p, max_new_tokens=_MAX_NEW,
+                                           seed=7 + i))
+                for i, p in enumerate(_PROMPTS)]
+        for _ in range(3):
+            eng.step()
+        assert eng._slots[0] is not None and not eng._slots[0].prefilling
+        eng._preempt(0)
+        # land the gathered blocks host-side WITHOUT ticking — a full
+        # step would re-admit the parked request into the freed slot
+        # and swap straight back in, vacating the host tier again
+        eng._drain_swaps()
+        assert eng.stats["swap_outs"] == 1
+        assert eng.host_store.used_blocks > 0
+        rt_guard.snapshot_roundtrip(eng)       # volatile tier: no drift
+        root = str(tmp_path / "snap")
+        eng.save_snapshot(root)
+    finally:
+        eng.close()
+
+    eng2 = serving.ServingEngine.restore(model, root)
+    try:
+        # the host tier died with the process — the restored engine
+        # resumes from serialized tokens, not from parked KV
+        assert eng2.host_store is not None
+        assert eng2.host_store.used_blocks == 0
+        eng2.drain()
+        assert [list(eng2.results[r].tokens) for r in rids] == base
+    finally:
+        eng2.close()
+
+
+# ------------------------------------------------- tier-wide prefix store
+
+_BT = 8
+_SHARED = np.arange(1, 33, dtype=np.int32)          # 4 full blocks
+
+
+def _tier_share_scenario(rt, want):
+    """Warm replica A with the shared prefix, keep it busy, then submit
+    a same-prefix request that OVERFLOWS to the cold sibling — which
+    must serve the shared span as a block copy, not a recompute."""
+    p1 = np.concatenate([_SHARED, np.array([100, 101, 102], np.int32)])
+    p2 = np.concatenate([_SHARED, np.array([200, 201], np.int32)])
+    a = rt.submit(serving.Request(p1, max_new_tokens=24, seed=3))
+    for _ in range(4):
+        rt.step()
+    t1 = rt._requests[a].replica
+    b = rt.submit(serving.Request(p2, max_new_tokens=8, seed=7))
+    t2 = rt._requests[b].replica
+    assert t1 != t2, "same-prefix request must overflow to the sibling"
+    rt.drain(timeout_s=600)
+    assert [int(t) for t in rt.results[b].tokens] == want
+    return t2
+
+
+def _reference_tokens(model):
+    p2 = np.concatenate([_SHARED, np.array([200, 201], np.int32)])
+    eng = serving.ServingEngine(model, max_slots=2, block_tokens=_BT,
+                                max_seq_len=128)
+    r = eng.submit(serving.Request(p2, max_new_tokens=8, seed=7))
+    eng.drain()
+    want = [int(t) for t in eng.results[r].tokens]
+    eng.close()
+    return want
+
+
+def test_tier_prefix_share_is_block_copy(model):
+    """Cross-replica prefix reuse, pinned by COUNTER assertion: the
+    overflow replica's prefill reused all 4 shared blocks (32 tokens)
+    and prefilled only the 2-token tail — zero prefill programs ran for
+    the shared span — with tokens bit-identical to a fresh engine that
+    computed the whole prompt itself."""
+    want = _reference_tokens(model)
+    rt = serving.Router(model, replicas=2, affinity_overload_factor=0.05,
+                        max_slots=2, block_tokens=_BT, max_seq_len=128)
+    try:
+        t2 = _tier_share_scenario(rt, want)
+        st2 = rt.replica_engine(t2).stats
+        assert st2["prefill_tokens_reused"] == 4 * _BT, st2
+        assert st2["prefill_tokens"] == 2, st2
+        assert rt.router_stats["prefix_shared_blocks"] == 4
+        assert rt.tier_prefix_hit_rate > 0.0
+        # satellite metric surface: the merged tier snapshot names both
+        text = rt.metrics_snapshot().prometheus_text()
+        assert "serving_router_prefix_hit_rate" in text
+        assert "serving_router_tier_prefix_hit_rate" in text
+    finally:
+        rt.close()
+
+
+@pytest.mark.slow
+def test_tier_prefix_share_over_rpc(proc_router):
+    """The same block-copy scenario across OS processes: the shared
+    blocks ship over the CRC-framed transport (block_fetch/block_put,
+    bf16 as raw bytes — never a float cast) and land bit-exact."""
+    want = _reference_tokens(tiny_factory())
+    rt = proc_router(replicas=2, affinity_overload_factor=0.05,
+                     max_slots=2, block_tokens=_BT, max_seq_len=128)
+    t2 = _tier_share_scenario(rt, want)
+    st2 = rt.replica_engine(t2).stats
+    assert st2["prefill_tokens_reused"] == 4 * _BT, st2
+    assert rt.router_stats["prefix_shared_blocks"] == 4
+
+
+# ------------------------------------------------------ SIGKILL mid-swap
+
+def test_sigkill_mid_swap_zero_loss(proc_router, tmp_path):
+    """A real SIGKILL landing INSIDE the swap window (an armed
+    ``offload.swap`` hang holds the worker between the D2H gather and
+    the host-tier commit) must leave the tier consistent: failover
+    re-places every journaled request and the results are bit-identical
+    — the host tier died with the process, the durable resume path
+    doesn't care."""
+    ref = {}
+    ref_eng = serving.ServingEngine(tiny_factory(), max_slots=2,
+                                    block_tokens=8, max_seq_len=64)
+    lows = [np.arange(1, 13, dtype=np.int32),
+            np.arange(20, 32, dtype=np.int32)]
+    high = np.arange(40, 50, dtype=np.int32)
+    for i, p in enumerate(lows + [high]):
+        r = ref_eng.submit(serving.Request(p, max_new_tokens=12, seed=i))
+        ref[i] = r
+    ref_eng.drain()
+    ref_toks = {i: list(ref_eng.results[r].tokens)
+                for i, r in ref.items()}
+    ref_eng.close()
+
+    rt = proc_router(replicas=1, root=str(tmp_path / "tier"),
+                     snapshot_every=None, heartbeat_timeout_s=0.5,
+                     suspect_after=1, dead_after=1,
+                     max_slots=2, block_tokens=8, max_seq_len=64,
+                     offload=True, host_pool_blocks=64)
+    rids = [rt.submit(serving.Request(p, max_new_tokens=12, seed=i,
+                                      priority="low"))
+            for i, p in enumerate(lows)]
+    for _ in range(3):
+        rt.step()           # both low requests decoding in the 2 slots
+    proxy = rt.replica_engine(0)
+    proxy.arm_faults([{"site": "offload.swap", "kind": "hang",
+                       "seconds": 15.0}])
+    # a high-priority arrival displaces a low slot -> preempt ->
+    # swap-out -> the worker falls asleep inside the swap window; the
+    # timer SIGKILLs it mid-sleep = genuinely MID-SWAP, while the
+    # parent is still blocked in the tick RPC (a step exception is
+    # replica-level: EOF -> dead -> failover)
+    rids.append(rt.submit(serving.Request(high, max_new_tokens=12,
+                                          seed=2, priority="high")))
+    killer = threading.Timer(2.0, os.kill,
+                             (proxy.pid, signal.SIGKILL))
+    killer.start()
+    try:
+        rt.step()           # tick RPC dies mid-swap: EOF absorbed
+    finally:
+        killer.cancel()
+    rt.step()               # dead -> failover respawn
+    assert rt.router_stats["failovers"] >= 1
+    rt.drain(timeout_s=600)
+    for i, rid in enumerate(rids):
+        assert rid in rt.results, f"request {i} lost across mid-swap kill"
+        assert list(rt.results[rid].tokens) == ref_toks[i]

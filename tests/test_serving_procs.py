@@ -86,6 +86,7 @@ def _prompts(n, rng):
     return [rng.randint(3, 500, (12,)) for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_cross_process_smoke_token_parity(proc_router):
     """submit → step → drain over 2 worker processes; tokens must be
     bit-identical to an in-process engine (same prompts, same seeds —

@@ -106,11 +106,13 @@ def _run_preempt_scenario(m, cache_dtype, temperature):
     eng.close()
 
 
+@pytest.mark.slow
 def test_preempt_resume_parity_bf16_greedy():
     cfg, m = tiny_llama()
     _run_preempt_scenario(m, jnp.bfloat16, 0.0)
 
 
+@pytest.mark.slow
 def test_preempt_resume_parity_int8_sampled():
     cfg, m = tiny_llama()
     _run_preempt_scenario(m, jnp.int8, 0.8)
@@ -228,6 +230,7 @@ def test_deadline_infeasible_shed_and_feasible_admitted():
 
 # --------------------------------------------- snapshot / restore / chaos
 
+@pytest.mark.slow
 def test_fault_mid_step_snapshot_restore_zero_loss(tmp_path):
     """The `not slow` chaos smoke: a decode.dispatch fault kills a step
     mid-flight (2 slots active, 2 requests queued); snapshot -> commit

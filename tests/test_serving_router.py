@@ -52,6 +52,7 @@ def _router(model, tmp_path=None, replicas=2, **kw):
 
 # ------------------------------------------------------------- placement
 
+@pytest.mark.slow
 def test_prefix_affinity_routes_same_prefix_together(model):
     rng = np.random.RandomState(0)
     prefix = rng.randint(3, 500, (16,))     # exactly one full block
@@ -110,6 +111,7 @@ def test_tier_saturated_typed_shedding(model):
 
 # -------------------------------------------------------- health machine
 
+@pytest.mark.slow
 def test_heartbeat_faults_drive_suspect_then_dead_then_failover(model):
     rng = np.random.RandomState(2)
     with _router(model, replicas=2, dead_after=3) as rt:
@@ -184,10 +186,12 @@ def _kill_parity(model, tmp_path, wipe_snapshots, temperature=0.0,
         rt.close()
 
 
+@pytest.mark.slow
 def test_kill_replica_restore_path_zero_loss_parity(model, tmp_path):
     _kill_parity(model, tmp_path, wipe_snapshots=False)
 
 
+@pytest.mark.slow
 def test_kill_replica_redistribute_path_zero_loss_parity(model,
                                                          tmp_path):
     _kill_parity(model, tmp_path, wipe_snapshots=True)
@@ -203,6 +207,7 @@ def test_kill_replica_parity_int8(model, tmp_path):
     _kill_parity(model, tmp_path, wipe_snapshots=False, cache_int8=True)
 
 
+@pytest.mark.slow
 def test_step_crash_fault_is_replica_level(model, tmp_path):
     """An injected decode.dispatch fault inside a replica's tick is a
     replica event (snapshot-at-crash + failover), never a router
@@ -222,6 +227,7 @@ def test_step_crash_fault_is_replica_level(model, tmp_path):
 
 # ------------------------------------------------------------ elasticity
 
+@pytest.mark.slow
 def test_drain_replica_migrates_and_add_replica_joins(model, tmp_path):
     rng = np.random.RandomState(5)
     refs = {}
@@ -271,6 +277,7 @@ def test_journal_replay_skips_corrupt_lines(tmp_path):
     assert corrupt == 2 and len(events) == 4
 
 
+@pytest.mark.slow
 def test_router_recover_rebuilds_tier_from_journal(model, tmp_path):
     rng = np.random.RandomState(6)
     prompts = [rng.randint(3, 500, (10,)) for _ in range(4)]
@@ -301,6 +308,7 @@ def test_router_recover_rebuilds_tier_from_journal(model, tmp_path):
         rt2.close()
 
 
+@pytest.mark.slow
 def test_recover_reanchors_seed_source_past_journaled_seeds(
         model, tmp_path):
     """A recovered router must not mint a fresh request the SAME
@@ -349,6 +357,7 @@ def test_restore_errors_are_typed(model, tmp_path):
     assert ei.value.reason == "schema"
 
 
+@pytest.mark.slow
 def test_restore_draft_snapshot_missing_model_is_typed(model):
     _, draft = tiny_llama()
     eng = serving.ServingEngine(
@@ -372,6 +381,7 @@ def test_restore_draft_snapshot_missing_model_is_typed(model):
 
 # -------------------------------------------- causal trace-id threading
 
+@pytest.mark.slow
 def test_trace_chain_connected_across_kill_replica(model, tmp_path):
     """One request = ONE trace_id chain, reconstructible from the
     journal alone — including across a kill-replica failover, whose
@@ -514,6 +524,7 @@ def test_router_duck_types_engine_bench_surface(model):
         rt.submit(rng.randint(3, 500, (8,)))
 
 
+@pytest.mark.slow
 def test_engine_displacement_rescued_on_sibling_replica(model):
     """A bounded-queue displacement inside one replica is only final
     at TIER saturation: the router re-places the displaced accepted
@@ -568,6 +579,7 @@ def test_roles_are_validated(model):
             rt.add_replica(role="bogus")
 
 
+@pytest.mark.slow
 def test_prefill_decode_roles_migrate_with_parity(model):
     """Splitwise-style disaggregation: admissions land on the PREFILL
     replica, every request migrates to the DECODE replica at its first

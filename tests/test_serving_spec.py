@@ -167,11 +167,13 @@ def test_spec_parity_bf16_greedy_ngram():
     assert stats["decode_tokens"] > stats["steps"]
 
 
+@pytest.mark.slow
 def test_spec_parity_int8_sampled_ngram():
     cfg, m = tiny_llama()
     _run_parity(m, jnp.int8, 0.8)
 
 
+@pytest.mark.slow
 def test_spec_parity_bf16_greedy_draft():
     cfg, m = tiny_llama()
     _, draft = tiny_llama(seed=0)   # same-weights draft: max acceptance
@@ -231,6 +233,7 @@ def test_spec_parity_gpt():
 
 # ------------------------------------- spec x non-spec engine equality
 
+@pytest.mark.slow
 def test_spec_engine_matches_nonspec_engine():
     """The same submissions through a speculative and a plain engine
     produce byte-identical result rows — speculation is invisible."""
@@ -251,6 +254,7 @@ def test_spec_engine_matches_nonspec_engine():
 
 # ------------------------------------------- preempt/resume + snapshot
 
+@pytest.mark.slow
 def test_spec_preempt_resume_token_exact():
     cfg, m = tiny_llama()
     rng = np.random.RandomState(3)
@@ -277,6 +281,7 @@ def test_spec_preempt_resume_token_exact():
     eng.close()
 
 
+@pytest.mark.slow
 def test_spec_snapshot_restore_token_exact(tmp_path):
     cfg, m = tiny_llama()
     rng = np.random.RandomState(3)
@@ -334,6 +339,7 @@ def test_spec_draft_snapshot_demands_model_override(tmp_path):
 
 # -------------------------------------------- TTFT estimator satellite
 
+@pytest.mark.slow
 def test_estimator_prices_speculative_tokens_per_tick():
     """The accepted-length EWMA must divide the decode work ahead: an
     engine committing ~3 tokens/tick estimates ~3x less queue wait
@@ -505,6 +511,7 @@ def test_spec_engine_on_interpret_kernel_token_exact():
 
 # ----------------------------------------------- per-slot adaptive k
 
+@pytest.mark.slow
 def test_adaptive_k_decays_on_low_acceptance_token_exact():
     """A draft proposer with DIFFERENT weights proposes k tokens every
     tick that almost never match the target's samples: the per-slot
@@ -538,6 +545,7 @@ def test_adaptive_k_decays_on_low_acceptance_token_exact():
     eng.close()
 
 
+@pytest.mark.slow
 def test_spec_k_zero_probe_reobserves_and_climbs_back():
     """The k=0 recovery probe (ROADMAP carry-over): a slot parked at
     ``k_min=0`` proposes nothing, so without probing its acceptance
@@ -578,6 +586,7 @@ def test_spec_k_zero_probe_reobserves_and_climbs_back():
     eng.close()
 
 
+@pytest.mark.slow
 def test_adaptive_k_holds_on_high_acceptance_token_exact():
     """A repetitive prompt keeps the n-gram acceptance EWMA above the
     ceiling: k never decays (every tick stays speculative) and tokens
@@ -604,6 +613,7 @@ def test_adaptive_k_holds_on_high_acceptance_token_exact():
     eng.close()
 
 
+@pytest.mark.slow
 def test_adaptive_config_survives_snapshot_roundtrip(tmp_path):
     cfg, m = tiny_llama()
     eng = serving.ServingEngine(

@@ -549,6 +549,7 @@ def test_metric_names_documented_in_observability_table():
 
 # ---- load_bench smoke (open-loop harness, BENCH percentile fields) ----------
 
+@pytest.mark.slow
 def test_load_bench_smoke_emits_slo_percentiles(tmp_path):
     """`not slow` CI smoke: load_bench at tiny CPU scale (with the PR 8
     overload knobs armed: --shed bounded queue + a priority mix) must

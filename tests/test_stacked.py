@@ -36,6 +36,7 @@ def test_from_state_dict_token_parity(tiny):
     assert np.asarray(out_ref).tolist() == np.asarray(out_st).tolist()
 
 
+@pytest.mark.slow
 def test_from_config_int8_runs(tiny):
     """Random-int8 materialization (the 7B bench path): decodes finite
     tokens, padded FFN stacks sized by the block plan."""

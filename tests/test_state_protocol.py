@@ -57,7 +57,12 @@ def _build(model, name, **extra):
     return serving.ServingEngine(model, **kw)
 
 
-@pytest.mark.parametrize("config", sorted(ENGINE_CONFIGS))
+@pytest.mark.parametrize("config", [
+    # the speculative combo is the heaviest and its snapshot surface is
+    # pinned by its own suite — tier-2; the other configs stay tier-1
+    pytest.param(c, marks=([pytest.mark.slow] if c == "speculative"
+                           else []))
+    for c in sorted(ENGINE_CONFIGS)])
 def test_snapshot_roundtrip_byte_identity_mid_flight(model, config):
     """THE pin: a mid-flight engine — active slots, queued work (mixed
     priorities/deadlines, a mid-prefill slot on the chunked config) —
@@ -88,6 +93,7 @@ def test_snapshot_roundtrip_byte_identity_mid_flight(model, config):
         assert eng.stats["roundtrip_checks"] == 2
 
 
+@pytest.mark.slow
 def test_snapshot_roundtrip_router_replica(model):
     """A live router replica's engine round-trips too (the failover
     restore path is the same protocol)."""

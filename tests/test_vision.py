@@ -29,6 +29,7 @@ def test_resnet18_forward_and_bn_buffers():
     assert "bn1._mean" in new_bufs
 
 
+@pytest.mark.slow
 def test_resnet18_train_step_decreases_loss():
     paddle_tpu.seed(0)
     model = resnet18(num_classes=4)

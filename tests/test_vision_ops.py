@@ -99,6 +99,7 @@ def test_roi_align_constant_and_linear():
     np.testing.assert_allclose(out[0, 0, 0], expect, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_roi_align_adaptive_default_grid():
     """sampling_ratio<=0 with CONCRETE boxes reproduces the reference's
     adaptive ceil(roi/pooled) grid per RoI; under jit it falls back to the
